@@ -1,0 +1,417 @@
+// Minimal JSON library for the symbiont native services.
+//
+// Hand-written (NOT generated). The generated wire-schema header
+// (generated/cpp/symbiont_schema.hpp) builds on this. Scope is exactly what the
+// wire schema needs: null/bool/number/string/array/object, UTF-8 passthrough,
+// \uXXXX escapes (incl. surrogate pairs), strict parse errors. The reference's
+// services get this via serde_json (reference: libs/shared_models/Cargo.toml);
+// this is the C++ equivalent with the same strictness.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <initializer_list>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace json {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error("json: " + msg) {}
+};
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  explicit Value(bool b) : type_(Type::Bool), bool_(b) {}
+  explicit Value(double n) : type_(Type::Number), num_(n) {}
+  explicit Value(float n) : type_(Type::Number), num_(n), is_f32_(true) {}
+  explicit Value(int n) : type_(Type::Number), num_(n) {}
+  explicit Value(const std::string& s) : type_(Type::String), str_(s) {}
+  explicit Value(std::string&& s) : type_(Type::String), str_(std::move(s)) {}
+  explicit Value(const char* s) : type_(Type::String), str_(s) {}
+
+  static Value object() {
+    Value v;
+    v.type_ = Type::Object;
+    return v;
+  }
+  static Value array() {
+    Value v;
+    v.type_ = Type::Array;
+    return v;
+  }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+
+  bool as_bool() const {
+    require(Type::Bool, "bool");
+    return bool_;
+  }
+  double as_number() const {
+    require(Type::Number, "number");
+    return num_;
+  }
+  // Strict u64 decode parity with the Python schema decoder: fractional or
+  // negative numbers are rejected. Values are limited to 2^53 (the double
+  // mantissa) — the wire schema's u64 fields are timestamps/counts, far below.
+  uint64_t as_u64() const {
+    require(Type::Number, "number");
+    if (num_ < 0 || num_ != std::floor(num_) || num_ >= 9007199254740992.0)
+      throw Error("expected integer (u64-safe)");
+    return (uint64_t)num_;
+  }
+  const std::string& as_string() const {
+    require(Type::String, "string");
+    return str_;
+  }
+  const std::vector<Value>& as_array() const {
+    require(Type::Array, "array");
+    return arr_;
+  }
+  const std::map<std::string, Value>& as_object() const {
+    require(Type::Object, "object");
+    return obj_;
+  }
+
+  void set(const std::string& key, Value v) {
+    require(Type::Object, "object");
+    // std::map keeps keys sorted; field order is not part of JSON equality.
+    obj_[key] = std::move(v);
+  }
+  void push_back(Value v) {
+    require(Type::Array, "array");
+    arr_.push_back(std::move(v));
+  }
+
+  bool has(const std::string& key) const {
+    require(Type::Object, "object");
+    return obj_.count(key) != 0;
+  }
+  const Value& at(const std::string& key) const {
+    require(Type::Object, "object");
+    auto it = obj_.find(key);
+    if (it == obj_.end()) throw Error("missing required field '" + key + "'");
+    return it->second;
+  }
+  // Strict-decode parity with the Python schema decoder
+  // (symbiont_tpu/schema/__init__.py from_dict): unknown fields are an error.
+  void check_known_fields(std::initializer_list<const char*> known) const {
+    require(Type::Object, "object");
+    for (const auto& kv : obj_) {
+      bool ok = false;
+      for (const char* k : known)
+        if (kv.first == k) {
+          ok = true;
+          break;
+        }
+      if (!ok) throw Error("unknown field '" + kv.first + "'");
+    }
+  }
+
+  std::string dump() const {
+    std::string out;
+    write(out);
+    return out;
+  }
+
+ private:
+  void require(Type t, const char* name) const {
+    if (type_ != t) throw Error(std::string("expected ") + name);
+  }
+
+  static void write_escaped(std::string& out, const std::string& s) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof buf, "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);  // UTF-8 bytes pass through
+          }
+      }
+    }
+    out += '"';
+  }
+
+  void write(std::string& out) const {
+    switch (type_) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += bool_ ? "true" : "false"; break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 9.007199254740992e15) {
+          char buf[32];
+          std::snprintf(buf, sizeof buf, "%lld", (long long)num_);
+          out += buf;
+        } else if (is_f32_) {
+          // Shortest representation that round-trips the f32 exactly —
+          // matches serde_json's f32 output (the wire format embeddings use,
+          // reference: libs/shared_models/src/lib.rs:42 Vec<f32>).
+          char buf[40];
+          float f = (float)num_;
+          for (int prec = 1; prec <= 9; ++prec) {
+            std::snprintf(buf, sizeof buf, "%.*g", prec, (double)f);
+            if (std::strtof(buf, nullptr) == f) break;
+          }
+          out += buf;
+        } else {
+          char buf[40];
+          for (int prec = 1; prec <= 17; ++prec) {
+            std::snprintf(buf, sizeof buf, "%.*g", prec, num_);
+            if (std::strtod(buf, nullptr) == num_) break;
+          }
+          out += buf;
+        }
+        break;
+      }
+      case Type::String: write_escaped(out, str_); break;
+      case Type::Array: {
+        out += '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) out += ',';
+          first = false;
+          v.write(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) out += ',';
+          first = false;
+          write_escaped(out, kv.first);
+          out += ':';
+          kv.second.write(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  bool is_f32_ = false;
+  double num_ = 0.0;
+  std::string str_;
+  std::vector<Value> arr_;
+  std::map<std::string, Value> obj_;
+};
+
+template <typename T, typename F>
+Value to_array(const std::vector<T>& items, F f) {
+  Value a = Value::array();
+  for (const T& v : items) a.push_back(f(v));
+  return a;
+}
+
+// ---------------------------------------------------------------------------
+// Parser
+// ---------------------------------------------------------------------------
+
+class Parser {
+ public:
+  explicit Parser(const std::string& src) : s_(src) {}
+
+  Value parse() {
+    skip_ws();
+    Value v = parse_value();
+    skip_ws();
+    if (pos_ != s_.size()) throw Error("trailing characters at offset " + std::to_string(pos_));
+    return v;
+  }
+
+ private:
+  char peek() {
+    if (pos_ >= s_.size()) throw Error("unexpected end of input");
+    return s_[pos_];
+  }
+  char next() {
+    char c = peek();
+    ++pos_;
+    return c;
+  }
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' || s_[pos_] == '\r'))
+      ++pos_;
+  }
+  void expect(const char* lit) {
+    for (const char* p = lit; *p; ++p)
+      if (pos_ >= s_.size() || s_[pos_++] != *p) throw Error(std::string("expected '") + lit + "'");
+  }
+
+  Value parse_value() {
+    switch (peek()) {
+      case 'n': expect("null"); return Value();
+      case 't': expect("true"); return Value(true);
+      case 'f': expect("false"); return Value(false);
+      case '"': return Value(parse_string());
+      case '[': return parse_array();
+      case '{': return parse_object();
+      default: return parse_number();
+    }
+  }
+
+  Value parse_number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < s_.size() && (std::isdigit((unsigned char)s_[pos_]) || s_[pos_] == '.' ||
+                                s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '+' ||
+                                s_[pos_] == '-'))
+      ++pos_;
+    if (pos_ == start) throw Error("invalid number");
+    try {
+      size_t used = 0;
+      double d = std::stod(s_.substr(start, pos_ - start), &used);
+      if (used != pos_ - start) throw Error("invalid number");
+      return Value(d);
+    } catch (const std::logic_error&) {
+      throw Error("invalid number");
+    }
+  }
+
+  static void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) {
+      out += (char)cp;
+    } else if (cp < 0x800) {
+      out += (char)(0xC0 | (cp >> 6));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += (char)(0xE0 | (cp >> 12));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else {
+      out += (char)(0xF0 | (cp >> 18));
+      out += (char)(0x80 | ((cp >> 12) & 0x3F));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  uint32_t parse_hex4() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= (uint32_t)(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= (uint32_t)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= (uint32_t)(c - 'A' + 10);
+      else throw Error("invalid \\u escape");
+    }
+    return v;
+  }
+
+  std::string parse_string() {
+    expect("\"");
+    std::string out;
+    for (;;) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            uint32_t cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              expect("\\u");
+              uint32_t lo = parse_hex4();
+              if (lo < 0xDC00 || lo > 0xDFFF) throw Error("invalid surrogate pair");
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              throw Error("lone low surrogate");  // serde_json rejects these too
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: throw Error("invalid escape");
+        }
+      } else if ((unsigned char)c < 0x20) {
+        throw Error("unescaped control character in string");
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Value parse_array() {
+    expect("[");
+    Value a = Value::array();
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return a;
+    }
+    for (;;) {
+      skip_ws();
+      a.push_back(parse_value());
+      skip_ws();
+      char c = next();
+      if (c == ']') return a;
+      if (c != ',') throw Error("expected ',' or ']' in array");
+    }
+  }
+
+  Value parse_object() {
+    expect("{");
+    Value o = Value::object();
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return o;
+    }
+    for (;;) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(":");
+      skip_ws();
+      o.set(key, parse_value());
+      skip_ws();
+      char c = next();
+      if (c == '}') return o;
+      if (c != ',') throw Error("expected ',' or '}' in object");
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& src) { return Parser(src).parse(); }
+
+}  // namespace json

@@ -1,0 +1,28 @@
+"""symbiont_tpu — a TPU-native framework with the capabilities of
+makkenzo/codename-symbiont.
+
+The reference system (see SURVEY.md) is a Rust microservice pipeline whose only
+tensor compute is a candle BERT forward pass (reference:
+services/preprocessing_service/src/embedding_generator.rs:198-207). This
+framework keeps the reference's *shape* — schema-first services around a message
+bus — and relocates the center of gravity into a TPU engine (JAX/XLA/pallas)
+that owns the device mesh, batches work with length-bucketed static shapes, and
+shards it across chips with shard_map/pjit.
+
+Subpackages
+-----------
+schema    : single-source wire schema (Python dataclasses → generated C++/TS)
+bus       : message fabric (in-proc async bus + native TCP broker client)
+models    : pure-JAX model zoo (BERT family, cross-encoder, decoder LMs, Markov)
+ops       : TPU ops (attention, pooling, top-k retrieval, pallas kernels)
+parallel  : mesh / sharding / collectives / ring attention
+engine    : the TPU engine service (batching queue, bucketed executor)
+memory    : TPU-native vector store (Qdrant-parity API, matmul top-k on MXU)
+graph     : embedded knowledge-graph store (Neo4j-parity MERGE semantics)
+services  : worker services (perception, preprocessing, vector_memory,
+            knowledge_graph, text_generator, api gateway)
+train     : sharded training steps (contrastive embedder + LM)
+utils     : config, ids, structured logging/tracing, metrics
+"""
+
+__version__ = "0.1.0"

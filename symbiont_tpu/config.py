@@ -1,0 +1,187 @@
+"""Typed configuration layer: defaults < config file < environment.
+
+The reference has no config system — raw `std::env::var` calls with warn+default
+fallbacks scattered through every service plus hardcoded constants (SURVEY.md
+§5.6; e.g. reference: services/perception_service/src/main.rs:177-180, batch
+size 8 at services/preprocessing_service/src/embedding_generator.rs:146). Here
+every tunable lives in one typed tree shared by the Python engine/services and
+exported to the native C++ workers via environment variables.
+
+Env override convention: SYMBIONT_<SECTION>_<FIELD>, e.g.
+SYMBIONT_ENGINE_MODEL_NAME, SYMBIONT_BUS_URL. Reference-era env names
+(NATS_URL, QDRANT_URI, FORCE_CPU, API_SERVER_HOST/PORT) are honored as aliases
+for drop-in compatibility (reference: .env.example:1-12).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, List, Optional
+
+
+@dataclass
+class BusConfig:
+    # reference default: nats://localhost:4222 (services) / nats://cs-nats:4222
+    # (api_service) — reference: services/api_service/src/main.rs:519-524
+    url: str = "symbus://127.0.0.1:4233"
+    request_timeout_embed_s: float = 15.0  # reference: api_service/src/main.rs:310
+    request_timeout_search_s: float = 20.0  # reference: api_service/src/main.rs:430
+
+
+@dataclass
+class EngineConfig:
+    # reference hardcodes the model id twice
+    # (reference: services/preprocessing_service/src/main.rs:305 and :121)
+    model_name: str = "sentence-transformers/paraphrase-multilingual-mpnet-base-v2"
+    model_dir: Optional[str] = None  # local checkpoint dir (safetensors + config)
+    embedding_dim: int = 768
+    force_cpu: bool = False  # reference: FORCE_CPU env, preprocessing main.rs:307
+    dtype: str = "bfloat16"
+    # Length buckets replace the reference's pad-everything-to-max policy
+    # (reference: embedding_generator.rs:83-91) — §5.7 of SURVEY.md.
+    length_buckets: List[int] = field(default_factory=lambda: [32, 64, 128, 256, 512])
+    # Batch buckets: one compiled executable per (length bucket, batch bucket).
+    batch_buckets: List[int] = field(default_factory=lambda: [1, 8, 32, 128])
+    max_batch: int = 128
+    # Interactive path: flush a partial batch after this deadline.
+    flush_deadline_ms: float = 5.0
+    data_parallel: bool = True  # shard batches across the mesh 'data' axis
+    executable_cache_size: int = 64
+
+
+@dataclass
+class VectorStoreConfig:
+    # reference: collection name + dim 768 + cosine hardcoded
+    # (reference: services/vector_memory_service/src/main.rs:20-22,34-42)
+    collection: str = "symbiont_document_embeddings"
+    dim: int = 768
+    distance: str = "cosine"
+    data_dir: str = "data/vector_store"
+    device_resident: bool = True  # corpus matrix lives in TPU HBM
+    shard_capacity: int = 65536  # rows per device-resident block
+
+
+@dataclass
+class GraphStoreConfig:
+    data_dir: str = "data/graph_store"
+
+
+@dataclass
+class ApiConfig:
+    # reference: API_SERVER_HOST/PORT (reference: api_service/src/main.rs:545-547)
+    host: str = "127.0.0.1"
+    port: int = 8080
+    sse_keepalive_s: float = 15.0  # reference: api_service/src/main.rs:190-213
+    sse_channel_capacity: int = 32  # reference: api_service/src/main.rs:537
+    max_gen_length: int = 1000  # reference: api_service/src/main.rs:133
+
+
+@dataclass
+class PerceptionConfig:
+    scrape_timeout_s: float = 15.0  # reference: perception_service/src/main.rs:89-91
+    user_agent: str = "SymbiontTPU/0.1 (+research crawler)"
+
+
+@dataclass
+class ParallelConfig:
+    # Mesh axes: data / tensor. PP/SP axes are pluggable (SURVEY.md §2 table).
+    mesh_shape: Optional[List[int]] = None  # None → (n_devices, 1)
+    axis_names: List[str] = field(default_factory=lambda: ["data", "tensor"])
+
+
+@dataclass
+class SymbiontConfig:
+    bus: BusConfig = field(default_factory=BusConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    vector_store: VectorStoreConfig = field(default_factory=VectorStoreConfig)
+    graph_store: GraphStoreConfig = field(default_factory=GraphStoreConfig)
+    api: ApiConfig = field(default_factory=ApiConfig)
+    perception: PerceptionConfig = field(default_factory=PerceptionConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+
+
+# Reference-era env aliases → (section, field) (reference: .env.example:1-12).
+_ENV_ALIASES = {
+    "NATS_URL": ("bus", "url"),
+    "API_SERVER_HOST": ("api", "host"),
+    "API_SERVER_PORT": ("api", "port"),
+    "FORCE_CPU": ("engine", "force_cpu"),
+    "EMBEDDING_MODEL_NAME": ("engine", "model_name"),
+}
+
+
+def _coerce(tp: Any, raw: str) -> Any:
+    if tp is bool or tp == Optional[bool]:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if tp is int or tp == Optional[int]:
+        return int(raw)
+    if tp is float or tp == Optional[float]:
+        return float(raw)
+    if tp in (List[int], List[str], Optional[List[int]]):
+        parsed = json.loads(raw)
+        return parsed
+    return raw
+
+
+def _apply_overrides(cfg: SymbiontConfig, env: dict[str, str]) -> None:
+    import typing
+
+    hints_by_section = {
+        f.name: typing.get_type_hints(type(getattr(cfg, f.name)))
+        for f in dataclasses.fields(cfg)
+    }
+    # Legacy reference-era aliases apply FIRST so canonical SYMBIONT_* vars win
+    # when both are set.
+    for alias, (sec, fld) in _ENV_ALIASES.items():
+        if alias in env:
+            setattr(getattr(cfg, sec), fld, _coerce(hints_by_section[sec][fld], env[alias]))
+    for section_field in dataclasses.fields(cfg):
+        section = getattr(cfg, section_field.name)
+        hints = hints_by_section[section_field.name]
+        for f in dataclasses.fields(section):
+            key = f"SYMBIONT_{section_field.name.upper()}_{f.name.upper()}"
+            if key in env:
+                setattr(section, f.name, _coerce(hints[f.name], env[key]))
+
+
+def _merge_dict(cfg_obj: Any, data: dict) -> None:
+    for k, v in data.items():
+        if not hasattr(cfg_obj, k):
+            raise ValueError(f"unknown config key {k!r} for {type(cfg_obj).__name__}")
+        cur = getattr(cfg_obj, k)
+        if dataclasses.is_dataclass(cur) and isinstance(v, dict):
+            _merge_dict(cur, v)
+        else:
+            # JSON carries types; guard the scalar ones so a quoted number in a
+            # config file fails loudly instead of flowing through as a string.
+            if cur is not None and v is not None and type(cur) in (int, float, str, bool):
+                if type(cur) is float and isinstance(v, int):
+                    v = float(v)
+                elif type(cur) is not type(v) or isinstance(v, bool) != isinstance(cur, bool):
+                    raise ValueError(
+                        f"config key {k!r}: expected {type(cur).__name__}, "
+                        f"got {type(v).__name__}"
+                    )
+            setattr(cfg_obj, k, v)
+
+
+def load_config(
+    path: str | Path | None = None, env: dict[str, str] | None = None
+) -> SymbiontConfig:
+    """defaults < json config file < env vars (legacy aliases below SYMBIONT_*)."""
+    cfg = SymbiontConfig()
+    env_map = os.environ if env is None else env
+    explicit = path is not None
+    if path is None:
+        path = env_map.get("SYMBIONT_CONFIG")
+    if path is not None:
+        if Path(path).exists():
+            _merge_dict(cfg, json.loads(Path(path).read_text()))
+        elif explicit:
+            raise FileNotFoundError(f"config file not found: {path}")
+    _apply_overrides(cfg, env_map)
+    return cfg

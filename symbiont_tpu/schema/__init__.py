@@ -1,0 +1,264 @@
+"""Single-source wire schema.
+
+The reference keeps its wire schema in Rust serde structs
+(reference: libs/shared_models/src/lib.rs:3-110) and hand-duplicates the same
+shapes as TypeScript interfaces in the frontend
+(reference: frontend/src/app/page.tsx:7-48) — an acknowledged hand-sync hazard.
+Here the schema has exactly ONE source (these dataclasses); the C++ header and
+TS interfaces are *generated* from it (see symbiont_tpu.schema.codegen), so the
+sync bug class cannot exist.
+
+All 13 wire structs from the reference are present with identical field names
+and JSON shapes, so the reference frontend and any NATS-speaking peer remain
+wire-compatible. Optional fields serialize as JSON null (serde's Option
+behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+# Registry of all wire structs, in reference declaration order
+# (reference: libs/shared_models/src/lib.rs:3-110).
+WIRE_TYPES: list[type] = []
+
+
+def wire(cls: type) -> type:
+    """Register a dataclass as a wire struct (adds JSON round-trip methods)."""
+    cls = dataclass(cls)
+    cls.__wire_hints__ = typing.get_type_hints(cls)  # cached: decode hot path
+    WIRE_TYPES.append(cls)
+    return cls
+
+
+def _encode(value: Any) -> Any:
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {f.name: _encode(getattr(value, f.name)) for f in dataclasses.fields(value)}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    return value
+
+
+def _decode(tp: Any, value: Any) -> Any:
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if value is None:
+            return None
+        return _decode(args[0], value)
+    if origin in (list, List):
+        if not isinstance(value, list):
+            raise ValueError(f"expected array, got {type(value).__name__}")
+        (elem,) = get_args(tp)
+        return [_decode(elem, v) for v in value]
+    if dataclasses.is_dataclass(tp):
+        return from_dict(tp, value)
+    if tp is float:
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            raise ValueError(f"expected number, got {type(value).__name__}")
+        return float(value)
+    if tp is int:
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ValueError(f"expected integer, got {type(value).__name__}")
+        return value
+    if tp is str and not isinstance(value, str):
+        raise ValueError(f"expected string, got {type(value).__name__}")
+    return value
+
+
+def from_dict(cls: Type[T], data: dict) -> T:
+    """Strict decode: unknown fields rejected, missing non-optional fields raise."""
+    hints = getattr(cls, "__wire_hints__", None) or typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _decode(hints[f.name], data[f.name])
+        elif _is_optional(hints[f.name]):
+            kwargs[f.name] = None
+        else:
+            raise ValueError(f"{cls.__name__}: missing required field {f.name!r}")
+    unknown = set(data) - {f.name for f in dataclasses.fields(cls)}
+    if unknown:
+        raise ValueError(f"{cls.__name__}: unknown fields {sorted(unknown)}")
+    return cls(**kwargs)
+
+
+def _is_optional(tp: Any) -> bool:
+    return get_origin(tp) is typing.Union and type(None) in get_args(tp)
+
+
+def to_json(msg: Any) -> str:
+    return json.dumps(_encode(msg), ensure_ascii=False, separators=(",", ":"))
+
+
+def to_json_bytes(msg: Any) -> bytes:
+    return to_json(msg).encode("utf-8")
+
+
+def from_json(cls: Type[T], raw: str | bytes) -> T:
+    if isinstance(raw, (bytes, bytearray)):
+        raw = raw.decode("utf-8")
+    return from_dict(cls, json.loads(raw))
+
+
+# ---------------------------------------------------------------------------
+# The 13 wire structs (reference: libs/shared_models/src/lib.rs:3-110)
+# ---------------------------------------------------------------------------
+
+
+@wire
+class PerceiveUrlTask:
+    """reference: libs/shared_models/src/lib.rs:4-6"""
+
+    url: str
+
+
+@wire
+class RawTextMessage:
+    """reference: libs/shared_models/src/lib.rs:9-14"""
+
+    id: str
+    source_url: str
+    raw_text: str
+    timestamp_ms: int
+
+
+@wire
+class TokenizedTextMessage:
+    """reference: libs/shared_models/src/lib.rs:17-23"""
+
+    original_id: str
+    source_url: str
+    tokens: List[str]
+    sentences: List[str]
+    timestamp_ms: int
+
+
+@wire
+class GenerateTextTask:
+    """reference: libs/shared_models/src/lib.rs:26-30"""
+
+    task_id: str
+    prompt: Optional[str]
+    max_length: int
+
+
+@wire
+class GeneratedTextMessage:
+    """reference: libs/shared_models/src/lib.rs:33-37"""
+
+    original_task_id: str
+    generated_text: str
+    timestamp_ms: int
+
+
+@wire
+class SentenceEmbedding:
+    """reference: libs/shared_models/src/lib.rs:40-43"""
+
+    sentence_text: str
+    embedding: List[float]
+
+
+@wire
+class TextWithEmbeddingsMessage:
+    """reference: libs/shared_models/src/lib.rs:46-52"""
+
+    original_id: str
+    source_url: str
+    embeddings_data: List[SentenceEmbedding]
+    model_name: str
+    timestamp_ms: int
+
+
+@wire
+class SemanticSearchApiRequest:
+    """reference: libs/shared_models/src/lib.rs:55-58"""
+
+    query_text: str
+    top_k: int
+
+
+@wire
+class QueryForEmbeddingTask:
+    """reference: libs/shared_models/src/lib.rs:61-64"""
+
+    request_id: str
+    text_to_embed: str
+
+
+@wire
+class QueryEmbeddingResult:
+    """reference: libs/shared_models/src/lib.rs:67-72"""
+
+    request_id: str
+    embedding: Optional[List[float]]
+    model_name: Optional[str]
+    error_message: Optional[str]
+
+
+@wire
+class QdrantPointPayload:
+    """reference: libs/shared_models/src/lib.rs:75-82
+
+    Name kept for wire parity even though our vector store is TPU-native
+    (symbiont_tpu.memory), not Qdrant.
+    """
+
+    original_document_id: str
+    source_url: str
+    sentence_text: str
+    sentence_order: int
+    model_name: str
+    processed_at_ms: int
+
+
+@wire
+class SemanticSearchNatsTask:
+    """reference: libs/shared_models/src/lib.rs:85-89"""
+
+    request_id: str
+    query_embedding: List[float]
+    top_k: int
+
+
+@wire
+class SemanticSearchResultItem:
+    """reference: libs/shared_models/src/lib.rs:92-96"""
+
+    qdrant_point_id: str
+    score: float
+    payload: QdrantPointPayload
+
+
+@wire
+class SemanticSearchNatsResult:
+    """reference: libs/shared_models/src/lib.rs:99-103"""
+
+    request_id: str
+    results: List[SemanticSearchResultItem]
+    error_message: Optional[str]
+
+
+@wire
+class SemanticSearchApiResponse:
+    """reference: libs/shared_models/src/lib.rs:106-110"""
+
+    search_request_id: str
+    results: List[SemanticSearchResultItem]
+    error_message: Optional[str]
+
+
+__all__ = [t.__name__ for t in WIRE_TYPES] + [
+    "WIRE_TYPES",
+    "to_json",
+    "to_json_bytes",
+    "from_json",
+    "from_dict",
+]

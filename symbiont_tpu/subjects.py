@@ -1,0 +1,49 @@
+"""Bus subject table — the system's internal API surface.
+
+Parity with the reference's eight NATS subjects (SURVEY.md §1-L3; producers and
+consumers cited there). Unlike the reference, which hardcodes each subject
+string inside each service (e.g. reference: services/api_service/src/main.rs:20-24),
+these are one configurable table shared by every service and by the native C++
+workers (exported through the generated header).
+
+The reference's `data.processed_text.tokenized` subject is ORPHANED in v0.3.0 —
+knowledge_graph_service subscribes (reference:
+services/knowledge_graph_service/src/main.rs:9,201) but nothing publishes
+(reference: CHANGELOG.md:57-60). This framework deliberately restores the
+producer: our preprocessing service publishes it (SURVEY.md fact #3).
+"""
+
+from __future__ import annotations
+
+# pipeline (fire-and-forget pub/sub)
+TASKS_PERCEIVE_URL = "tasks.perceive.url"
+DATA_RAW_TEXT_DISCOVERED = "data.raw_text.discovered"
+DATA_TEXT_WITH_EMBEDDINGS = "data.text.with_embeddings"
+DATA_PROCESSED_TEXT_TOKENIZED = "data.processed_text.tokenized"  # un-orphaned here
+TASKS_GENERATION_TEXT = "tasks.generation.text"
+EVENTS_TEXT_GENERATED = "events.text.generated"
+
+# request-reply (query path)
+TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query"
+TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request"
+
+ALL_SUBJECTS = [
+    TASKS_PERCEIVE_URL,
+    DATA_RAW_TEXT_DISCOVERED,
+    DATA_TEXT_WITH_EMBEDDINGS,
+    DATA_PROCESSED_TEXT_TOKENIZED,
+    TASKS_GENERATION_TEXT,
+    EVENTS_TEXT_GENERATED,
+    TASKS_EMBEDDING_FOR_QUERY,
+    TASKS_SEARCH_SEMANTIC_REQUEST,
+]
+
+# queue groups: the reference uses plain subscribe() with no queue groups, so a
+# second replica would double-process every message (SURVEY.md §1-L3 notes).
+# Every pipeline consumer here subscribes under a queue group so workers scale
+# out horizontally.
+QUEUE_PERCEPTION = "q.perception"
+QUEUE_PREPROCESSING = "q.preprocessing"
+QUEUE_VECTOR_MEMORY = "q.vector_memory"
+QUEUE_KNOWLEDGE_GRAPH = "q.knowledge_graph"
+QUEUE_TEXT_GENERATOR = "q.text_generator"

@@ -1,0 +1,3 @@
+from symbiont_tpu.utils.ids import current_timestamp_ms, generate_uuid
+
+__all__ = ["current_timestamp_ms", "generate_uuid"]

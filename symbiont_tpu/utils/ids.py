@@ -1,0 +1,20 @@
+"""ID + timestamp helpers.
+
+Parity with the reference's two shared helpers
+(reference: libs/shared_models/src/lib.rs:112-121).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+
+def current_timestamp_ms() -> int:
+    """Milliseconds since the Unix epoch (u64 semantics in the wire schema)."""
+    return int(time.time() * 1000)
+
+
+def generate_uuid() -> str:
+    """Random UUIDv4 string, the id format used on every wire message."""
+    return str(uuid.uuid4())

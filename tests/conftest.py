@@ -1,0 +1,20 @@
+"""Test harness setup.
+
+Multi-chip testing without a real pod: force the JAX CPU backend with 8 virtual
+devices (SURVEY.md §4 item 4) so sharding/collective tests exercise a real
+8-device mesh. Must run before the first `import jax` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_data_dir(tmp_path):
+    return tmp_path

@@ -1,0 +1,121 @@
+"""Cross-language wire parity: Python encoder ↔ generated C++ decoder.
+
+The reference never solved schema sync (hand-copied Rust ↔ TS shapes,
+reference: frontend/src/app/page.tsx:7-48). Here we *prove* sync: every wire
+message is encoded by Python, parsed + re-emitted by the generated C++, and
+decoded back by Python, field-for-field.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from symbiont_tpu import schema
+from symbiont_tpu.schema import codegen, from_json, to_json
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="g++ not available")
+
+HARNESS = r"""
+#include <iostream>
+#include <sstream>
+#include <string>
+#include "symbiont_schema.hpp"
+
+using namespace symbiont;
+
+// Reads one JSON line per wire type in registry order, echoes the C++
+// re-serialization; exercises parse() and to_json_string() for every struct.
+int main() {
+  std::string line;
+  int i = 0;
+  const char* names[] = {TYPE_LIST};
+  while (std::getline(std::cin, line)) {
+    std::string name = names[i++];
+    try {
+      std::string out = DISPATCH(name, line);
+      std::cout << out << "\n";
+    } catch (const std::exception& e) {
+      std::cout << "ERROR " << name << ": " << e.what() << "\n";
+      return 1;
+    }
+  }
+  return 0;
+}
+"""
+
+
+def _build_harness(tmp_path: Path) -> Path:
+    outdir = tmp_path / "gen"
+    codegen.main(str(outdir))
+    names = [t.__name__ for t in schema.WIRE_TYPES]
+    dispatch = "\n".join(
+        f'  if (name == "{n}") return {n}::parse(line).to_json_string();' for n in names
+    )
+    src = HARNESS.replace("TYPE_LIST", ", ".join(f'"{n}"' for n in names)).replace(
+        'DISPATCH(name, line)', "dispatch(name, line)"
+    )
+    src = src.replace(
+        "int main() {",
+        "std::string dispatch(const std::string& name, const std::string& line) {\n"
+        + dispatch
+        + '\n  throw std::runtime_error("unknown type " + name);\n}\n\nint main() {',
+    )
+    cpp = tmp_path / "harness.cpp"
+    cpp.write_text(src)
+    exe = tmp_path / "harness"
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-o", str(exe), str(cpp),
+         "-I", str(REPO / "native"), "-I", str(outdir / "cpp")],
+        check=True, capture_output=True, text=True,
+    )
+    return exe
+
+
+def _sample(cls):
+    """One populated instance per wire type (same fixtures as test_schema)."""
+    from tests.test_schema import CASES
+
+    for c in CASES:
+        if type(c) is cls:
+            return c
+    raise AssertionError(f"no fixture for {cls}")
+
+
+def test_cpp_round_trip_all_types(tmp_path):
+    exe = _build_harness(tmp_path)
+    msgs = [_sample(t) for t in schema.WIRE_TYPES]
+    stdin = "\n".join(to_json(m) for m in msgs) + "\n"
+    proc = subprocess.run([str(exe)], input=stdin, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = proc.stdout.strip().split("\n")
+    assert len(lines) == len(msgs)
+    for msg, line in zip(msgs, lines):
+        back = from_json(type(msg), line)
+        assert back == msg, f"{type(msg).__name__}: {line}"
+
+
+def test_cpp_rejects_unknown_field(tmp_path):
+    exe = _build_harness(tmp_path)
+    bad = json.dumps({"url": "http://x", "extra": 1})
+    proc = subprocess.run([str(exe)], input=bad + "\n", capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "unknown field" in proc.stdout
+
+
+def test_cpp_missing_optional_ok(tmp_path):
+    exe = _build_harness(tmp_path)
+    # GenerateTextTask is 4th in registry order; feed prior types valid inputs
+    msgs = [_sample(t) for t in schema.WIRE_TYPES[:3]]
+    stdin = "\n".join(to_json(m) for m in msgs)
+    stdin += "\n" + json.dumps({"task_id": "t", "max_length": 3}) + "\n"
+    proc = subprocess.run([str(exe)], input=stdin, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout
+    last = json.loads(proc.stdout.strip().split("\n")[-1])
+    assert last["prompt"] is None
+    assert last["max_length"] == 3

@@ -1,0 +1,55 @@
+import json
+
+from symbiont_tpu.config import SymbiontConfig, load_config
+
+
+def test_defaults():
+    cfg = SymbiontConfig()
+    assert cfg.vector_store.dim == 768
+    assert cfg.vector_store.collection == "symbiont_document_embeddings"
+    assert cfg.engine.length_buckets == [32, 64, 128, 256, 512]
+
+
+def test_file_then_env_precedence(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"api": {"port": 9000}, "engine": {"embedding_dim": 384}}))
+    cfg = load_config(p, env={"SYMBIONT_API_PORT": "9100"})
+    assert cfg.api.port == 9100  # env wins over file
+    assert cfg.engine.embedding_dim == 384  # file wins over default
+
+
+def test_reference_env_aliases(tmp_path):
+    cfg = load_config(env={
+        "NATS_URL": "symbus://bus:4233",
+        "FORCE_CPU": "true",
+        "API_SERVER_PORT": "8088",
+    })
+    assert cfg.bus.url == "symbus://bus:4233"
+    assert cfg.engine.force_cpu is True
+    assert cfg.api.port == 8088
+
+
+def test_canonical_env_beats_legacy_alias():
+    cfg = load_config(env={
+        "NATS_URL": "nats://old-host:4222",
+        "SYMBIONT_BUS_URL": "symbus://bus:4233",
+    })
+    assert cfg.bus.url == "symbus://bus:4233"
+
+
+def test_explicit_missing_config_path_raises(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        load_config(tmp_path / "missing.json")
+
+
+def test_unknown_file_key_rejected(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"api": {"bogus": 1}}))
+    try:
+        load_config(p)
+    except ValueError as e:
+        assert "bogus" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
