@@ -280,20 +280,35 @@ class Parser {
   }
 
   Value parse_number() {
+    // Strict JSON grammar: -?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][+-]?[0-9]+)?
+    // — same forms serde_json and Python's json accept; '01', '.5', '1.'
+    // are rejected.
     size_t start = pos_;
-    if (peek() == '-') ++pos_;
-    while (pos_ < s_.size() && (std::isdigit((unsigned char)s_[pos_]) || s_[pos_] == '.' ||
-                                s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '+' ||
-                                s_[pos_] == '-'))
-      ++pos_;
-    if (pos_ == start) throw Error("invalid number");
-    try {
-      size_t used = 0;
-      double d = std::stod(s_.substr(start, pos_ - start), &used);
-      if (used != pos_ - start) throw Error("invalid number");
-      return Value(d);
-    } catch (const std::logic_error&) {
+    if (pos_ < s_.size() && s_[pos_] == '-') ++pos_;
+    if (pos_ >= s_.size() || !std::isdigit((unsigned char)s_[pos_]))
       throw Error("invalid number");
+    if (s_[pos_] == '0') {
+      ++pos_;
+    } else {
+      while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    }
+    if (pos_ < s_.size() && s_[pos_] == '.') {
+      ++pos_;
+      if (pos_ >= s_.size() || !std::isdigit((unsigned char)s_[pos_]))
+        throw Error("invalid number");
+      while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    }
+    if (pos_ < s_.size() && (s_[pos_] == 'e' || s_[pos_] == 'E')) {
+      ++pos_;
+      if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+      if (pos_ >= s_.size() || !std::isdigit((unsigned char)s_[pos_]))
+        throw Error("invalid number");
+      while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
+    }
+    try {
+      return Value(std::stod(s_.substr(start, pos_ - start)));
+    } catch (const std::out_of_range&) {
+      throw Error("number out of range");
     }
   }
 

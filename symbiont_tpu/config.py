@@ -57,6 +57,9 @@ class EngineConfig:
 class VectorStoreConfig:
     # reference: collection name + dim 768 + cosine hardcoded
     # (reference: services/vector_memory_service/src/main.rs:20-22,34-42)
+    # uri accepted for reference-deployment compat (QDRANT_URI); the embedded
+    # TPU-native store ignores it unless an external-qdrant backend is selected.
+    uri: Optional[str] = None
     collection: str = "symbiont_document_embeddings"
     dim: int = 768
     distance: str = "cosine"
@@ -107,6 +110,7 @@ class SymbiontConfig:
 # Reference-era env aliases → (section, field) (reference: .env.example:1-12).
 _ENV_ALIASES = {
     "NATS_URL": ("bus", "url"),
+    "QDRANT_URI": ("vector_store", "uri"),
     "API_SERVER_HOST": ("api", "host"),
     "API_SERVER_PORT": ("api", "port"),
     "FORCE_CPU": ("engine", "force_cpu"),
@@ -148,7 +152,37 @@ def _apply_overrides(cfg: SymbiontConfig, env: dict[str, str]) -> None:
                 setattr(section, f.name, _coerce(hints[f.name], env[key]))
 
 
+def _check_type(key: str, tp: Any, v: Any) -> Any:
+    """Validate a config-file value against the field's declared type."""
+    import typing
+
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        if v is None:
+            return None
+        inner = [a for a in typing.get_args(tp) if a is not type(None)][0]
+        return _check_type(key, inner, v)
+    if origin is list:
+        if not isinstance(v, list):
+            raise ValueError(f"config key {key!r}: expected list, got {type(v).__name__}")
+        (elem,) = typing.get_args(tp)
+        return [_check_type(key, elem, x) for x in v]
+    if tp is float:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"config key {key!r}: expected number, got {type(v).__name__}")
+        return float(v)
+    if tp in (int, str, bool):
+        if not isinstance(v, tp) or (tp is int and isinstance(v, bool)):
+            raise ValueError(
+                f"config key {key!r}: expected {tp.__name__}, got {type(v).__name__}")
+        return v
+    return v
+
+
 def _merge_dict(cfg_obj: Any, data: dict) -> None:
+    import typing
+
+    hints = typing.get_type_hints(type(cfg_obj))
     for k, v in data.items():
         if not hasattr(cfg_obj, k):
             raise ValueError(f"unknown config key {k!r} for {type(cfg_obj).__name__}")
@@ -156,17 +190,7 @@ def _merge_dict(cfg_obj: Any, data: dict) -> None:
         if dataclasses.is_dataclass(cur) and isinstance(v, dict):
             _merge_dict(cur, v)
         else:
-            # JSON carries types; guard the scalar ones so a quoted number in a
-            # config file fails loudly instead of flowing through as a string.
-            if cur is not None and v is not None and type(cur) in (int, float, str, bool):
-                if type(cur) is float and isinstance(v, int):
-                    v = float(v)
-                elif type(cur) is not type(v) or isinstance(v, bool) != isinstance(cur, bool):
-                    raise ValueError(
-                        f"config key {k!r}: expected {type(cur).__name__}, "
-                        f"got {type(v).__name__}"
-                    )
-            setattr(cfg_obj, k, v)
+            setattr(cfg_obj, k, _check_type(k, hints[k], v))
 
 
 def load_config(

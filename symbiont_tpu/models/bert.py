@@ -1,0 +1,294 @@
+"""BERT-family encoder, TPU-first.
+
+Replaces the reference's one true compute core — the candle BertModel forward +
+attention-masked mean pooling inside preprocessing_service (reference:
+services/preprocessing_service/src/embedding_generator.rs:198-207) — with a
+pure-JAX implementation designed for the MXU:
+
+- params are a pytree of jax arrays; the forward is a pure function, so it
+  jits/shards/differentiates with no adapter layer;
+- compute dtype is bfloat16 by default (MXU-native) with float32 layernorm,
+  softmax accumulation and pooling for numerical parity with the fp32
+  reference (golden tests in tests/test_bert_numerics.py);
+- static shapes only: the engine pads to length buckets (SURVEY.md §5.7) and
+  this module never branches on data;
+- one config covers the checkpoint layouts in BASELINE.md: classic BERT
+  (MiniLM, bge, e5, ms-marco cross-encoder) and XLM-RoBERTa
+  (paraphrase-multilingual-mpnet-base-v2, the reference's default model) which
+  differs only in position-id offset (= pad_token_id + 1) and vocab details.
+
+Layout convention for weights: all linear kernels are stored [in, out] so the
+forward is `x @ W + b` (HF torch Linear weights are transposed on conversion —
+see symbiont_tpu.models.convert).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # nested dict pytree
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    # XLM-RoBERTa (mpnet-multilingual) offsets position ids by pad_token_id+1=2
+    # and starts them past the padding index; classic BERT uses offset 0.
+    # (HF: XLMRobertaEmbeddings.create_position_ids_from_input_ids.)
+    position_offset: int = 0
+    hidden_act: str = "gelu"
+    # dtype for matmul compute; params may be stored fp32 and cast on entry.
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def from_hf(cfg: dict) -> "BertConfig":
+        """Map an HF config.json dict (BertConfig/XLMRobertaConfig) to ours."""
+        model_type = cfg.get("model_type", "bert")
+        offset = 0
+        if model_type in ("xlm-roberta", "roberta", "mpnet"):
+            offset = cfg.get("pad_token_id", 1) + 1
+        return BertConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            num_layers=cfg.get("num_hidden_layers", 12),
+            num_heads=cfg.get("num_attention_heads", 12),
+            intermediate_size=cfg.get("intermediate_size", 4 * cfg["hidden_size"]),
+            max_position_embeddings=cfg.get("max_position_embeddings", 512),
+            type_vocab_size=cfg.get("type_vocab_size", 2) or 1,
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+            position_offset=offset,
+            hidden_act=cfg.get("hidden_act", "gelu"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    # fp32 statistics regardless of compute dtype — parity with the fp32
+    # reference forward within bf16 matmul noise.
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale + bias).astype(x.dtype)
+
+
+def _act(name: str):
+    if name in ("gelu", "gelu_new", "gelu_python"):
+        return partial(jax.nn.gelu, approximate=False)
+    if name == "relu":
+        return jax.nn.relu
+    if name == "silu":
+        return jax.nn.silu
+    raise ValueError(f"unsupported activation {name!r}")
+
+
+def attention(
+    params: Params,
+    x: jax.Array,  # [B, S, H]
+    mask_bias: jax.Array,  # [B, 1, 1, S] additive bias (0 or -inf-ish)
+    cfg: BertConfig,
+) -> jax.Array:
+    B, S, H = x.shape
+    nh = cfg.num_heads
+    hd = H // nh
+
+    def proj(p):
+        return (x @ p["kernel"] + p["bias"]).reshape(B, S, nh, hd)
+
+    q = proj(params["query"])
+    k = proj(params["key"])
+    v = proj(params["value"])
+
+    # [B, nh, S, S] scores; softmax in fp32 for stability/parity.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    scores = scores.astype(jnp.float32) + mask_bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
+    out = ctx @ params["out"]["kernel"] + params["out"]["bias"]
+    return out
+
+
+def encoder_layer(params: Params, x: jax.Array, mask_bias: jax.Array, cfg: BertConfig) -> jax.Array:
+    # Post-LN transformer block (classic BERT ordering).
+    attn_out = attention(params["attention"], x, mask_bias, cfg)
+    x = layer_norm(x + attn_out, params["attention"]["ln"]["scale"],
+                   params["attention"]["ln"]["bias"], cfg.layer_norm_eps)
+    h = x @ params["mlp"]["in"]["kernel"] + params["mlp"]["in"]["bias"]
+    h = _act(cfg.hidden_act)(h)
+    h = h @ params["mlp"]["out"]["kernel"] + params["mlp"]["out"]["bias"]
+    x = layer_norm(x + h, params["mlp"]["ln"]["scale"], params["mlp"]["ln"]["bias"],
+                   cfg.layer_norm_eps)
+    return x
+
+
+def embeddings(
+    params: Params,
+    input_ids: jax.Array,  # [B, S] int32
+    attention_mask: jax.Array,  # [B, S] int32/bool
+    cfg: BertConfig,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    B, S = input_ids.shape
+    tok = params["word_embeddings"][input_ids]
+    if cfg.position_offset:
+        # RoBERTa-style: positions count only non-pad tokens, offset past pad id.
+        mask = attention_mask.astype(jnp.int32)
+        positions = jnp.cumsum(mask, axis=1) * mask + cfg.position_offset - 1
+        positions = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
+    else:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    pos = params["position_embeddings"][positions]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    typ = params["token_type_embeddings"][token_type_ids]
+    x = tok + pos + typ
+    x = layer_norm(x, params["ln"]["scale"], params["ln"]["bias"], cfg.layer_norm_eps)
+    return x
+
+
+def bert_encode(
+    params: Params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    cfg: BertConfig,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full encoder forward → last hidden state [B, S, H] in cfg.dtype."""
+    dtype = jnp.dtype(cfg.dtype)
+    params = jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params
+    )
+    x = embeddings(params["embeddings"], input_ids, attention_mask, cfg, token_type_ids)
+    x = x.astype(dtype)
+    # additive mask bias: 0 for real tokens, large negative for padding
+    mask_bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+    for layer_params in params["layers"]:
+        x = encoder_layer(layer_params, x, mask_bias, cfg)
+    return x
+
+
+def mean_pool(hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """Attention-masked mean pooling, fp32 accumulation.
+
+    Exact semantics of the reference's pooling math (reference:
+    services/preprocessing_service/src/embedding_generator.rs:201-207):
+    sum(hidden * mask) / sum(mask), per sentence.
+    """
+    mask = attention_mask[..., None].astype(jnp.float32)
+    summed = (hidden.astype(jnp.float32) * mask).sum(axis=1)
+    counts = jnp.maximum(mask.sum(axis=1), 1.0)
+    return summed / counts
+
+
+def cls_pool(hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """CLS-token pooling (bge-style checkpoints)."""
+    del attention_mask
+    return hidden[:, 0, :].astype(jnp.float32)
+
+
+POOLERS = {"mean": mean_pool, "cls": cls_pool}
+
+
+def embed_sentences(
+    params: Params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    cfg: BertConfig,
+    pooling: str = "mean",
+    normalize: bool = False,
+) -> jax.Array:
+    """Encoder forward + pooling → [B, H] float32 sentence embeddings.
+
+    The reference does not L2-normalize (cosine distance is computed by Qdrant,
+    reference: services/vector_memory_service/src/main.rs:36), so normalize
+    defaults to False; e5/bge recipes can turn it on.
+    """
+    hidden = bert_encode(params, input_ids, attention_mask, cfg)
+    pooled = POOLERS[pooling](hidden, attention_mask)
+    if normalize:
+        pooled = pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return pooled
+
+
+def cross_encoder_score(
+    params: Params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    cfg: BertConfig,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Cross-encoder relevance score [B] (ms-marco rerank head: pooler + linear).
+
+    BASELINE.md config #4: ms-marco-MiniLM-L-6 rerank on top-k search hits.
+    """
+    hidden = bert_encode(params, input_ids, attention_mask, cfg, token_type_ids)
+    # HF BertPooler: tanh(W @ h_cls + b), then classifier [H, num_labels=1].
+    cls = hidden[:, 0, :]
+    pooled = jnp.tanh(cls @ params["pooler"]["kernel"] + params["pooler"]["bias"])
+    logits = pooled @ params["classifier"]["kernel"] + params["classifier"]["bias"]
+    return logits[..., 0].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Init (random params for tests/benchmarks; real weights come from convert.py)
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: BertConfig, with_pooler: bool = False) -> Params:
+    """Random init with BERT's trunc-normal(0.02) scheme; fp32 storage."""
+    k_iter = iter(jax.random.split(key, 6 + cfg.num_layers * 16))
+
+    def dense(shape):
+        return jax.random.truncated_normal(next(k_iter), -2, 2, shape, jnp.float32) * 0.02
+
+    def linear(n_in, n_out):
+        return {"kernel": dense((n_in, n_out)), "bias": jnp.zeros((n_out,), jnp.float32)}
+
+    def ln():
+        return {"scale": jnp.ones((cfg.hidden_size,), jnp.float32),
+                "bias": jnp.zeros((cfg.hidden_size,), jnp.float32)}
+
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    params: Params = {
+        "embeddings": {
+            "word_embeddings": dense((cfg.vocab_size, H)),
+            "position_embeddings": dense((cfg.max_position_embeddings, H)),
+            "token_type_embeddings": dense((cfg.type_vocab_size, H)),
+            "ln": ln(),
+        },
+        "layers": [
+            {
+                "attention": {
+                    "query": linear(H, H),
+                    "key": linear(H, H),
+                    "value": linear(H, H),
+                    "out": linear(H, H),
+                    "ln": ln(),
+                },
+                "mlp": {"in": linear(H, I), "out": linear(I, H), "ln": ln()},
+            }
+            for _ in range(cfg.num_layers)
+        ],
+    }
+    if with_pooler:
+        params["pooler"] = linear(H, H)
+        params["classifier"] = linear(H, 1)
+    return params

@@ -1,0 +1,225 @@
+"""HF checkpoint → JAX parameter pytrees.
+
+Replaces the reference's weight path — hf-hub download + unsafe mmap VarBuilder
+into candle (reference:
+services/preprocessing_service/src/embedding_generator.rs:25-58,106-124) — with
+an offline converter: local safetensors / torch `.bin` state_dicts are mapped
+into the pytree layout of symbiont_tpu.models.bert (and .gpt). No network: the
+engine points at a local model dir (config.engine.model_dir). Converted params
+can be checkpointed via symbiont_tpu.train.checkpoint so engine restarts skip
+reconversion (SURVEY.md §5.4 plan).
+
+Handles the BERT-family layouts named in BASELINE.md: bert.* (MiniLM/bge/e5,
+ms-marco cross-encoder), roberta.* (xlm-roberta = mpnet-multilingual), plus
+bare (headless) encoder dumps. Torch Linear stores [out, in]; kernels are
+transposed to [in, out] on conversion (see bert.py layout note).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict
+
+import numpy as np
+
+from symbiont_tpu.models.bert import BertConfig
+
+Params = Any
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (cpu) without importing torch at module load
+    return t.detach().cpu().numpy()
+
+
+def load_state_dict(model_dir: str | Path) -> Dict[str, np.ndarray]:
+    """Load weights from a local model dir: model.safetensors (preferred,
+    incl. sharded index — parity with the reference's sharded handling at
+    embedding_generator.rs:36-50) or pytorch_model.bin."""
+    model_dir = Path(model_dir)
+    st = model_dir / "model.safetensors"
+    idx = model_dir / "model.safetensors.index.json"
+    if st.exists():
+        from safetensors.numpy import load_file
+
+        return load_file(str(st))
+    if idx.exists():
+        from safetensors.numpy import load_file
+
+        shards = {json.loads(idx.read_text())["weight_map"][k] for k in
+                  json.loads(idx.read_text())["weight_map"]}
+        out: Dict[str, np.ndarray] = {}
+        for shard in sorted(shards):
+            out.update(load_file(str(model_dir / shard)))
+        return out
+    bin_path = model_dir / "pytorch_model.bin"
+    if bin_path.exists():
+        import torch
+
+        sd = torch.load(str(bin_path), map_location="cpu", weights_only=True)
+        return {k: _to_numpy(v) for k, v in sd.items()}
+    raise FileNotFoundError(f"no model.safetensors or pytorch_model.bin in {model_dir}")
+
+
+def load_hf_config(model_dir: str | Path) -> dict:
+    return json.loads((Path(model_dir) / "config.json").read_text())
+
+
+_PREFIXES = ("bert.", "roberta.", "mpnet.", "model.", "electra.")
+
+
+def _strip_prefix(name: str) -> str:
+    for p in _PREFIXES:
+        if name.startswith(p):
+            return name[len(p):]
+    return name
+
+
+def convert_bert(
+    state_dict: Dict[str, Any], cfg: BertConfig, with_pooler: bool = False
+) -> Params:
+    """Map an HF BERT/XLM-RoBERTa state_dict to the bert.py pytree."""
+    sd = {_strip_prefix(k): _to_numpy(v) for k, v in state_dict.items()}
+
+    def take(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(f"checkpoint missing tensor {name!r}; have e.g. "
+                           f"{sorted(sd)[:5]}")
+        return sd[name].astype(np.float32)
+
+    def linear(prefix: str) -> dict:
+        return {"kernel": take(f"{prefix}.weight").T, "bias": take(f"{prefix}.bias")}
+
+    def ln(prefix: str) -> dict:
+        return {"scale": take(f"{prefix}.weight"), "bias": take(f"{prefix}.bias")}
+
+    params: Params = {
+        "embeddings": {
+            "word_embeddings": take("embeddings.word_embeddings.weight"),
+            "position_embeddings": take("embeddings.position_embeddings.weight"),
+            "token_type_embeddings": (
+                take("embeddings.token_type_embeddings.weight")
+                if "embeddings.token_type_embeddings.weight" in sd
+                else np.zeros((cfg.type_vocab_size, cfg.hidden_size), np.float32)
+            ),
+            "ln": ln("embeddings.LayerNorm"),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}"
+        params["layers"].append(
+            {
+                "attention": {
+                    "query": linear(f"{p}.attention.self.query"),
+                    "key": linear(f"{p}.attention.self.key"),
+                    "value": linear(f"{p}.attention.self.value"),
+                    "out": linear(f"{p}.attention.output.dense"),
+                    "ln": ln(f"{p}.attention.output.LayerNorm"),
+                },
+                "mlp": {
+                    "in": linear(f"{p}.intermediate.dense"),
+                    "out": linear(f"{p}.output.dense"),
+                    "ln": ln(f"{p}.output.LayerNorm"),
+                },
+            }
+        )
+    if with_pooler:
+        params["pooler"] = linear("pooler.dense")
+        # cross-encoder classifier head lives outside the encoder prefix
+        cls_key = "classifier.weight" if "classifier.weight" in sd else None
+        if cls_key:
+            params["classifier"] = {"kernel": take("classifier.weight").T,
+                                    "bias": take("classifier.bias")}
+    return params
+
+
+def convert_gpt(state_dict: Dict[str, Any], cfg) -> Params:
+    """Map an HF GPT-2 or Llama state_dict to the gpt.py pytree.
+
+    GPT-2 uses Conv1D modules whose weights are already [in, out]; the fused
+    c_attn [H, 3H] is split into q/k/v. Llama uses Linear ([out, in] →
+    transposed) with separate q/k/v/o and SwiGLU gate/up/down.
+    """
+    import numpy as np
+
+    sd = {_strip_prefix(k.replace("transformer.", "")): _to_numpy(v)
+          for k, v in state_dict.items()}
+
+    def take(name):
+        if name not in sd:
+            raise KeyError(f"checkpoint missing tensor {name!r}")
+        return sd[name].astype(np.float32)
+
+    params: Params = {"layers": []}
+    if cfg.arch == "gpt2":
+        params["wte"] = take("wte.weight")
+        params["wpe"] = take("wpe.weight")
+        params["ln_f"] = {"scale": take("ln_f.weight"), "bias": take("ln_f.bias")}
+        H = cfg.hidden_size
+        for i in range(cfg.num_layers):
+            p = f"h.{i}"
+            qkv_w = take(f"{p}.attn.c_attn.weight")  # [H, 3H] (Conv1D)
+            qkv_b = take(f"{p}.attn.c_attn.bias")
+            qw, kw, vw = np.split(qkv_w, 3, axis=1)
+            qb, kb, vb = np.split(qkv_b, 3)
+            params["layers"].append({
+                "ln1": {"scale": take(f"{p}.ln_1.weight"), "bias": take(f"{p}.ln_1.bias")},
+                "ln2": {"scale": take(f"{p}.ln_2.weight"), "bias": take(f"{p}.ln_2.bias")},
+                "q": {"kernel": qw, "bias": qb},
+                "k": {"kernel": kw, "bias": kb},
+                "v": {"kernel": vw, "bias": vb},
+                "o": {"kernel": take(f"{p}.attn.c_proj.weight"),
+                      "bias": take(f"{p}.attn.c_proj.bias")},
+                "mlp": {
+                    "in": {"kernel": take(f"{p}.mlp.c_fc.weight"),
+                           "bias": take(f"{p}.mlp.c_fc.bias")},
+                    "out": {"kernel": take(f"{p}.mlp.c_proj.weight"),
+                            "bias": take(f"{p}.mlp.c_proj.bias")},
+                },
+            })
+    elif cfg.arch == "llama":
+        params["wte"] = take("embed_tokens.weight")
+        params["ln_f"] = {"scale": take("norm.weight")}
+        for i in range(cfg.num_layers):
+            p = f"layers.{i}"
+            params["layers"].append({
+                "ln1": {"scale": take(f"{p}.input_layernorm.weight")},
+                "ln2": {"scale": take(f"{p}.post_attention_layernorm.weight")},
+                "q": {"kernel": take(f"{p}.self_attn.q_proj.weight").T},
+                "k": {"kernel": take(f"{p}.self_attn.k_proj.weight").T},
+                "v": {"kernel": take(f"{p}.self_attn.v_proj.weight").T},
+                "o": {"kernel": take(f"{p}.self_attn.o_proj.weight").T},
+                "mlp": {
+                    "gate": {"kernel": take(f"{p}.mlp.gate_proj.weight").T},
+                    "up": {"kernel": take(f"{p}.mlp.up_proj.weight").T},
+                    "down": {"kernel": take(f"{p}.mlp.down_proj.weight").T},
+                },
+            })
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": take("lm_head.weight").T}
+    else:
+        raise ValueError(f"unsupported arch {cfg.arch!r}")
+    return params
+
+
+def load_gpt_model(model_dir: str | Path):
+    """One-call load: (params, GPTConfig) from a local HF model dir."""
+    from symbiont_tpu.models.gpt import GPTConfig
+
+    hf_cfg = load_hf_config(model_dir)
+    cfg = GPTConfig.from_hf(hf_cfg)
+    params = convert_gpt(load_state_dict(model_dir), cfg)
+    return params, cfg
+
+
+def load_bert_model(model_dir: str | Path, with_pooler: bool = False):
+    """One-call load: (params, BertConfig) from a local HF model dir."""
+    hf_cfg = load_hf_config(model_dir)
+    cfg = BertConfig.from_hf(hf_cfg)
+    params = convert_bert(load_state_dict(model_dir), cfg, with_pooler=with_pooler)
+    return params, cfg

@@ -1,0 +1,347 @@
+"""Decoder LMs for TPU generation: GPT-2 layout and Llama/TinyLlama layout.
+
+The reference's "text generator" is an order-1 Markov chain trained on one
+hardcoded sentence (reference: services/text_generator_service/src/main.rs:13-109,
+corpus at :170). BASELINE.json's north star upgrades this to a real
+autoregressive LM decoded on TPU (config #5: TinyLlama-1.1B / GPT-2,
+tokens/sec/chip + time-to-first-token). This module is that LM:
+
+- pure function over a params pytree, one config for both layouts
+  (GPT-2: learned positions + LN + gelu fused-qkv; Llama: RoPE + RMSNorm +
+  SwiGLU + GQA);
+- static-shape KV cache: prefill at a bucketed prompt length, then a
+  `lax.scan` decode loop over a fixed max_new_tokens — no data-dependent
+  Python control flow, one executable per (prompt_bucket, gen_bucket);
+- sampling: greedy / temperature / top-k, all shape-static;
+- tensor-parallel ready: attention heads and MLP hidden are the natural shard
+  axes; symbiont_tpu.parallel.sharding places them on the 'tensor' mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA (llama); None → num_heads
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    arch: str = "gpt2"  # "gpt2" | "llama"
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = True
+    dtype: str = "bfloat16"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def from_hf(cfg: dict) -> "GPTConfig":
+        mt = cfg.get("model_type", "gpt2")
+        if mt == "gpt2":
+            return GPTConfig(
+                vocab_size=cfg["vocab_size"],
+                hidden_size=cfg.get("n_embd", 768),
+                num_layers=cfg.get("n_layer", 12),
+                num_heads=cfg.get("n_head", 12),
+                intermediate_size=4 * cfg.get("n_embd", 768),
+                max_position_embeddings=cfg.get("n_positions", 1024),
+                layer_norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
+                arch="gpt2",
+            )
+        if mt in ("llama", "mistral"):
+            return GPTConfig(
+                vocab_size=cfg["vocab_size"],
+                hidden_size=cfg["hidden_size"],
+                num_layers=cfg["num_hidden_layers"],
+                num_heads=cfg["num_attention_heads"],
+                num_kv_heads=cfg.get("num_key_value_heads"),
+                intermediate_size=cfg["intermediate_size"],
+                max_position_embeddings=cfg.get("max_position_embeddings", 2048),
+                layer_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+                arch="llama",
+                rope_theta=cfg.get("rope_theta", 10000.0),
+                tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            )
+        raise ValueError(f"unsupported model_type {mt!r}")
+
+
+class KVCache(NamedTuple):
+    """Static-shape per-layer cache: k/v [L, B, max_len, kv_heads, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array  # [] int32 — number of valid positions
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Norms / RoPE
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return (((xf - mean) * jax.lax.rsqrt(var + eps)) * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _rmsnorm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (xf * scale * p["scale"]).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def _attn(
+    layer: Params,
+    x: jax.Array,  # [B, S, H]
+    layer_idx: int,
+    cache: KVCache,
+    positions: jax.Array,  # [B, S]
+    cfg: GPTConfig,
+) -> tuple[jax.Array, KVCache]:
+    B, S, H = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+    q = (x @ layer["q"]["kernel"] + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
+    k = (x @ layer["k"]["kernel"] + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
+    v = (x @ layer["v"]["kernel"] + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)
+
+    if cfg.arch == "llama":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+    # write into the static cache at [length : length+S]
+    start = cache.length
+    k_all = jax.lax.dynamic_update_slice(cache.k[layer_idx], k.astype(cache.k.dtype),
+                                         (0, start, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(cache.v[layer_idx], v.astype(cache.v.dtype),
+                                         (0, start, 0, 0))
+    new_cache = KVCache(cache.k.at[layer_idx].set(k_all),
+                        cache.v.at[layer_idx].set(v_all), cache.length)
+
+    if nkv != nh:
+        rep = nh // nkv
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+
+    T = k_all.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype)) / math.sqrt(hd)
+    # causal + validity mask over the static cache length
+    kv_pos = jnp.arange(T)[None, None, None, :]
+    q_pos = positions[:, None, :, None]
+    valid = (kv_pos <= q_pos) & (kv_pos < (start + S))
+    scores = jnp.where(valid, scores.astype(jnp.float32), -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(x.dtype)).reshape(B, S, H)
+    out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
+    return out, new_cache
+
+
+def _block(layer, x, layer_idx, cache, positions, cfg):
+    if cfg.arch == "gpt2":
+        a, cache = _attn(layer, _ln(x, layer["ln1"], cfg.layer_norm_eps),
+                         layer_idx, cache, positions, cfg)
+        x = x + a
+        h = _ln(x, layer["ln2"], cfg.layer_norm_eps)
+        h = h @ layer["mlp"]["in"]["kernel"] + layer["mlp"]["in"]["bias"]
+        h = jax.nn.gelu(h, approximate=True)  # GPT-2 uses gelu_new
+        h = h @ layer["mlp"]["out"]["kernel"] + layer["mlp"]["out"]["bias"]
+        return x + h, cache
+    # llama
+    a, cache = _attn(layer, _rmsnorm(x, layer["ln1"], cfg.layer_norm_eps),
+                     layer_idx, cache, positions, cfg)
+    x = x + a
+    h = _rmsnorm(x, layer["ln2"], cfg.layer_norm_eps)
+    gate = jax.nn.silu(h @ layer["mlp"]["gate"]["kernel"])
+    up = h @ layer["mlp"]["up"]["kernel"]
+    h = (gate * up) @ layer["mlp"]["down"]["kernel"]
+    return x + h, cache
+
+
+def forward(
+    params: Params,
+    input_ids: jax.Array,  # [B, S]
+    cache: KVCache,
+    positions: jax.Array,  # [B, S] absolute positions of these tokens
+    cfg: GPTConfig,
+) -> tuple[jax.Array, KVCache]:
+    """Forward over S new tokens against the cache → (logits [B, S, V], cache)."""
+    dtype = jnp.dtype(cfg.dtype)
+    params = jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params
+    )
+    x = params["wte"][input_ids]
+    if cfg.arch == "gpt2":
+        x = x + params["wpe"][positions]
+    for i, layer in enumerate(params["layers"]):
+        x, cache = _block(layer, x, i, cache, positions, cfg)
+    if cfg.arch == "gpt2":
+        x = _ln(x, params["ln_f"], cfg.layer_norm_eps)
+    else:
+        x = _rmsnorm(x, params["ln_f"], cfg.layer_norm_eps)
+    head = params["wte"].T if cfg.tie_word_embeddings else params["lm_head"]["kernel"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, cache
+
+
+# ---------------------------------------------------------------------------
+# Generation (static shapes; one executable per (prompt_len, max_new) pair)
+# ---------------------------------------------------------------------------
+
+
+def _sample(logits: jax.Array, key: jax.Array, temperature: float, top_k: int) -> jax.Array:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "eos_id"))
+def generate(
+    params: Params,
+    prompt_ids: jax.Array,  # [B, P] left-padded with pad_id? No: right-aligned real tokens
+    prompt_mask: jax.Array,  # [B, P] 1 for real prompt tokens (prefix-aligned)
+    key: jax.Array,
+    cfg: GPTConfig,
+    max_new_tokens: int = 64,
+    temperature: float = 0.8,
+    top_k: int = 40,
+    eos_id: int = -1,
+) -> tuple[jax.Array, jax.Array]:
+    """Prefill + scan decode. Returns (tokens [B, max_new_tokens], lengths [B]).
+
+    Prompts are prefix-aligned (real tokens first, padding after). Decode
+    continues from each row's true prompt length. Rows stop at eos_id (if ≥0);
+    lengths reports tokens generated before eos.
+    """
+    B, P = prompt_ids.shape
+    total = P + max_new_tokens
+    cache = init_cache(cfg, B, total, jnp.dtype(cfg.dtype))
+
+    prompt_len = prompt_mask.astype(jnp.int32).sum(axis=1)  # [B]
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    logits, cache = forward(params, prompt_ids, cache, positions, cfg)
+    cache = cache._replace(length=jnp.asarray(P, jnp.int32))
+
+    # logits at each row's last real prompt token
+    last_idx = jnp.maximum(prompt_len - 1, 0)
+    next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0, :]
+
+    def step(carry, step_key):
+        cache, cur_logits, cur_pos, done = carry
+        tok = _sample(cur_logits, step_key, temperature, top_k)
+        tok = jnp.where(done, 0, tok)
+        new_done = done | (tok == eos_id) if eos_id >= 0 else done
+        logits, new_cache = forward(params, tok[:, None], cache, cur_pos[:, None], cfg)
+        new_cache = new_cache._replace(length=cache.length + 1)
+        return (new_cache, logits[:, 0, :], cur_pos + 1, new_done), (tok, done)
+
+    keys = jax.random.split(key, max_new_tokens)
+    init = (cache, next_logits, prompt_len, jnp.zeros((B,), bool))
+    _, (tokens, was_done) = jax.lax.scan(step, init, keys)
+    tokens = tokens.T  # [B, max_new]
+    lengths = (~was_done.T).astype(jnp.int32).sum(axis=1)
+    return tokens, lengths
+
+
+# ---------------------------------------------------------------------------
+# Init (random params; real weights via convert_gpt)
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: GPTConfig) -> Params:
+    keys = jax.random.split(key, 4 + cfg.num_layers)
+
+    def dense(k, shape, scale=0.02):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    H, I, hd = cfg.hidden_size, cfg.intermediate_size, cfg.head_dim
+    nkv = cfg.kv_heads
+
+    def make_layer(k):
+        ks = jax.random.split(k, 8)
+        if cfg.arch == "gpt2":
+            return {
+                "ln1": {"scale": jnp.ones(H), "bias": jnp.zeros(H)},
+                "ln2": {"scale": jnp.ones(H), "bias": jnp.zeros(H)},
+                "q": {"kernel": dense(ks[0], (H, H)), "bias": jnp.zeros(H)},
+                "k": {"kernel": dense(ks[1], (H, H)), "bias": jnp.zeros(H)},
+                "v": {"kernel": dense(ks[2], (H, H)), "bias": jnp.zeros(H)},
+                "o": {"kernel": dense(ks[3], (H, H)), "bias": jnp.zeros(H)},
+                "mlp": {
+                    "in": {"kernel": dense(ks[4], (H, I)), "bias": jnp.zeros(I)},
+                    "out": {"kernel": dense(ks[5], (I, H)), "bias": jnp.zeros(H)},
+                },
+            }
+        return {
+            "ln1": {"scale": jnp.ones(H)},
+            "ln2": {"scale": jnp.ones(H)},
+            "q": {"kernel": dense(ks[0], (H, H))},
+            "k": {"kernel": dense(ks[1], (H, nkv * hd))},
+            "v": {"kernel": dense(ks[2], (H, nkv * hd))},
+            "o": {"kernel": dense(ks[3], (H, H))},
+            "mlp": {
+                "gate": {"kernel": dense(ks[4], (H, I))},
+                "up": {"kernel": dense(ks[5], (H, I))},
+                "down": {"kernel": dense(ks[6], (I, H))},
+            },
+        }
+
+    params: Params = {
+        "wte": dense(keys[0], (cfg.vocab_size, H)),
+        "layers": [make_layer(k) for k in keys[4:]],
+        "ln_f": ({"scale": jnp.ones(H), "bias": jnp.zeros(H)} if cfg.arch == "gpt2"
+                 else {"scale": jnp.ones(H)}),
+    }
+    if cfg.arch == "gpt2":
+        params["wpe"] = dense(keys[1], (cfg.max_position_embeddings, H))
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(keys[2], (H, cfg.vocab_size))}
+    return params

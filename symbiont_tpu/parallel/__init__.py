@@ -1,0 +1,37 @@
+"""Device-plane parallelism (ICI): mesh, sharding specs, ring attention.
+
+The reference has NO device parallelism of any kind — single candle device,
+serial batch-8 loop (reference:
+services/preprocessing_service/src/embedding_generator.rs:146-216), and its
+only "distributed" layer is NATS pub/sub between single-instance services
+(SURVEY.md §2 parallelism inventory). This package is the TPU-native design
+that replaces that absence:
+
+mesh       : named device meshes (axes: data, tensor) over real TPU slices or
+             the 8-virtual-device CPU backend used in tests
+sharding   : NamedSharding rules — DP batch sharding for embedding, TP rules
+             for decoder LM params (heads / MLP hidden on 'tensor')
+ring_attention : sequence-parallel blockwise attention via shard_map+ppermute
+             for long-context (a first-class capability the reference lacks)
+
+XLA inserts the collectives (psum/all-gather/ppermute ride ICI); this package
+only defines meshes and shardings — no hand-written NCCL analog (SURVEY.md §2
+"Distributed communication backend").
+"""
+
+from symbiont_tpu.parallel.mesh import build_mesh, local_device_count
+from symbiont_tpu.parallel.sharding import (
+    batch_sharding,
+    gpt_param_sharding,
+    replicate,
+    shard_params,
+)
+
+__all__ = [
+    "build_mesh",
+    "local_device_count",
+    "batch_sharding",
+    "replicate",
+    "gpt_param_sharding",
+    "shard_params",
+]

@@ -1,0 +1,44 @@
+"""Mesh construction over whatever devices the runtime exposes.
+
+Axes:
+- "data"   — batch sharding (DP); embedding throughput scales on this axis.
+- "tensor" — parameter sharding (TP) for decoder LMs too big for one chip.
+
+PP/SP are deliberately *pluggable, not default*: the mesh helper accepts
+arbitrary extra axes so a pipeline or sequence axis can be added without
+touching call sites (SURVEY.md §2: PP "design mesh axes so PP can be added").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def build_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("data", "tensor"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh.
+
+    shape=None → all devices on the first axis, 1 on the rest (pure DP, the
+    right default for the embedding models: MiniLM..e5-large all fit a single
+    v5e chip's HBM; TP is for LMs).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = [n] + [1] * (len(axis_names) - 1)
+    shape = list(shape)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))

@@ -1,0 +1,88 @@
+"""Sharding specs: how params and batches lay out on the mesh.
+
+Design per the scaling-book recipe: pick a mesh, annotate shardings with
+NamedSharding/PartitionSpec, let XLA insert the collectives. Nothing here
+issues a collective by hand except ring attention (which needs the explicit
+ppermute schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Any
+
+
+def replicate(mesh: Mesh, tree: Params) -> Params:
+    """Fully replicate a pytree across the mesh (embedding models: weights are
+    small; DP wants replicas)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard dim 0 (batch) over the data axis; everything else replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def _gpt_layer_spec(arch: str) -> dict:
+    """TP rules for one decoder layer: attention heads and MLP hidden shard on
+    'tensor'; output projections shard the contracting dim so XLA reduces the
+    partial sums with a psum over 'tensor'."""
+    col = P(None, "tensor")  # [in, out] sharded on out
+    row = P("tensor", None)  # [in, out] sharded on in  (contraction → psum)
+    vec = P("tensor")
+    if arch == "gpt2":
+        return {
+            "ln1": {"scale": P(), "bias": P()},
+            "ln2": {"scale": P(), "bias": P()},
+            "q": {"kernel": col, "bias": vec},
+            "k": {"kernel": col, "bias": vec},
+            "v": {"kernel": col, "bias": vec},
+            "o": {"kernel": row, "bias": P()},
+            "mlp": {
+                "in": {"kernel": col, "bias": vec},
+                "out": {"kernel": row, "bias": P()},
+            },
+        }
+    return {
+        "ln1": {"scale": P()},
+        "ln2": {"scale": P()},
+        "q": {"kernel": col},
+        "k": {"kernel": col},
+        "v": {"kernel": col},
+        "o": {"kernel": row},
+        "mlp": {
+            "gate": {"kernel": col},
+            "up": {"kernel": col},
+            "down": {"kernel": row},
+        },
+    }
+
+
+def gpt_param_sharding(mesh: Mesh, params: Params, arch: str = "gpt2") -> Params:
+    """PartitionSpec tree for decoder LM params (megatron-style TP)."""
+    layer_spec = _gpt_layer_spec(arch)
+    spec: dict = {
+        "wte": P("tensor", None),  # vocab-sharded embedding
+        "layers": [layer_spec for _ in params["layers"]],
+        "ln_f": {k: P() for k in params["ln_f"]},
+    }
+    if "wpe" in params:
+        spec["wpe"] = P()
+    if "lm_head" in params:
+        spec["lm_head"] = {"kernel": P(None, "tensor")}
+    return spec
+
+
+def shard_params(mesh: Mesh, params: Params, spec_tree: Params) -> Params:
+    """Place params on the mesh per a PartitionSpec tree."""
+    return jax.tree.map(
+        lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec)),
+        params,
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -37,11 +37,19 @@ def wire(cls: type) -> type:
     return cls
 
 
+_U64_SAFE_MAX = 2**53  # double-mantissa bound shared with the C++ decoder
+
+
 def _encode(value: Any) -> Any:
     if dataclasses.is_dataclass(value) and not isinstance(value, type):
         return {f.name: _encode(getattr(value, f.name)) for f in dataclasses.fields(value)}
     if isinstance(value, (list, tuple)):
         return [_encode(v) for v in value]
+    if isinstance(value, int) and not isinstance(value, bool):
+        # wire ints are u64 (serde side); enforce at the producer so a bad
+        # value fails here, not in a remote C++ worker's as_u64()
+        if not 0 <= value < _U64_SAFE_MAX:
+            raise ValueError(f"integer {value} outside u64-safe range [0, 2^53)")
     return value
 
 
@@ -66,6 +74,8 @@ def _decode(tp: Any, value: Any) -> Any:
     if tp is int:
         if not isinstance(value, int) or isinstance(value, bool):
             raise ValueError(f"expected integer, got {type(value).__name__}")
+        if not 0 <= value < _U64_SAFE_MAX:
+            raise ValueError(f"integer {value} outside u64-safe range [0, 2^53)")
         return value
     if tp is str and not isinstance(value, str):
         raise ValueError(f"expected string, got {type(value).__name__}")
@@ -94,7 +104,10 @@ def _is_optional(tp: Any) -> bool:
 
 
 def to_json(msg: Any) -> str:
-    return json.dumps(_encode(msg), ensure_ascii=False, separators=(",", ":"))
+    # allow_nan=False: a NaN/Inf embedding value must fail at the producer,
+    # not poison the bus for serde_json/C++ consumers.
+    return json.dumps(_encode(msg), ensure_ascii=False, separators=(",", ":"),
+                      allow_nan=False)
 
 
 def to_json_bytes(msg: Any) -> bytes:

@@ -7,10 +7,21 @@ devices (SURVEY.md §4 item 4) so sharding/collective tests exercise a real
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The sandbox's sitecustomize registers the axon TPU backend and force-updates
+# jax_platforms to "axon,cpu", overriding the env var — push it back to cpu
+# before any backend is instantiated. Guarded: the schema/config/bus tests
+# must still run where jax isn't installed.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,178 @@
+"""Golden-vector numerics: our JAX BERT vs the HF torch reference.
+
+SURVEY.md §4 names this the gate for weight-porting fidelity: the reference's
+compute core is candle BertModel + masked mean pooling
+(reference: services/preprocessing_service/src/embedding_generator.rs:198-207);
+we verify our forward matches transformers' BertModel / XLMRobertaModel on
+randomly-initialized tiny checkpoints (no network needed), in fp32, to tight
+tolerance. bf16 is then checked for coarse agreement (MXU production dtype).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from symbiont_tpu.models.bert import (  # noqa: E402
+    BertConfig,
+    bert_encode,
+    cross_encoder_score,
+    embed_sentences,
+    mean_pool,
+)
+from symbiont_tpu.models.convert import convert_bert  # noqa: E402
+
+TINY = dict(
+    vocab_size=99,
+    hidden_size=32,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=64,
+)
+
+
+def _rand_inputs(rng, B=3, S=10, vocab=99, pad_to=16):
+    ids = rng.integers(3, vocab, size=(B, pad_to))
+    mask = np.zeros((B, pad_to), np.int32)
+    for i, ln in enumerate([S, S - 3, S - 5]):
+        mask[i, :ln] = 1
+        ids[i, ln:] = 0
+    return ids.astype(np.int32), mask
+
+
+@pytest.fixture(scope="module")
+def torch_bert():
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(**TINY)
+    model = transformers.BertModel(cfg).eval()
+    return model, cfg
+
+
+@pytest.fixture(scope="module")
+def torch_xlmr():
+    torch.manual_seed(1)
+    cfg = transformers.XLMRobertaConfig(**TINY, pad_token_id=1)
+    model = transformers.XLMRobertaModel(cfg).eval()
+    return model, cfg
+
+
+def _our_cfg(hf_cfg, **kw) -> BertConfig:
+    cfg = BertConfig.from_hf(hf_cfg.to_dict())
+    return dataclasses.replace(cfg, dtype="float32", **kw)
+
+
+def test_bert_last_hidden_matches_hf(torch_bert):
+    model, hf_cfg = torch_bert
+    ids, mask = _rand_inputs(np.random.default_rng(0))
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor(ids.astype(np.int64)),
+                    attention_mask=torch.tensor(mask.astype(np.int64)))
+    cfg = _our_cfg(hf_cfg)
+    params = convert_bert(model.state_dict(), cfg)
+    ours = bert_encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    ref_np = ref.last_hidden_state.numpy()
+    # padding positions are junk in both impls; compare only real tokens
+    m = mask[..., None].astype(bool)
+    np.testing.assert_allclose(np.where(m, np.asarray(ours), 0),
+                               np.where(m, ref_np, 0), atol=2e-5, rtol=1e-4)
+
+
+def test_xlmr_position_offset_matches_hf(torch_xlmr):
+    """XLM-RoBERTa layout = the reference's default mpnet-multilingual model."""
+    model, hf_cfg = torch_xlmr
+    ids, mask = _rand_inputs(np.random.default_rng(1))
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor(ids.astype(np.int64)),
+                    attention_mask=torch.tensor(mask.astype(np.int64)))
+    cfg = _our_cfg(hf_cfg)
+    assert cfg.position_offset == 2  # pad_token_id(1) + 1
+    params = convert_bert(model.state_dict(), cfg)
+    ours = bert_encode(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    m = mask[..., None].astype(bool)
+    np.testing.assert_allclose(np.where(m, np.asarray(ours), 0),
+                               np.where(m, ref.last_hidden_state.numpy(), 0),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_mean_pool_matches_reference_semantics(torch_bert):
+    """sum(h*mask)/sum(mask) — reference: embedding_generator.rs:201-207."""
+    model, hf_cfg = torch_bert
+    ids, mask = _rand_inputs(np.random.default_rng(2))
+    with torch.no_grad():
+        ref_h = model(input_ids=torch.tensor(ids.astype(np.int64)),
+                      attention_mask=torch.tensor(mask.astype(np.int64))
+                      ).last_hidden_state.numpy()
+    manual = (ref_h * mask[..., None]).sum(1) / mask.sum(1, keepdims=True)
+    cfg = _our_cfg(hf_cfg)
+    params = convert_bert(model.state_dict(), cfg)
+    ours = embed_sentences(params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+                           pooling="mean")
+    np.testing.assert_allclose(np.asarray(ours), manual, atol=2e-5, rtol=1e-4)
+
+
+def test_normalized_embeddings_unit_norm(torch_bert):
+    model, hf_cfg = torch_bert
+    ids, mask = _rand_inputs(np.random.default_rng(3))
+    cfg = _our_cfg(hf_cfg)
+    params = convert_bert(model.state_dict(), cfg)
+    out = embed_sentences(params, jnp.asarray(ids), jnp.asarray(mask), cfg,
+                          normalize=True)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0,
+                               atol=1e-5)
+
+
+def test_cross_encoder_matches_hf():
+    """ms-marco-style rerank head (BASELINE.md config #4)."""
+    torch.manual_seed(2)
+    hf_cfg = transformers.BertConfig(**TINY, num_labels=1)
+    model = transformers.BertForSequenceClassification(hf_cfg).eval()
+    ids, mask = _rand_inputs(np.random.default_rng(4))
+    with torch.no_grad():
+        ref = model(input_ids=torch.tensor(ids.astype(np.int64)),
+                    attention_mask=torch.tensor(mask.astype(np.int64))).logits[:, 0]
+    cfg = _our_cfg(hf_cfg)
+    params = convert_bert(model.state_dict(), cfg, with_pooler=True)
+    ours = cross_encoder_score(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=3e-5, rtol=1e-4)
+
+
+def test_bf16_close_to_fp32(torch_bert):
+    """Production dtype sanity: bf16 embeddings ≈ fp32 (cosine > 0.995)."""
+    model, hf_cfg = torch_bert
+    ids, mask = _rand_inputs(np.random.default_rng(5))
+    cfg32 = _our_cfg(hf_cfg)
+    cfg16 = dataclasses.replace(cfg32, dtype="bfloat16")
+    params = convert_bert(model.state_dict(), cfg32)
+    e32 = np.asarray(embed_sentences(params, jnp.asarray(ids), jnp.asarray(mask), cfg32))
+    e16 = np.asarray(embed_sentences(params, jnp.asarray(ids), jnp.asarray(mask), cfg16))
+    cos = (e32 * e16).sum(-1) / (np.linalg.norm(e32, axis=-1) * np.linalg.norm(e16, axis=-1))
+    assert cos.min() > 0.995, cos
+
+
+def test_padding_invariance():
+    """Embedding of a sentence must not change when batch-padded longer —
+    the property that makes length-bucketing (SURVEY.md §5.7) safe."""
+    import symbiont_tpu.models.bert as bert_mod
+
+    cfg = BertConfig(vocab_size=50, hidden_size=16, num_layers=2, num_heads=2,
+                     intermediate_size=32, max_position_embeddings=32,
+                     dtype="float32")
+    params = bert_mod.init_params(jax.random.key(0), cfg)
+    ids = np.zeros((1, 8), np.int32)
+    ids[0, :5] = [4, 5, 6, 7, 8]
+    mask = np.zeros((1, 8), np.int32)
+    mask[0, :5] = 1
+    short = embed_sentences(params, jnp.asarray(ids), jnp.asarray(mask), cfg)
+    ids_l = np.zeros((1, 16), np.int32)
+    ids_l[0, :5] = [4, 5, 6, 7, 8]
+    mask_l = np.zeros((1, 16), np.int32)
+    mask_l[0, :5] = 1
+    long = embed_sentences(params, jnp.asarray(ids_l), jnp.asarray(mask_l), cfg)
+    np.testing.assert_allclose(np.asarray(short), np.asarray(long), atol=1e-5)

@@ -100,6 +100,24 @@ def test_cpp_round_trip_all_types(tmp_path):
         assert back == msg, f"{type(msg).__name__}: {line}"
 
 
+def test_committed_generated_files_in_sync():
+    """generated/ must match fresh codegen output — guards against editing the
+    schema without re-running `python -m symbiont_tpu.schema.codegen generated`."""
+    cpp = (REPO / "generated" / "cpp" / "symbiont_schema.hpp").read_text()
+    ts = (REPO / "generated" / "ts" / "schema.ts").read_text()
+    assert cpp == codegen.gen_cpp(), "regenerate: python -m symbiont_tpu.schema.codegen generated"
+    assert ts == codegen.gen_ts(), "regenerate: python -m symbiont_tpu.schema.codegen generated"
+
+
+def test_cpp_rejects_malformed_numbers(tmp_path):
+    """Strict number grammar parity: serde/Python reject these; C++ must too."""
+    exe = _build_harness(tmp_path)
+    for bad in ('{"url": 01}', '{"url": .5}', '{"url": 1.}', '{"url": +1}'):
+        proc = subprocess.run([str(exe)], input=bad + "\n", capture_output=True,
+                              text=True)
+        assert proc.returncode == 1, f"C++ accepted {bad!r}"
+
+
 def test_cpp_rejects_unknown_field(tmp_path):
     exe = _build_harness(tmp_path)
     bad = json.dumps({"url": "http://x", "extra": 1})

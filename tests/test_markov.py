@@ -1,0 +1,65 @@
+"""Markov chain parity tests (reference:
+services/text_generator_service/src/main.rs:13-109 — untested there)."""
+
+import random
+
+from symbiont_tpu.models.markov import MarkovModel
+
+
+def test_untrained_returns_sentinel():
+    # reference: main.rs:84-89
+    assert MarkovModel().generate(10) == "Model not trained."
+
+
+def test_single_word_trains_starter_only():
+    m = MarkovModel()
+    m.train("hello")
+    assert m.starters == ["hello"]
+    assert m.chain == {}
+    assert m.generate(5) == "Model not trained."  # chain empty → sentinel
+
+
+def test_empty_text_noop():
+    m = MarkovModel()
+    m.train("")
+    assert m.starters == [] and m.chain == {}
+
+
+def test_generate_walks_chain():
+    m = MarkovModel()
+    m.train("a b c d")
+    out = m.generate(10, rng=random.Random(0))
+    words = out.split()
+    assert words[0] == "a"
+    # every adjacent pair must be a trained transition
+    for cur, nxt in zip(words, words[1:]):
+        assert nxt in m.chain[cur]
+    assert len(words) <= 10
+
+
+def test_max_length_bounds_output():
+    m = MarkovModel()
+    m.train("x y x y x y")
+    for n in (1, 2, 5):
+        assert len(m.generate(n, rng=random.Random(1)).split()) <= n
+
+
+def test_duplicates_weight_transitions():
+    # transitions are a multiset (reference pushes every occurrence,
+    # main.rs:51-58): "a b" twice + "a c" once → b twice as likely
+    m = MarkovModel()
+    m.train("a b")
+    m.train("a b")
+    m.train("a c")
+    assert sorted(m.chain["a"]) == ["b", "b", "c"]
+    assert m.starters == ["a"]  # deduped
+
+
+def test_incremental_training_and_state_round_trip():
+    m = MarkovModel()
+    m.train("раз два три")  # reference corpus is Russian; unicode must work
+    m.train("четыре пять")
+    state = m.to_state()
+    m2 = MarkovModel.from_state(state)
+    assert m2.chain == m.chain and m2.starters == m.starters
+    assert m2.generate(4, rng=random.Random(2))
