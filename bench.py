@@ -1,0 +1,95 @@
+"""Benchmark: embeddings/sec/chip on the flagship embedding path.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md: "none exist"), so
+vs_baseline is measured, not quoted: the same model on the same chip run the
+reference's way — fixed padding to model max (514-equivalent) in serial
+batches of 8 (reference: embedding_generator.rs:83-91,146) — versus this
+framework's way (length-bucketed static shapes, big batches, bf16). The ratio
+is the design win of SURVEY.md §5.7/§7 on identical hardware.
+
+Extra detail lines go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_sentences(n: int, rng) -> list:
+    """Synthetic corpus with a realistic sentence-length mix (most sentences
+    short, a tail of long ones — what the scraper actually produces)."""
+    words = ["tensor", "processing", "unit", "accelerates", "matrix", "products",
+             "the", "memory", "bandwidth", "of", "embeddings", "semantic",
+             "search", "pipeline", "document", "sentences", "vector", "graph",
+             "tokens", "model", "attention", "masked", "pooling", "batch"]
+    out = []
+    for _ in range(n):
+        ln = int(np.clip(rng.lognormal(2.6, 0.7), 3, 120))
+        out.append(" ".join(rng.choice(words, size=ln)))
+    return out
+
+
+def main() -> None:
+    t_start = time.time()
+    import jax
+
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.device_kind} ({dev.platform})")
+    rng = np.random.default_rng(0)
+    sentences = make_sentences(2048, rng)
+
+    # MiniLM-L6 geometry (BASELINE.md config #1), bf16, synthetic weights —
+    # throughput is weight-value independent.
+    def mk_engine(length_buckets, batch_buckets, max_batch):
+        return TpuEngine(EngineConfig(
+            embedding_dim=384, length_buckets=length_buckets,
+            batch_buckets=batch_buckets, max_batch=max_batch,
+            dtype="bfloat16", data_parallel=False))
+
+    # --- our policy: buckets {64,128}, batches up to 256 ------------------
+    ours = mk_engine([64, 128], [32, 128, 256], 256)
+    ours.embed_texts(sentences)  # warmup: compiles every (bucket, batch) the
+    #                              real run will hit (same plan, same shapes)
+    t0 = time.time()
+    ours.embed_texts(sentences)
+    dt_ours = time.time() - t0
+    eps_ours = len(sentences) / dt_ours
+    log(f"bucketed policy: {len(sentences)} sentences in {dt_ours:.2f}s "
+        f"→ {eps_ours:.0f} emb/s (compiles={ours.stats['compiles']})")
+
+    # --- reference policy: pad-to-512, serial batch 8 ---------------------
+    ref = mk_engine([512], [8], 8)
+    n_ref = 256  # subset; serial 512-padded batches are slow by design
+    ref.embed_texts(sentences[:n_ref])  # warmup, same shapes as timed run
+    t0 = time.time()
+    ref.embed_texts(sentences[:n_ref])
+    dt_ref = time.time() - t0
+    eps_ref = n_ref / dt_ref
+    log(f"reference policy (pad-512, batch 8): {n_ref} sentences in "
+        f"{dt_ref:.2f}s → {eps_ref:.0f} emb/s")
+
+    log(f"total bench time {time.time() - t_start:.0f}s")
+    print(json.dumps({
+        "metric": "embeddings/sec/chip (MiniLM-L6 geometry, bf16, mixed-length corpus)",
+        "value": round(eps_ours, 1),
+        "unit": "embeddings/s",
+        "vs_baseline": round(eps_ours / eps_ref, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

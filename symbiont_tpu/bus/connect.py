@@ -1,0 +1,22 @@
+"""Transport selection by URL scheme."""
+
+from __future__ import annotations
+
+
+async def connect(url: str):
+    """inproc:// → shared in-process bus; symbus://host:port → native broker;
+    nats://host:port → accepted as an alias for symbus (reference-era configs,
+    reference: .env.example NATS_URL) since the wire protocol is ours."""
+    if url.startswith("inproc://"):
+        from symbiont_tpu.bus.inproc import connect_inproc
+
+        return connect_inproc(shared=True)
+    if url.startswith(("symbus://", "nats://")):
+        from symbiont_tpu.bus.tcp import TcpBus
+
+        hostport = url.split("://", 1)[1].rstrip("/")
+        host, _, port = hostport.partition(":")
+        bus = TcpBus(host or "127.0.0.1", int(port or 4233))
+        await bus.connect()
+        return bus
+    raise ValueError(f"unsupported bus url {url!r}")

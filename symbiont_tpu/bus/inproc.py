@@ -1,0 +1,122 @@
+"""In-process asyncio bus.
+
+Semantics match core NATS as the reference uses it (SURVEY.md §1-L3):
+- publish is fire-and-forget, at-most-once, no persistence;
+- plain subscriptions each get every matching message;
+- queue-group subscriptions share: one member per group per message
+  (round-robin) — the scale-out mechanism the reference lacks;
+- request() publishes with a unique inbox reply subject and awaits the first
+  response (the api_service pattern, reference:
+  services/api_service/src/main.rs:309-316).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from symbiont_tpu.bus.core import Msg, Subscription, subject_matches
+from symbiont_tpu.utils.ids import generate_uuid
+
+log = logging.getLogger(__name__)
+
+
+class InprocBus:
+    def __init__(self) -> None:
+        self._subs: List[Subscription] = []
+        self._rr: Dict[tuple, itertools.count] = defaultdict(itertools.count)
+        self._closed = False
+        self.stats = {"published": 0, "delivered": 0, "dropped": 0}
+
+    # ------------------------------------------------------------------ pub
+
+    async def publish(self, subject: str, data: bytes,
+                      reply: Optional[str] = None,
+                      headers: Optional[Dict[str, str]] = None) -> None:
+        if self._closed:
+            raise RuntimeError("bus closed")
+        msg = Msg(subject=subject, data=bytes(data), reply=reply,
+                  headers=dict(headers or {}))
+        self.stats["published"] += 1
+        matching = [s for s in self._subs if subject_matches(s.subject, subject)]
+        # queue groups: pick one member per (pattern, queue) group round-robin
+        groups: Dict[tuple, List[Subscription]] = defaultdict(list)
+        for s in matching:
+            if s.queue:
+                groups[(s.subject, s.queue)].append(s)
+        chosen = set()
+        for gkey, members in groups.items():
+            i = next(self._rr[gkey]) % len(members)
+            chosen.add(id(members[i]))
+        for s in matching:
+            if s.queue and id(s) not in chosen:
+                continue
+            if s._deliver(msg):
+                self.stats["delivered"] += 1
+            else:
+                self.stats["dropped"] += 1
+
+    # ------------------------------------------------------------------ sub
+
+    async def subscribe(self, subject: str, queue: Optional[str] = None,
+                        maxsize: int = 1024) -> Subscription:
+        if self._closed:
+            raise RuntimeError("bus closed")
+        sub = Subscription(subject, queue=queue, maxsize=maxsize)
+        self._subs.append(sub)
+        _orig_close = sub.close
+
+        def close_and_remove() -> None:
+            _orig_close()
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                pass
+
+        sub.close = close_and_remove  # type: ignore[method-assign]
+        return sub
+
+    # -------------------------------------------------------------- request
+
+    async def request(self, subject: str, data: bytes, timeout: float,
+                      headers: Optional[Dict[str, str]] = None) -> Msg:
+        """Inbox request-reply; raises TimeoutError like the reference's
+        tokio timeouts (api_service/src/main.rs:309-349)."""
+        inbox = f"_INBOX.{generate_uuid()}"
+        sub = await self.subscribe(inbox)
+        try:
+            await self.publish(subject, data, reply=inbox, headers=headers)
+            msg = await sub.next(timeout)
+            if msg is None:
+                raise TimeoutError(f"request on {subject!r} timed out after {timeout}s")
+            return msg
+        finally:
+            sub.close()
+
+    async def flush(self) -> None:
+        # give queued deliveries a tick (in-proc delivery is synchronous, so
+        # this is just a scheduling yield for handlers)
+        await asyncio.sleep(0)
+
+    async def close(self) -> None:
+        self._closed = True
+        for s in list(self._subs):
+            s.close()
+        self._subs.clear()
+
+
+_shared: Optional[InprocBus] = None
+
+
+def connect_inproc(shared: bool = True) -> InprocBus:
+    """Shared singleton (one process = one bus, like one NATS server) or a
+    fresh private bus for tests."""
+    global _shared
+    if not shared:
+        return InprocBus()
+    if _shared is None or _shared._closed:
+        _shared = InprocBus()
+    return _shared

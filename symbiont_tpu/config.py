@@ -26,8 +26,10 @@ from typing import Any, List, Optional
 @dataclass
 class BusConfig:
     # reference default: nats://localhost:4222 (services) / nats://cs-nats:4222
-    # (api_service) — reference: services/api_service/src/main.rs:519-524
-    url: str = "symbus://127.0.0.1:4233"
+    # (api_service) — reference: services/api_service/src/main.rs:519-524.
+    # Ours defaults to the in-process bus (single-process stack needs no
+    # broker); set symbus://host:port to go through the native broker.
+    url: str = "inproc://"
     request_timeout_embed_s: float = 15.0  # reference: api_service/src/main.rs:310
     request_timeout_search_s: float = 20.0  # reference: api_service/src/main.rs:430
 

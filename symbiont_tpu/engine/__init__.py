@@ -1,0 +1,22 @@
+"""The TPU engine — the system's compute center.
+
+In the reference, compute hides inside preprocessing_service as a serial
+batch-8 candle loop shared across unbounded spawned tasks (reference:
+services/preprocessing_service/src/main.rs:376, embedding_generator.rs:146-216
+— a documented contention hazard, SURVEY.md §5.2). Here the engine is a
+single-owner component: one process owns the device mesh, all work flows
+through an explicit batching queue, and executables are compiled per
+(length-bucket, batch-bucket) static shape.
+
+text      : cleaning / sentence split / word tokenize (reference parity)
+tokenizer : subword tokenizers (HF tokenizers file, or hash tokenizer for
+            file-free tests and benchmarks)
+bucketing : length buckets + padding (replaces pad-everything-to-514)
+batcher   : async micro-batching queue with deadline flush (latency vs
+            throughput policies over one engine)
+engine    : TpuEngine — embed / rerank / generate over the mesh
+"""
+
+from symbiont_tpu.engine.engine import TpuEngine
+
+__all__ = ["TpuEngine"]

@@ -1,0 +1,111 @@
+"""Async micro-batching queue in front of the engine.
+
+SURVEY.md §7 hard-part #1/#4: the bus delivers one document/query at a time,
+the TPU wants large uniform batches, and the interactive search path (p50
+latency) must not wait behind bulk ingest. Two policies over one engine:
+
+- `MicroBatcher` — aggregates submissions; flushes when `max_batch` items are
+  queued or the oldest item has waited `flush_deadline_ms`. Queries ride in
+  the next flush (small batch, low latency); bulk ingest fills batches.
+- Ingest callers submit whole documents (many sentences at once) and get all
+  vectors back in one future.
+
+The reference's model — spawn a task per message, all contending on one model
+(reference: services/preprocessing_service/src/main.rs:376,425) — is exactly
+what this replaces (SURVEY.md §5.2 hazard).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from symbiont_tpu.engine.engine import TpuEngine
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Pending:
+    texts: List[str]
+    future: asyncio.Future
+
+
+class MicroBatcher:
+    def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
+                 flush_deadline_ms: Optional[float] = None):
+        self.engine = engine
+        self.max_batch = max_batch or engine.config.max_batch
+        self.deadline_s = (flush_deadline_ms
+                           if flush_deadline_ms is not None
+                           else engine.config.flush_deadline_ms) / 1000.0
+        self._queue: List[_Pending] = []
+        self._queued_texts = 0
+        self._wake = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(), name="micro-batcher")
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    async def embed(self, texts: Sequence[str]) -> np.ndarray:
+        """Submit texts; resolves with [n, dim] when their batch flushes."""
+        if self._closed:
+            raise RuntimeError("batcher closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.append(_Pending(list(texts), fut))
+        self._queued_texts += len(texts)
+        self._wake.set()
+        return await fut
+
+    async def _run(self) -> None:
+        while True:
+            if not self._queue:
+                if self._closed:
+                    return
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            if self._queued_texts < self.max_batch and not self._closed:
+                # deadline flush: give late arrivals a short window to batch up
+                try:
+                    await asyncio.wait_for(self._sleep_until_full(), self.deadline_s)
+                except asyncio.TimeoutError:
+                    pass
+            batch, self._queue = self._queue, []
+            self._queued_texts = 0
+            texts: List[str] = []
+            for p in batch:
+                texts.extend(p.texts)
+            try:
+                # off the event loop: the forward is CPU/TPU-bound
+                vecs = await asyncio.get_running_loop().run_in_executor(
+                    None, self.engine.embed_texts, texts)
+                offset = 0
+                for p in batch:
+                    n = len(p.texts)
+                    if not p.future.cancelled():
+                        p.future.set_result(vecs[offset:offset + n])
+                    offset += n
+            except Exception as e:  # propagate to every waiter
+                log.exception("batch embed failed")
+                for p in batch:
+                    if not p.future.cancelled():
+                        p.future.set_exception(e)
+
+    async def _sleep_until_full(self) -> None:
+        while self._queued_texts < self.max_batch and not self._closed:
+            self._wake.clear()
+            await self._wake.wait()

@@ -1,0 +1,75 @@
+"""Length buckets + padding — the replacement for pad-everything-to-max.
+
+The reference pads every sentence to the model's max_position_embeddings (514
+for mpnet) regardless of true length (reference:
+services/preprocessing_service/src/embedding_generator.rs:83-91), so a 6-token
+sentence pays a 514-token forward. SURVEY.md §5.7 sizes that waste at ~10-80×.
+Here each sequence is padded only to the smallest configured bucket ≥ its
+length, and batches are grouped per bucket; batch sizes are likewise bucketed
+so the executable cache stays bounded at |length_buckets|×|batch_buckets|
+entries (the "recompile storm" guard from SURVEY.md §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+
+def choose_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ length; the largest bucket if none (caller truncates)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_to_bucket(
+    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a list of token-id sequences to [n, bucket] ids + mask (int32)."""
+    n = len(seqs)
+    ids = np.full((n, bucket), pad_id, np.int32)
+    mask = np.zeros((n, bucket), np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s[:bucket])
+        ids[i, : len(s)] = s
+        mask[i, : len(s)] = 1
+    return ids, mask
+
+
+def pad_batch_rows(
+    ids: np.ndarray, mask: np.ndarray, batch_bucket: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pad batch dim up to batch_bucket with all-pad rows; returns real count."""
+    n = ids.shape[0]
+    if n == batch_bucket:
+        return ids, mask, n
+    pad_rows = batch_bucket - n
+    ids = np.concatenate([ids, np.tile(ids[-1:], (pad_rows, 1))], axis=0)
+    mask = np.concatenate([mask, np.zeros((pad_rows, mask.shape[1]), np.int32)], axis=0)
+    return ids, mask, n
+
+
+def plan_batches(
+    lengths: Sequence[int],
+    length_buckets: Sequence[int],
+    max_batch: int,
+) -> List[Tuple[int, List[int]]]:
+    """Greedy plan: sort indices by length, group same-bucket runs into batches
+    of ≤ max_batch. Returns [(length_bucket, [original indices]), ...]."""
+    order = sorted(range(len(lengths)), key=lambda i: lengths[i])
+    plans: List[Tuple[int, List[int]]] = []
+    cur_bucket = None
+    cur: List[int] = []
+    for idx in order:
+        b = choose_bucket(lengths[idx], length_buckets)
+        if b != cur_bucket or len(cur) >= max_batch:
+            if cur:
+                plans.append((cur_bucket, cur))
+            cur_bucket, cur = b, []
+        cur.append(idx)
+    if cur:
+        plans.append((cur_bucket, cur))
+    return plans

@@ -1,0 +1,249 @@
+"""TpuEngine — single owner of the device mesh; embed / rerank / generate.
+
+Replaces the reference's EmbeddingGenerator (reference:
+services/preprocessing_service/src/embedding_generator.rs:134-223) and its
+serial batch-8, pad-to-max loop with:
+
+- length-bucketed static shapes (engine/bucketing.py) and a bounded
+  (length-bucket × batch-bucket) executable cache — no recompile storms;
+- data-parallel batches over the mesh 'data' axis (params replicated,
+  batch dim sharded) — the DP row of SURVEY.md §2's parallelism table;
+- a single-owner design: services talk to the engine, never to the device,
+  removing the reference's concurrent-forward contention hazard (§5.2).
+
+The engine is synchronous at this layer; the async micro-batching facade for
+the interactive query path lives in engine/batcher.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from symbiont_tpu.config import EngineConfig
+from symbiont_tpu.engine.bucketing import (
+    choose_bucket,
+    pad_batch_rows,
+    pad_to_bucket,
+    plan_batches,
+)
+from symbiont_tpu.engine.tokenizer import Tokenizer, load_tokenizer
+from symbiont_tpu.models import bert as bert_mod
+from symbiont_tpu.models.bert import BertConfig
+
+log = logging.getLogger(__name__)
+
+
+class TpuEngine:
+    def __init__(
+        self,
+        config: Optional[EngineConfig] = None,
+        mesh=None,
+        params=None,
+        model_cfg: Optional[BertConfig] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        pooling: str = "mean",
+        normalize: bool = False,
+        cross_params=None,
+        cross_cfg: Optional[BertConfig] = None,
+    ):
+        import jax
+
+        self.config = config or EngineConfig()
+        self.mesh = mesh
+        self.pooling = pooling
+        self.normalize = normalize
+
+        if params is None or model_cfg is None:
+            if self.config.model_dir:
+                from symbiont_tpu.models.convert import load_bert_model
+
+                params, model_cfg = load_bert_model(self.config.model_dir)
+                log.info("loaded checkpoint from %s", self.config.model_dir)
+            else:
+                # synthetic mode: random weights at the configured dim — full
+                # pipeline runs with zero model assets (dev / bench / tests)
+                d = self.config.embedding_dim
+                model_cfg = BertConfig(
+                    vocab_size=30000, hidden_size=d,
+                    num_layers=6, num_heads=max(1, d // 64),
+                    intermediate_size=4 * d, max_position_embeddings=512,
+                    dtype=self.config.dtype)
+                params = bert_mod.init_params(jax.random.key(0), model_cfg)
+                log.warning("engine running with RANDOM weights (no model_dir)")
+        if model_cfg.dtype != self.config.dtype:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(model_cfg, dtype=self.config.dtype)
+        self.model_cfg = model_cfg
+        self.tokenizer = tokenizer or load_tokenizer(self.config.model_dir,
+                                                     model_cfg.vocab_size)
+        self.cross_params = cross_params
+        self.cross_cfg = cross_cfg
+
+        self._lock = threading.Lock()  # single-owner: serialize device access
+        self._exec_cache: OrderedDict = OrderedDict()
+
+        self._data_parallel = False
+        if mesh is not None and self.config.data_parallel:
+            if mesh.shape.get("data", 1) > 1:
+                self._data_parallel = True
+        if self._data_parallel:
+            from symbiont_tpu.parallel.sharding import batch_sharding, replicate
+
+            self.params = replicate(mesh, params)
+            self._batch_sharding = batch_sharding(mesh)
+            self._n_data = mesh.shape["data"]
+            if cross_params is not None:
+                self.cross_params = replicate(mesh, cross_params)
+        else:
+            self.params = jax.device_put(params)
+            self._batch_sharding = None
+            self._n_data = 1
+            if cross_params is not None:
+                self.cross_params = jax.device_put(cross_params)
+
+        # stats (SURVEY.md §5.5: the reference has none)
+        self.stats = {"embed_calls": 0, "sentences_embedded": 0,
+                      "rerank_calls": 0, "compiles": 0}
+
+    # ------------------------------------------------------------------ jit
+
+    def _get_executable(self, kind: str, L: int, B: int) -> Callable:
+        import jax
+
+        key = (kind, L, B)
+        with self._lock:
+            if key in self._exec_cache:
+                self._exec_cache.move_to_end(key)
+                return self._exec_cache[key]
+
+        if kind == "embed":
+            cfg, pooling, normalize = self.model_cfg, self.pooling, self.normalize
+
+            def fn(params, ids, mask):
+                return bert_mod.embed_sentences(params, ids, mask, cfg,
+                                                pooling=pooling, normalize=normalize)
+        elif kind == "rerank":
+            ccfg = self.cross_cfg
+
+            def fn(params, ids, mask, types):
+                return bert_mod.cross_encoder_score(params, ids, mask, ccfg, types)
+        else:
+            raise ValueError(kind)
+
+        jitted = jax.jit(fn)
+        with self._lock:
+            self._exec_cache[key] = jitted
+            self.stats["compiles"] += 1
+            while len(self._exec_cache) > self.config.executable_cache_size:
+                self._exec_cache.popitem(last=False)
+        return jitted
+
+    def _device_batch(self, ids: np.ndarray, mask: np.ndarray):
+        import jax.numpy as jnp
+
+        if self._batch_sharding is not None:
+            import jax
+
+            return (jax.device_put(jnp.asarray(ids), self._batch_sharding),
+                    jax.device_put(jnp.asarray(mask), self._batch_sharding))
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    def _batch_bucket(self, n: int) -> int:
+        b = choose_bucket(n, self.config.batch_buckets)
+        if self._n_data > 1:
+            # batch must divide over the data axis
+            b = max(b, self._n_data)
+            b = ((b + self._n_data - 1) // self._n_data) * self._n_data
+        return b
+
+    # ---------------------------------------------------------------- embed
+
+    def embed_texts(self, texts: Sequence[str]) -> np.ndarray:
+        """Texts → [n, hidden] float32 embeddings. Parity surface of the
+        reference's generate_sentence_embeddings (embedding_generator.rs:134)."""
+        if len(texts) == 0:
+            return np.zeros((0, self.model_cfg.hidden_size), np.float32)
+        max_len = min(self.config.length_buckets[-1],
+                      self.model_cfg.max_position_embeddings)
+        encoded = [self.tokenizer.encode(t, max_len) for t in texts]
+        lengths = [len(e) for e in encoded]
+        buckets = [b for b in self.config.length_buckets
+                   if b <= self.model_cfg.max_position_embeddings]
+        out = np.zeros((len(texts), self.model_cfg.hidden_size), np.float32)
+        # two phases: dispatch everything (jax dispatch is async — device
+        # compute and host<->device transfers of successive batches overlap),
+        # then materialize. Serializing np.asarray per batch would pay the
+        # full device round-trip latency once per batch.
+        pending = []
+        for bucket, indices in plan_batches(lengths, buckets, self.config.max_batch):
+            seqs = [encoded[i] for i in indices]
+            ids, mask = pad_to_bucket(seqs, bucket, self.tokenizer.pad_id)
+            bb = self._batch_bucket(len(indices))
+            ids, mask, n_real = pad_batch_rows(ids, mask, bb)
+            fn = self._get_executable("embed", bucket, bb)
+            ids_d, mask_d = self._device_batch(ids, mask)
+            pending.append((indices, n_real, fn(self.params, ids_d, mask_d)))
+        for indices, n_real, res_dev in pending:
+            out[indices] = np.asarray(res_dev)[:n_real]
+        self.stats["embed_calls"] += 1
+        self.stats["sentences_embedded"] += len(texts)
+        return out
+
+    def embed_query(self, text: str) -> np.ndarray:
+        """Single query embedding (the tasks.embedding.for_query path)."""
+        return self.embed_texts([text])[0]
+
+    # --------------------------------------------------------------- rerank
+
+    def rerank(self, query: str, passages: Sequence[str]) -> np.ndarray:
+        """Cross-encoder scores for (query, passage) pairs — BASELINE.md #4."""
+        if self.cross_params is None or self.cross_cfg is None:
+            raise RuntimeError("no cross-encoder model loaded")
+        if len(passages) == 0:
+            return np.zeros((0,), np.float32)
+        max_len = min(self.config.length_buckets[-1],
+                      self.cross_cfg.max_position_embeddings)
+        pairs = [self.tokenizer.encode_pair(query, p, max_len) for p in passages]
+        lengths = [len(ids) for ids, _ in pairs]
+        buckets = [b for b in self.config.length_buckets
+                   if b <= self.cross_cfg.max_position_embeddings]
+        out = np.zeros((len(passages),), np.float32)
+        for bucket, indices in plan_batches(lengths, buckets, self.config.max_batch):
+            ids, mask = pad_to_bucket([pairs[i][0] for i in indices], bucket,
+                                      self.tokenizer.pad_id)
+            types, _ = pad_to_bucket([pairs[i][1] for i in indices], bucket, 0)
+            bb = self._batch_bucket(len(indices))
+            ids, mask, n_real = pad_batch_rows(ids, mask, bb)
+            types = np.concatenate(
+                [types, np.zeros((bb - n_real, bucket), np.int32)], axis=0
+            ) if types.shape[0] < bb else types
+            fn = self._get_executable("rerank", bucket, bb)
+            import jax.numpy as jnp
+
+            ids_d, mask_d = self._device_batch(ids, mask)
+            res = np.asarray(fn(self.cross_params, ids_d, mask_d,
+                                jnp.asarray(types)))[:n_real]
+            out[indices] = res
+        self.stats["rerank_calls"] += 1
+        return out
+
+    # ---------------------------------------------------------------- warm
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               batches: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile the hot (bucket, batch) executables so first queries
+        don't pay the 20-40s TPU compile."""
+        for L in buckets or self.config.length_buckets[:2]:
+            for B in batches or self.config.batch_buckets[:2]:
+                bb = self._batch_bucket(B)
+                ids = np.ones((bb, L), np.int32)
+                mask = np.ones((bb, L), np.int32)
+                fn = self._get_executable("embed", L, bb)
+                ids_d, mask_d = self._device_batch(ids, mask)
+                np.asarray(fn(self.params, ids_d, mask_d))

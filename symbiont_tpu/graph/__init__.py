@@ -1,0 +1,12 @@
+"""Embedded knowledge-graph store (Neo4j-parity semantics, sqlite-backed).
+
+The reference stores graph data in an external Neo4j over Bolt (reference:
+services/knowledge_graph_service/src/main.rs). That path is ORPHANED in
+v0.3.0 — no producer publishes its input subject (SURVEY.md fact #3). Here the
+graph store is embedded in the framework and the producing side is restored
+(preprocessing publishes data.processed_text.tokenized).
+"""
+
+from symbiont_tpu.graph.store import GraphStore
+
+__all__ = ["GraphStore"]

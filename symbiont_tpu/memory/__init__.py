@@ -1,0 +1,14 @@
+"""Vector memory — the Qdrant-parity store, TPU-native.
+
+The reference delegates similarity search to an external Qdrant server over
+gRPC (reference: services/vector_memory_service/src/main.rs:24-119 ensure,
+:121-228 upsert, :230-456 search). Here the store is part of the framework:
+vectors live in a device-resident matrix and search is an MXU matmul + top-k
+(symbiont_tpu/memory/vector_store.py), sharded over the mesh for large
+corpora. Durability is write-ahead-logged on the host (upsert acks after the
+WAL fsync — the reference's wait=true stance, main.rs:196).
+"""
+
+from symbiont_tpu.memory.vector_store import SearchHit, VectorStore
+
+__all__ = ["VectorStore", "SearchHit"]

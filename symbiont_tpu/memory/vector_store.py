@@ -1,0 +1,281 @@
+"""TPU-native vector store: brute-force exact cosine top-k on the MXU.
+
+Design rationale: at the corpus scales the reference system handles (sentences
+of scraped documents), exact search as one [N, D] x [D] matmul on a TPU chip
+beats an ANN index round-trip — no gRPC hop, no graph traversal, exact
+results, and the matmul rides the MXU at bf16. Rows shard over the mesh 'data'
+axis for corpora beyond one chip's HBM (capacity blocks keep shapes static).
+
+API parity with the reference's Qdrant adapter:
+- ensure_collection (dim + cosine at startup):
+  reference vector_memory_service/src/main.rs:24-119
+- upsert(points with uuid ids + QdrantPointPayload-shaped payloads), ack after
+  durable: main.rs:121-228 (wait=true at :196)
+- search(query, top_k) → hits with id, score, payload: main.rs:230-456
+
+Durability: append-only JSONL WAL + optional compacted .npy snapshot;
+load() replays snapshot + WAL tail (SURVEY.md §5.4: DB-as-truth stance kept,
+now inside the framework).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from symbiont_tpu.config import VectorStoreConfig
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SearchHit:
+    id: str
+    score: float
+    payload: dict
+
+
+class VectorStore:
+    def __init__(self, config: Optional[VectorStoreConfig] = None, mesh=None):
+        self.config = config or VectorStoreConfig()
+        self.mesh = mesh
+        self.dim = self.config.dim
+        self._lock = threading.RLock()
+        self._ids: List[str] = []
+        self._id_to_row: Dict[str, int] = {}
+        self._payloads: List[dict] = []
+        self._vectors = np.zeros((0, self.dim), np.float32)  # L2-normalized rows
+        self._device_corpus = None  # padded [capacity_blocks, D] on device
+        self._device_rows = 0  # rows valid in the device copy
+        self._dirty = True
+        self._search_fns: dict = {}
+        self._wal_file = None
+        if self.config.data_dir:
+            Path(self.config.data_dir).mkdir(parents=True, exist_ok=True)
+            self.load()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def ensure_collection(self, dim: Optional[int] = None) -> None:
+        """Validate/establish the collection config (reference: main.rs:24-119).
+
+        Like Qdrant's ensure path this is idempotent; a dim mismatch with
+        existing data is an error rather than silent re-create."""
+        dim = dim or self.config.dim
+        with self._lock:
+            if len(self._ids) and dim != self.dim:
+                raise ValueError(
+                    f"collection '{self.config.collection}' already has dim "
+                    f"{self.dim}, requested {dim}")
+            self.dim = dim
+            if self._vectors.shape[1] != dim:
+                self._vectors = np.zeros((0, dim), np.float32)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+    # -------------------------------------------------------------- upsert
+
+    def upsert(self, points: Sequence[Tuple[str, Sequence[float], dict]]) -> int:
+        """Insert or overwrite points; ack only after the WAL write+flush
+        (the reference's wait=true durability, main.rs:196). Returns count."""
+        if not points:
+            return 0
+        with self._lock:
+            rows = []
+            new_pos: Dict[str, int] = {}  # ids first seen in THIS call — a
+            # duplicate id within one batch (e.g. WAL replay of an update)
+            # must overwrite, not append twice
+            for pid, vec, payload in points:
+                v = np.asarray(vec, np.float32)
+                if v.shape != (self.dim,):
+                    raise ValueError(f"vector dim {v.shape} != collection dim {self.dim}")
+                norm = float(np.linalg.norm(v))
+                v = v / norm if norm > 0 else v
+                if pid in self._id_to_row:
+                    r = self._id_to_row[pid]
+                    self._vectors[r] = v
+                    self._payloads[r] = dict(payload)
+                    self._dirty = True
+                elif pid in new_pos:
+                    rows[new_pos[pid]] = (pid, v, dict(payload))
+                else:
+                    new_pos[pid] = len(rows)
+                    rows.append((pid, v, dict(payload)))
+            if rows:
+                new_vecs = np.stack([v for _, v, _ in rows])
+                base = len(self._ids)
+                self._vectors = (np.concatenate([self._vectors, new_vecs])
+                                 if len(self._vectors) else new_vecs)
+                for i, (pid, _, payload) in enumerate(rows):
+                    self._ids.append(pid)
+                    self._id_to_row[pid] = base + i
+                    self._payloads.append(payload)
+                self._dirty = True
+            self._wal_append(points)
+            return len(points)
+
+    # -------------------------------------------------------------- search
+
+    def _capacity(self, n: int) -> int:
+        """Static capacity: next multiple of shard_capacity (and of the data
+        axis size when sharded) — keeps device shapes stable across growth."""
+        block = self.config.shard_capacity
+        cap = max(block, ((n + block - 1) // block) * block)
+        if self.mesh is not None:
+            nd = self.mesh.shape.get("data", 1)
+            cap = ((cap + nd - 1) // nd) * nd
+        return cap
+
+    def _sync_device(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        n = len(self._ids)
+        if self._device_corpus is not None and not self._dirty and self._device_rows == n:
+            return
+        cap = self._capacity(n)
+        padded = np.zeros((cap, self.dim), np.float32)
+        if n:
+            padded[:n] = self._vectors
+        if self.mesh is not None and self.mesh.shape.get("data", 1) > 1:
+            from symbiont_tpu.parallel.sharding import batch_sharding
+
+            self._device_corpus = jax.device_put(jnp.asarray(padded),
+                                                 batch_sharding(self.mesh))
+        else:
+            self._device_corpus = jnp.asarray(padded)
+        self._device_rows = n
+        self._dirty = False
+
+    def _get_search_fn(self, cap: int, k: int):
+        import jax
+        import jax.numpy as jnp
+
+        key = (cap, k)
+        if key not in self._search_fns:
+            def fn(corpus, query, n_valid):
+                # cosine == dot product (rows and query pre-normalized);
+                # bf16 matmul on the MXU, fp32 scores.
+                q = query.astype(jnp.bfloat16)
+                c = corpus.astype(jnp.bfloat16)
+                scores = (c @ q).astype(jnp.float32)
+                valid = jnp.arange(cap) < n_valid
+                scores = jnp.where(valid, scores, -jnp.inf)
+                return jax.lax.top_k(scores, k)
+
+            self._search_fns[key] = jax.jit(fn)
+        return self._search_fns[key]
+
+    def search(self, query: Sequence[float], top_k: int) -> List[SearchHit]:
+        """Exact cosine top-k (reference search handler: main.rs:230-456)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            n = len(self._ids)
+            if n == 0 or top_k <= 0:
+                return []
+            self._sync_device()
+            cap = self._device_corpus.shape[0]
+            q = np.asarray(query, np.float32)
+            if q.shape != (self.dim,):
+                raise ValueError(f"query dim {q.shape} != collection dim {self.dim}")
+            qn = float(np.linalg.norm(q))
+            q = q / qn if qn > 0 else q
+            # static k bucket (next power of two ≥ k, ≤ cap) bounds executables
+            k_static = 1
+            while k_static < min(top_k, n):
+                k_static *= 2
+            k_static = min(k_static, cap)
+            fn = self._get_search_fn(cap, k_static)
+            scores, idx = fn(self._device_corpus, jnp.asarray(q), n)
+            scores = np.asarray(scores)[:top_k]
+            idx = np.asarray(idx)[:top_k]
+            hits = []
+            for s, i in zip(scores, idx):
+                if not np.isfinite(s):
+                    continue
+                hits.append(SearchHit(id=self._ids[i], score=float(s),
+                                      payload=dict(self._payloads[i])))
+            return hits
+
+    # --------------------------------------------------------- persistence
+
+    def _wal_path(self) -> Optional[Path]:
+        if not self.config.data_dir:
+            return None
+        return Path(self.config.data_dir) / f"{self.config.collection}.wal.jsonl"
+
+    def _wal_append(self, points) -> None:
+        path = self._wal_path()
+        if path is None:
+            return
+        if self._wal_file is None:
+            self._wal_file = open(path, "a", encoding="utf-8")
+        for pid, vec, payload in points:
+            rec = {"id": pid, "vector": np.asarray(vec, np.float32).tolist(),
+                   "payload": payload}
+            self._wal_file.write(json.dumps(rec, ensure_ascii=False) + "\n")
+        self._wal_file.flush()
+        os.fsync(self._wal_file.fileno())
+
+    def compact(self) -> None:
+        """Snapshot vectors+payloads, truncate the WAL."""
+        if not self.config.data_dir:
+            return
+        with self._lock:
+            root = Path(self.config.data_dir)
+            np.save(root / f"{self.config.collection}.vectors.npy", self._vectors)
+            meta = {"dim": self.dim, "ids": self._ids, "payloads": self._payloads}
+            tmp = root / f"{self.config.collection}.meta.json.tmp"
+            tmp.write_text(json.dumps(meta, ensure_ascii=False))
+            tmp.replace(root / f"{self.config.collection}.meta.json")
+            if self._wal_file is not None:
+                self._wal_file.close()
+                self._wal_file = None
+            wal = self._wal_path()
+            if wal and wal.exists():
+                wal.unlink()
+
+    def load(self) -> None:
+        root = Path(self.config.data_dir)
+        meta_p = root / f"{self.config.collection}.meta.json"
+        with self._lock:
+            if meta_p.exists():
+                meta = json.loads(meta_p.read_text())
+                self.dim = meta["dim"]
+                self._ids = list(meta["ids"])
+                self._payloads = list(meta["payloads"])
+                self._vectors = np.load(root / f"{self.config.collection}.vectors.npy")
+                self._id_to_row = {pid: i for i, pid in enumerate(self._ids)}
+            wal = self._wal_path()
+            if wal and wal.exists():
+                replay: List[Tuple[str, list, dict]] = []
+                with open(wal, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                            replay.append((rec["id"], rec["vector"], rec["payload"]))
+                        except (json.JSONDecodeError, KeyError):
+                            log.warning("skipping corrupt WAL line")
+                if replay:
+                    # replay through upsert minus re-logging
+                    wal_file, self._wal_file = self._wal_file, None
+                    data_dir, self.config.data_dir = self.config.data_dir, ""
+                    try:
+                        self.upsert(replay)
+                    finally:
+                        self.config.data_dir = data_dir
+                        self._wal_file = wal_file
+            self._dirty = True

@@ -50,10 +50,9 @@ def load_state_dict(model_dir: str | Path) -> Dict[str, np.ndarray]:
     if idx.exists():
         from safetensors.numpy import load_file
 
-        shards = {json.loads(idx.read_text())["weight_map"][k] for k in
-                  json.loads(idx.read_text())["weight_map"]}
+        weight_map = json.loads(idx.read_text())["weight_map"]
         out: Dict[str, np.ndarray] = {}
-        for shard in sorted(shards):
+        for shard in sorted(set(weight_map.values())):
             out.update(load_file(str(model_dir / shard)))
         return out
     bin_path = model_dir / "pytorch_model.bin"

@@ -63,7 +63,7 @@ class GPTConfig:
                 hidden_size=cfg.get("n_embd", 768),
                 num_layers=cfg.get("n_layer", 12),
                 num_heads=cfg.get("n_head", 12),
-                intermediate_size=4 * cfg.get("n_embd", 768),
+                intermediate_size=cfg.get("n_inner") or 4 * cfg.get("n_embd", 768),
                 max_position_embeddings=cfg.get("n_positions", 1024),
                 layer_norm_eps=cfg.get("layer_norm_epsilon", 1e-5),
                 arch="gpt2",
@@ -139,8 +139,9 @@ def _attn(
     x: jax.Array,  # [B, S, H]
     layer_idx: int,
     cache: KVCache,
-    positions: jax.Array,  # [B, S]
+    positions: jax.Array,  # [B, S] logical positions (RoPE / wpe)
     cfg: GPTConfig,
+    kv_valid: Optional[jax.Array],  # [B, T] True where a cache slot is real
 ) -> tuple[jax.Array, KVCache]:
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -169,10 +170,14 @@ def _attn(
 
     T = k_all.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype)) / math.sqrt(hd)
-    # causal + validity mask over the static cache length
+    # causality runs over CACHE indices (where K/V physically live), not
+    # logical positions — they differ for padded rows; padding slots are
+    # excluded via kv_valid.
     kv_pos = jnp.arange(T)[None, None, None, :]
-    q_pos = positions[:, None, :, None]
-    valid = (kv_pos <= q_pos) & (kv_pos < (start + S))
+    q_cache_pos = (start + jnp.arange(S))[None, None, :, None]
+    valid = (kv_pos <= q_cache_pos) & (kv_pos < (start + S))
+    if kv_valid is not None:
+        valid = valid & kv_valid[:, None, None, :]
     scores = jnp.where(valid, scores.astype(jnp.float32), -1e9)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(x.dtype)).reshape(B, S, H)
@@ -180,10 +185,10 @@ def _attn(
     return out, new_cache
 
 
-def _block(layer, x, layer_idx, cache, positions, cfg):
+def _block(layer, x, layer_idx, cache, positions, cfg, kv_valid):
     if cfg.arch == "gpt2":
         a, cache = _attn(layer, _ln(x, layer["ln1"], cfg.layer_norm_eps),
-                         layer_idx, cache, positions, cfg)
+                         layer_idx, cache, positions, cfg, kv_valid)
         x = x + a
         h = _ln(x, layer["ln2"], cfg.layer_norm_eps)
         h = h @ layer["mlp"]["in"]["kernel"] + layer["mlp"]["in"]["bias"]
@@ -192,7 +197,7 @@ def _block(layer, x, layer_idx, cache, positions, cfg):
         return x + h, cache
     # llama
     a, cache = _attn(layer, _rmsnorm(x, layer["ln1"], cfg.layer_norm_eps),
-                     layer_idx, cache, positions, cfg)
+                     layer_idx, cache, positions, cfg, kv_valid)
     x = x + a
     h = _rmsnorm(x, layer["ln2"], cfg.layer_norm_eps)
     gate = jax.nn.silu(h @ layer["mlp"]["gate"]["kernel"])
@@ -205,10 +210,15 @@ def forward(
     params: Params,
     input_ids: jax.Array,  # [B, S]
     cache: KVCache,
-    positions: jax.Array,  # [B, S] absolute positions of these tokens
+    positions: jax.Array,  # [B, S] absolute logical positions of these tokens
     cfg: GPTConfig,
+    kv_valid: Optional[jax.Array] = None,  # [B, cache_len] mask of real slots
 ) -> tuple[jax.Array, KVCache]:
-    """Forward over S new tokens against the cache → (logits [B, S, V], cache)."""
+    """Forward over S new tokens against the cache → (logits [B, S, V], cache).
+
+    Tokens are written at cache indices [cache.length, cache.length+S); when
+    rows carry left-padding (batched generation), pass kv_valid=False on the
+    padding slots so attention never reads them."""
     dtype = jnp.dtype(cfg.dtype)
     params = jax.tree.map(
         lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params
@@ -217,7 +227,7 @@ def forward(
     if cfg.arch == "gpt2":
         x = x + params["wpe"][positions]
     for i, layer in enumerate(params["layers"]):
-        x, cache = _block(layer, x, i, cache, positions, cfg)
+        x, cache = _block(layer, x, i, cache, positions, cfg, kv_valid)
     if cfg.arch == "gpt2":
         x = _ln(x, params["ln_f"], cfg.layer_norm_eps)
     else:
@@ -257,37 +267,56 @@ def generate(
 ) -> tuple[jax.Array, jax.Array]:
     """Prefill + scan decode. Returns (tokens [B, max_new_tokens], lengths [B]).
 
-    Prompts are prefix-aligned (real tokens first, padding after). Decode
-    continues from each row's true prompt length. Rows stop at eos_id (if ≥0);
-    lengths reports tokens generated before eos.
+    Prompts arrive prefix-aligned (real tokens first, padding after); they are
+    right-aligned internally so every row's last prompt token sits at cache
+    index P-1 and decode steps share cache indices P, P+1, ... across the
+    batch, with left-padding slots masked out of attention via kv_valid.
+    Rows stop at eos_id (if ≥0); lengths counts tokens generated before eos.
     """
     B, P = prompt_ids.shape
     total = P + max_new_tokens
     cache = init_cache(cfg, B, total, jnp.dtype(cfg.dtype))
 
     prompt_len = prompt_mask.astype(jnp.int32).sum(axis=1)  # [B]
-    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-    logits, cache = forward(params, prompt_ids, cache, positions, cfg)
-    cache = cache._replace(length=jnp.asarray(P, jnp.int32))
+    pad = P - prompt_len  # left-pad width per row after alignment
 
-    # logits at each row's last real prompt token
-    last_idx = jnp.maximum(prompt_len - 1, 0)
-    next_logits = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0, :]
+    # right-align: ids_r[b, j] = ids[b, j - pad_b] for j >= pad_b, else 0
+    j = jnp.arange(P, dtype=jnp.int32)[None, :]
+    src = j - pad[:, None]
+    ids_r = jnp.take_along_axis(prompt_ids, jnp.clip(src, 0, P - 1), axis=1)
+    ids_r = jnp.where(src >= 0, ids_r, 0)
+    positions = jnp.maximum(src, 0)  # logical positions; pad slots masked anyway
+
+    # kv_valid over the whole static cache: left-pad slots are never readable,
+    # decode slots become real as they are written (cache-index causality
+    # already hides future slots, so marking them True here is safe).
+    kv_valid = jnp.concatenate(
+        [j >= pad[:, None], jnp.ones((B, max_new_tokens), bool)], axis=1)
+
+    logits, cache = forward(params, ids_r, cache, positions, cfg, kv_valid)
+    cache = cache._replace(length=jnp.asarray(P, jnp.int32))
+    next_logits = logits[:, -1, :]  # last prompt token is at P-1 for every row
 
     def step(carry, step_key):
         cache, cur_logits, cur_pos, done = carry
         tok = _sample(cur_logits, step_key, temperature, top_k)
         tok = jnp.where(done, 0, tok)
-        new_done = done | (tok == eos_id) if eos_id >= 0 else done
-        logits, new_cache = forward(params, tok[:, None], cache, cur_pos[:, None], cfg)
+        if eos_id >= 0:
+            counted = ~done & (tok != eos_id)
+            new_done = done | (tok == eos_id)
+        else:
+            counted = ~done
+            new_done = done
+        logits, new_cache = forward(params, tok[:, None], cache,
+                                    cur_pos[:, None], cfg, kv_valid)
         new_cache = new_cache._replace(length=cache.length + 1)
-        return (new_cache, logits[:, 0, :], cur_pos + 1, new_done), (tok, done)
+        return (new_cache, logits[:, 0, :], cur_pos + 1, new_done), (tok, counted)
 
     keys = jax.random.split(key, max_new_tokens)
     init = (cache, next_logits, prompt_len, jnp.zeros((B,), bool))
-    _, (tokens, was_done) = jax.lax.scan(step, init, keys)
+    _, (tokens, counted) = jax.lax.scan(step, init, keys)
     tokens = tokens.T  # [B, max_new]
-    lengths = (~was_done.T).astype(jnp.int32).sum(axis=1)
+    lengths = counted.T.astype(jnp.int32).sum(axis=1)
     return tokens, lengths
 
 

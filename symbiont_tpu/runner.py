@@ -1,0 +1,109 @@
+"""Single-process runner: bus + engine + all services.
+
+The reference needs docker-compose with 10 containers to run at all
+(reference: docker-compose.yml:1-151); this runner hosts the full pipeline in
+one process over the in-proc bus (or any subset against the native broker via
+config.bus.url). Usage:
+
+    python -m symbiont_tpu.runner            # full stack, config from env
+    SYMBIONT_API_PORT=8080 python -m symbiont_tpu.runner
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from symbiont_tpu.bus import connect
+from symbiont_tpu.config import SymbiontConfig, load_config
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.graph.store import GraphStore
+from symbiont_tpu.memory.vector_store import VectorStore
+from symbiont_tpu.services.api import ApiService
+from symbiont_tpu.services.knowledge_graph import KnowledgeGraphService
+from symbiont_tpu.services.perception import PerceptionService
+from symbiont_tpu.services.preprocessing import PreprocessingService
+from symbiont_tpu.services.text_generator import TextGeneratorService
+from symbiont_tpu.services.vector_memory import VectorMemoryService
+
+log = logging.getLogger(__name__)
+
+
+class SymbiontStack:
+    """Builds and owns the full service stack; also the e2e-test harness."""
+
+    def __init__(self, config: Optional[SymbiontConfig] = None, bus=None,
+                 engine: Optional[TpuEngine] = None, mesh=None,
+                 fetcher=None):
+        self.config = config or load_config()
+        self._bus_override = bus
+        self._engine_override = engine
+        self._mesh = mesh
+        self._fetcher = fetcher
+        self.services: list = []
+        self.bus = None
+        self.engine = None
+        self.vector_store = None
+        self.graph_store = None
+        self.api: Optional[ApiService] = None
+
+    async def start(self) -> None:
+        cfg = self.config
+        self.bus = self._bus_override or await connect(cfg.bus.url)
+        self.engine = self._engine_override or TpuEngine(cfg.engine,
+                                                         mesh=self._mesh)
+        # vector store dim follows the engine's actual hidden size
+        vs_cfg = cfg.vector_store
+        if vs_cfg.dim != self.engine.model_cfg.hidden_size:
+            import dataclasses
+
+            vs_cfg = dataclasses.replace(
+                vs_cfg, dim=self.engine.model_cfg.hidden_size)
+        self.vector_store = VectorStore(vs_cfg, mesh=self._mesh)
+        self.graph_store = GraphStore(cfg.graph_store)
+
+        self.api = ApiService(self.bus, cfg.api, cfg.bus)
+        self.services = [
+            PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher),
+            PreprocessingService(self.bus, self.engine),
+            VectorMemoryService(self.bus, self.vector_store),
+            KnowledgeGraphService(self.bus, self.graph_store),
+            TextGeneratorService(self.bus),
+        ]
+        for s in self.services:
+            await s.start()
+        await self.api.start()
+        log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
+
+    async def stop(self) -> None:
+        if self.api:
+            await self.api.stop()
+        for s in self.services:
+            await s.stop()
+        if self.graph_store:
+            self.graph_store.close()
+        if self.bus and self._bus_override is None:
+            await self.bus.close()
+
+
+async def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    stack = SymbiontStack()
+    await stack.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await stack.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,8 @@
+"""Worker services — the orbit around the TPU engine.
+
+Each reference worker (one Rust binary + NATS loop, SURVEY.md §1-L2) maps to a
+service class here with the same subjects and payloads; the runner
+(symbiont_tpu.runner) hosts any subset in one process over the in-proc bus, or
+each can run against the native broker for multi-process deployments. Native
+C++ counterparts for the bus-and-glue services live under native/.
+"""

@@ -1,0 +1,364 @@
+"""API gateway — HTTP + SSE frontend, bus client behind.
+
+Parity with reference: services/api_service/src/main.rs (§1-L4 contract —
+the reference's Next.js frontend works against this unmodified):
+
+- POST /api/submit-url      → publish tasks.perceive.url (main.rs:42-111)
+- POST /api/generate-text   → validate task_id/max_length 1..=1000, publish
+                              tasks.generation.text (main.rs:113-188)
+- GET  /api/events          → SSE stream of events.text.generated with 15s
+                              keep-alive, drop-on-lag (main.rs:190-270)
+- POST /api/search/semantic → 2-hop request-reply orchestration with 15s/20s
+                              timeouts and the reference's exact status-code /
+                              error-body mapping (main.rs:272-512)
+- CORS on localhost origins (main.rs:555-567)
+
+Additions (SURVEY.md §5.5/§5.3 plans): GET /api/metrics, GET /healthz.
+
+Server: stdlib asyncio HTTP/1.1 — no web framework; this is the Python twin of
+the native C++ gateway under native/.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from symbiont_tpu import subjects
+from symbiont_tpu.config import ApiConfig, BusConfig
+from symbiont_tpu.schema import (
+    GenerateTextTask,
+    QueryEmbeddingResult,
+    QueryForEmbeddingTask,
+    SemanticSearchApiRequest,
+    SemanticSearchApiResponse,
+    SemanticSearchNatsResult,
+    SemanticSearchNatsTask,
+    from_dict,
+    from_json,
+    to_json,
+    to_json_bytes,
+)
+from symbiont_tpu.utils.ids import generate_uuid
+from symbiont_tpu.utils.telemetry import metrics, new_trace_headers, span
+
+log = logging.getLogger(__name__)
+
+import re
+
+# exact host (+optional port): http://localhost.evil.com must NOT match
+_ORIGIN_RE = re.compile(r"^https?://(localhost|127\.0\.0\.1)(:\d+)?$")
+
+
+class _SseHub:
+    """Bounded broadcast: capacity-32 queues, drop-on-lag with a warning
+    (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209)."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._clients: List[asyncio.Queue] = []
+
+    def register(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.capacity)
+        self._clients.append(q)
+        return q
+
+    def unregister(self, q: asyncio.Queue) -> None:
+        if q in self._clients:
+            self._clients.remove(q)
+
+    def broadcast(self, payload: str) -> None:
+        for q in list(self._clients):
+            try:
+                q.put_nowait(payload)
+            except asyncio.QueueFull:
+                metrics.inc("api.sse_dropped")
+                log.warning("SSE client lagged; dropping message")
+
+    def close_all(self) -> None:
+        """Wake every SSE handler with a close sentinel (None) so graceful
+        shutdown doesn't deadlock in Server.wait_closed() behind permanently
+        connected clients."""
+        for q in list(self._clients):
+            try:
+                q.put_nowait(None)
+            except asyncio.QueueFull:
+                try:
+                    q.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+                try:
+                    q.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass
+
+
+class ApiService:
+    name = "api"
+
+    def __init__(self, bus, config: Optional[ApiConfig] = None,
+                 bus_config: Optional[BusConfig] = None):
+        self.bus = bus
+        self.config = config or ApiConfig()
+        self.bus_config = bus_config or BusConfig()
+        self.hub = _SseHub(self.config.sse_channel_capacity)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._bridge_task: Optional[asyncio.Task] = None
+        self._bridge_sub = None
+
+    # ---------------------------------------------------------------- server
+
+    async def start(self) -> None:
+        # NATS→SSE bridge (reference: nats_to_sse_listener, main.rs:215-270)
+        self._bridge_sub = await self.bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+
+        async def bridge() -> None:
+            async for msg in self._bridge_sub:
+                self.hub.broadcast(msg.data.decode("utf-8", errors="replace"))
+
+        self._bridge_task = asyncio.create_task(bridge(), name="sse-bridge")
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.config.host, self.config.port)
+        log.info("api listening on %s:%s", self.config.host, self.config.port)
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        self.hub.close_all()  # unblock SSE handlers before wait_closed
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._bridge_sub:
+            self._bridge_sub.close()
+        if self._bridge_task:
+            self._bridge_task.cancel()
+
+    # ------------------------------------------------------------- plumbing
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                method, path, headers, body = request
+                keep_alive = headers.get("connection", "").lower() != "close"
+                if path == "/api/events" and method == "GET":
+                    await self._serve_sse(writer, headers)
+                    return  # SSE occupies the connection
+                status, payload = await self._route(method, path, headers, body)
+                await self._write_response(writer, status, payload,
+                                           origin=headers.get("origin"),
+                                           keep_alive=keep_alive)
+                if not keep_alive:
+                    break
+        except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
+            pass
+        except Exception:
+            log.exception("connection handler error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+        try:
+            line = await reader.readline()
+        except (ConnectionResetError, asyncio.LimitOverrunError):
+            return None
+        if not line:
+            return None
+        try:
+            method, path, _version = line.decode("latin-1").strip().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            if b":" in h:
+                k, _, v = h.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+        body = b""
+        n = int(headers.get("content-length", 0) or 0)
+        if n:
+            body = await reader.readexactly(n)
+        return method, path.split("?")[0], headers, body
+
+    def _cors(self, origin: Optional[str]) -> str:
+        # reference allows localhost/127.0.0.1 origins (main.rs:555-567)
+        if origin and _ORIGIN_RE.match(origin):
+            return (f"Access-Control-Allow-Origin: {origin}\r\n"
+                    "Access-Control-Allow-Methods: GET, POST, OPTIONS\r\n"
+                    "Access-Control-Allow-Headers: Content-Type\r\n"
+                    "Vary: Origin\r\n")
+        return ""
+
+    async def _write_response(self, writer, status: int, payload: str,
+                              origin: Optional[str] = None,
+                              content_type: str = "application/json",
+                              keep_alive: bool = True) -> None:
+        reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                   405: "Method Not Allowed", 500: "Internal Server Error",
+                   503: "Service Unavailable"}
+        body = payload.encode("utf-8")
+        head = (f"HTTP/1.1 {status} {reasons.get(status, 'OK')}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"{self._cors(origin)}"
+                f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n")
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()
+
+    # --------------------------------------------------------------- routes
+
+    async def _route(self, method: str, path: str, headers: Dict[str, str],
+                     body: bytes) -> Tuple[int, str]:
+        if method == "OPTIONS":
+            return 200, ""
+        try:
+            if path == "/api/submit-url" and method == "POST":
+                metrics.inc("api.POST./api/submit-url")
+                return await self._submit_url(body)
+            if path == "/api/generate-text" and method == "POST":
+                metrics.inc("api.POST./api/generate-text")
+                return await self._generate_text(body)
+            if path == "/api/search/semantic" and method == "POST":
+                metrics.inc("api.POST./api/search/semantic")
+                return await self._semantic_search(body)
+            if path == "/api/metrics" and method == "GET":
+                return 200, json.dumps(metrics.snapshot())
+            if path == "/healthz" and method == "GET":
+                return 200, json.dumps({"status": "ok"})
+            # one bucket for everything unmatched: arbitrary scanner paths
+            # must not create unbounded counter cardinality
+            metrics.inc("api.unmatched")
+            return 404, json.dumps({"message": "not found", "task_id": None})
+        except json.JSONDecodeError as e:
+            return 400, json.dumps({"message": f"invalid JSON: {e}", "task_id": None})
+        except ValueError as e:
+            return 400, json.dumps({"message": str(e), "task_id": None})
+        except Exception:
+            log.exception("route %s failed", path)
+            return 500, json.dumps({"message": "internal error", "task_id": None})
+
+    async def _submit_url(self, body: bytes) -> Tuple[int, str]:
+        data = json.loads(body)
+        url = (data.get("url") or "").strip()
+        if not url:
+            # reference: main.rs:48-53
+            return 400, json.dumps({"message": "URL cannot be empty", "task_id": None})
+        await self.bus.publish(subjects.TASKS_PERCEIVE_URL,
+                               to_json_bytes_url(url),
+                               headers=new_trace_headers())
+        return 200, json.dumps({
+            "message": f"Task to scrape URL '{url}' submitted successfully.",
+            "task_id": None})
+
+    async def _generate_text(self, body: bytes) -> Tuple[int, str]:
+        task = from_dict(GenerateTextTask, json.loads(body))
+        if not task.task_id.strip():
+            # reference: main.rs:125-131
+            return 400, json.dumps({"message": "task_id cannot be empty",
+                                    "task_id": None})
+        if task.max_length == 0 or task.max_length > self.config.max_gen_length:
+            # reference: main.rs:133-142 (bound configurable here)
+            return 400, json.dumps({
+                "message": f"max_length must be between 1 and {self.config.max_gen_length}",
+                "task_id": task.task_id})
+        await self.bus.publish(subjects.TASKS_GENERATION_TEXT,
+                               to_json_bytes(task), headers=new_trace_headers())
+        return 200, json.dumps({
+            "message": f"Text generation task (id: {task.task_id}) submitted successfully.",
+            "task_id": task.task_id})
+
+    async def _semantic_search(self, body: bytes) -> Tuple[int, str]:
+        """2-hop orchestration with the reference's status mapping
+        (main.rs:272-512): bus timeout → 503; service-reported error → 500."""
+        req = from_dict(SemanticSearchApiRequest, json.loads(body))
+        request_id = generate_uuid()
+        trace = new_trace_headers()
+
+        def resp(results, err=None) -> str:
+            return to_json(SemanticSearchApiResponse(
+                search_request_id=request_id, results=results,
+                error_message=err))
+
+        with span("api.search", trace, top_k=req.top_k):
+            embed_task = QueryForEmbeddingTask(request_id=request_id,
+                                               text_to_embed=req.query_text)
+            try:
+                reply = await self.bus.request(
+                    subjects.TASKS_EMBEDDING_FOR_QUERY,
+                    to_json_bytes(embed_task),
+                    timeout=self.bus_config.request_timeout_embed_s,
+                    headers=trace)
+            except TimeoutError as e:
+                return 503, resp([], f"Failed to get embedding from preprocessing service: {e}")
+            embed_result = from_json(QueryEmbeddingResult, reply.data)
+            if embed_result.error_message or embed_result.embedding is None:
+                return 500, resp([], embed_result.error_message
+                                 or "embedding service returned no embedding")
+
+            search_task = SemanticSearchNatsTask(
+                request_id=request_id,
+                query_embedding=embed_result.embedding,
+                top_k=req.top_k)
+            try:
+                reply = await self.bus.request(
+                    subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
+                    to_json_bytes(search_task),
+                    timeout=self.bus_config.request_timeout_search_s,
+                    headers=trace)
+            except TimeoutError as e:
+                return 503, resp([], f"Failed to get search results from vector memory service: {e}")
+            search_result = from_json(SemanticSearchNatsResult, reply.data)
+            if search_result.error_message:
+                return 500, resp([], search_result.error_message)
+            return 200, resp(search_result.results)
+
+    # ------------------------------------------------------------------ SSE
+
+    async def _serve_sse(self, writer, headers: Dict[str, str]) -> None:
+        """SSE with 15s keep-alive comments (reference: main.rs:190-213)."""
+        origin = headers.get("origin")
+        head = ("HTTP/1.1 200 OK\r\n"
+                "Content-Type: text/event-stream\r\n"
+                "Cache-Control: no-cache\r\n"
+                f"{self._cors(origin)}"
+                "Connection: keep-alive\r\n\r\n")
+        writer.write(head.encode("latin-1"))
+        await writer.drain()
+        q = self.hub.register()
+        metrics.inc("api.sse_clients")
+        try:
+            while True:
+                try:
+                    payload = await asyncio.wait_for(
+                        q.get(), timeout=self.config.sse_keepalive_s)
+                    if payload is None:  # close sentinel from stop()
+                        return
+                    for line in payload.splitlines() or [""]:
+                        writer.write(f"data: {line}\n".encode("utf-8"))
+                    writer.write(b"\n")
+                except asyncio.TimeoutError:
+                    writer.write(b": keep-alive\n\n")
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, ConnectionAbortedError):
+            pass
+        finally:
+            self.hub.unregister(q)
+
+
+def to_json_bytes_url(url: str) -> bytes:
+    from symbiont_tpu.schema import PerceiveUrlTask
+
+    return to_json_bytes(PerceiveUrlTask(url=url))

@@ -1,0 +1,81 @@
+"""Service skeleton: the subscribe-dispatch loop every worker shares.
+
+Mirrors the reference's per-service main-loop shape (subscribe →
+`while let Some(msg) = sub.next().await` → spawn handler; e.g. reference:
+services/perception_service/src/main.rs:172-247) with the two flaws fixed
+that SURVEY.md §5.2/§5.3 documents:
+
+- bounded concurrency (semaphore) instead of unbounded tokio::spawn;
+- queue-group subscriptions so replicas shard work instead of duplicating it;
+- handler failures are counted + logged with trace context, never kill the
+  loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional
+
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.utils.telemetry import metrics, span
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Msg], Awaitable[None]]
+
+
+class Service:
+    name = "service"
+
+    def __init__(self, bus, max_concurrency: int = 32):
+        self.bus = bus
+        self._sem = asyncio.Semaphore(max_concurrency)
+        self._tasks: set = set()
+        self._subs: list = []
+        self._loops: list = []
+        self._running = False
+
+    async def start(self) -> None:
+        self._running = True
+        await self._setup()
+
+    async def _setup(self) -> None:  # override: create subscriptions
+        raise NotImplementedError
+
+    async def _subscribe_loop(self, subject: str, handler: Handler,
+                              queue: Optional[str] = None) -> None:
+        sub = await self.bus.subscribe(subject, queue=queue)
+        self._subs.append(sub)
+
+        async def loop() -> None:
+            async for msg in sub:
+                await self._sem.acquire()
+                task = asyncio.create_task(self._run_handler(subject, handler, msg))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+
+        t = asyncio.create_task(loop(), name=f"{self.name}:{subject}")
+        self._loops.append(t)
+
+    async def _run_handler(self, subject: str, handler: Handler, msg: Msg) -> None:
+        try:
+            metrics.inc(f"{self.name}.{subject}.consumed")
+            with span(f"{self.name}.handle", msg.headers, subject=subject):
+                await handler(msg)
+        except Exception:
+            metrics.inc(f"{self.name}.{subject}.failed")
+            log.exception("%s: handler failed for %s", self.name, subject)
+        finally:
+            self._sem.release()
+
+    async def stop(self) -> None:
+        self._running = False
+        for s in self._subs:
+            s.close()
+        for t in self._loops:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._loops.clear()
+        self._subs.clear()

@@ -1,0 +1,43 @@
+"""Knowledge-graph service — consumes the (restored) tokenized stream.
+
+Parity with reference: services/knowledge_graph_service/src/main.rs:142-156
+(handler) and :23-140 (save), over the embedded sqlite graph store instead of
+external Neo4j. In the reference this consumer is orphaned — nothing publishes
+its subject in v0.3.0 (SURVEY.md fact #3); here preprocessing publishes it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.graph.store import GraphStore
+from symbiont_tpu.schema import TokenizedTextMessage, from_json
+from symbiont_tpu.services.base import Service
+from symbiont_tpu.utils.telemetry import metrics, span
+
+log = logging.getLogger(__name__)
+
+
+class KnowledgeGraphService(Service):
+    name = "knowledge_graph"
+
+    def __init__(self, bus, store: GraphStore):
+        super().__init__(bus)
+        self.store = store
+        self.store.ensure_schema()  # retry-at-startup parity (main.rs:253-284)
+
+    async def _setup(self) -> None:
+        await self._subscribe_loop(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                   self._handle_tokenized,
+                                   queue=subjects.QUEUE_KNOWLEDGE_GRAPH)
+
+    async def _handle_tokenized(self, msg: Msg) -> None:
+        m = from_json(TokenizedTextMessage, msg.data)
+        with span("knowledge_graph.save", msg.headers,
+                  sentences=len(m.sentences), tokens=len(m.tokens)):
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.store.save_tokenized, m)
+        metrics.inc("knowledge_graph.documents_saved")

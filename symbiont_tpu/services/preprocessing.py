@@ -1,0 +1,136 @@
+"""Preprocessing service — the engine's bus frontend.
+
+Parity with reference: services/preprocessing_service/src/main.rs, two roles:
+1. pipeline: data.raw_text.discovered → clean/split/embed →
+   data.text.with_embeddings (main.rs:126-171), with errors for empty text
+   (main.rs:33-39);
+2. query embedding request-reply on tasks.embedding.for_query with typed
+   error replies even on bad input (main.rs:173-298).
+
+Plus the deliberate un-orphaning (SURVEY.md fact #3): after embedding, the
+tokenized form is published to data.processed_text.tokenized so the
+knowledge-graph path is live again (the reference's CHANGELOG.md:57-60 left it
+dead).
+
+Embedding runs through the MicroBatcher — queries and bulk ingest share the
+engine without the reference's concurrent-forward hazard (§5.2).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.engine.batcher import MicroBatcher
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.engine.text import clean_text, split_sentences, tokenize_words
+from symbiont_tpu.schema import (
+    QueryEmbeddingResult,
+    QueryForEmbeddingTask,
+    RawTextMessage,
+    SentenceEmbedding,
+    TextWithEmbeddingsMessage,
+    TokenizedTextMessage,
+    from_json,
+    to_json_bytes,
+)
+from symbiont_tpu.services.base import Service
+from symbiont_tpu.utils.ids import current_timestamp_ms
+from symbiont_tpu.utils.telemetry import child_headers, metrics
+
+log = logging.getLogger(__name__)
+
+
+class PreprocessingService(Service):
+    name = "preprocessing"
+
+    def __init__(self, bus, engine: TpuEngine,
+                 batcher: Optional[MicroBatcher] = None,
+                 publish_tokenized: bool = True):
+        super().__init__(bus)
+        self.engine = engine
+        self.batcher = batcher or MicroBatcher(engine)
+        self.publish_tokenized = publish_tokenized
+        self.model_name = engine.config.model_name
+
+    async def start(self) -> None:
+        await self.batcher.start()
+        await super().start()
+
+    async def stop(self) -> None:
+        await super().stop()
+        await self.batcher.close()
+
+    async def _setup(self) -> None:
+        await self._subscribe_loop(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                   self._handle_raw_text,
+                                   queue=subjects.QUEUE_PREPROCESSING)
+        await self._subscribe_loop(subjects.TASKS_EMBEDDING_FOR_QUERY,
+                                   self._handle_query_embedding,
+                                   queue=subjects.QUEUE_PREPROCESSING)
+
+    # ------------------------------------------------------------- pipeline
+
+    async def _handle_raw_text(self, msg: Msg) -> None:
+        raw = from_json(RawTextMessage, msg.data)
+        cleaned = clean_text(raw.raw_text)
+        if not cleaned:
+            metrics.inc("preprocessing.empty_text")
+            log.warning("cleaned text empty for id %s", raw.id)
+            return
+        sentences = split_sentences(cleaned)
+        vectors = await self.batcher.embed(sentences)
+        out = TextWithEmbeddingsMessage(
+            original_id=raw.id,
+            source_url=raw.source_url,
+            embeddings_data=[
+                SentenceEmbedding(sentence_text=s, embedding=[float(x) for x in v])
+                for s, v in zip(sentences, vectors)
+            ],
+            model_name=self.model_name,
+            timestamp_ms=current_timestamp_ms(),
+        )
+        headers = child_headers(msg.headers)
+        await self.bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS,
+                               to_json_bytes(out), headers=headers)
+        metrics.inc("preprocessing.embedded_docs")
+        metrics.inc("preprocessing.embedded_sentences", len(sentences))
+        if self.publish_tokenized:
+            tok = TokenizedTextMessage(
+                original_id=raw.id, source_url=raw.source_url,
+                tokens=tokenize_words(cleaned), sentences=sentences,
+                timestamp_ms=current_timestamp_ms())
+            await self.bus.publish(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                   to_json_bytes(tok), headers=headers)
+
+    # ------------------------------------------------------ query embedding
+
+    async def _handle_query_embedding(self, msg: Msg) -> None:
+        if not msg.reply:
+            log.warning("query-embedding task without reply inbox")
+            return
+        try:
+            task = from_json(QueryForEmbeddingTask, msg.data)
+        except Exception as e:
+            # typed error reply even on deserialize failure (main.rs:183-196)
+            err = QueryEmbeddingResult(request_id="unknown", embedding=None,
+                                       model_name=None,
+                                       error_message=f"bad request: {e}")
+            await self.bus.publish(msg.reply, to_json_bytes(err))
+            return
+        try:
+            vecs = await self.batcher.embed([task.text_to_embed])
+            result = QueryEmbeddingResult(
+                request_id=task.request_id,
+                embedding=[float(x) for x in vecs[0]],
+                model_name=self.model_name, error_message=None)
+        except Exception as e:
+            log.exception("query embedding failed")
+            result = QueryEmbeddingResult(request_id=task.request_id,
+                                          embedding=None, model_name=None,
+                                          error_message=str(e))
+        await self.bus.publish(msg.reply, to_json_bytes(result),
+                               headers=child_headers(msg.headers))
+        metrics.inc("preprocessing.query_embeddings")

@@ -1,0 +1,83 @@
+"""Text-generator service.
+
+Parity with reference: services/text_generator_service/src/main.rs:111-162:
+consumes GenerateTextTask, generates, publishes GeneratedTextMessage to
+events.text.generated. Two backends:
+
+- Markov (default, reference parity) — but trained continuously on every
+  ingested document (the reference trains once on one hardcoded sentence and
+  ignores the prompt, main.rs:120-123,169-174);
+- TPU LM (optional, BASELINE.md config #5): decoder LM via models/gpt with
+  the prompt actually used.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.models.markov import MarkovModel
+from symbiont_tpu.schema import (
+    GeneratedTextMessage,
+    GenerateTextTask,
+    RawTextMessage,
+    from_json,
+    to_json_bytes,
+)
+from symbiont_tpu.services.base import Service
+from symbiont_tpu.utils.ids import current_timestamp_ms
+from symbiont_tpu.utils.telemetry import child_headers, metrics, span
+
+log = logging.getLogger(__name__)
+
+# the reference's single hardcoded training sentence (main.rs:170) — kept as
+# the cold-start corpus so an empty system still generates
+SEED_CORPUS = (
+    "Это первое предложение для обучения нашей марковской модели оно простое"
+)
+
+
+class TextGeneratorService(Service):
+    name = "text_generator"
+
+    def __init__(self, bus, lm_generate=None, train_on_ingest: bool = True):
+        super().__init__(bus)
+        self.markov = MarkovModel()
+        self.markov.train(SEED_CORPUS)
+        self.lm_generate = lm_generate  # Callable[[str, int], str] | None
+        self.train_on_ingest = train_on_ingest
+
+    async def _setup(self) -> None:
+        await self._subscribe_loop(subjects.TASKS_GENERATION_TEXT,
+                                   self._handle_generate,
+                                   queue=subjects.QUEUE_TEXT_GENERATOR)
+        if self.train_on_ingest:
+            # continuous learning from the pipeline (no queue group: every
+            # generator replica learns the full stream)
+            await self._subscribe_loop(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                       self._handle_train)
+
+    async def _handle_train(self, msg: Msg) -> None:
+        raw = from_json(RawTextMessage, msg.data)
+        self.markov.train(raw.raw_text)
+        metrics.inc("text_generator.trained_docs")
+
+    async def _handle_generate(self, msg: Msg) -> None:
+        task = from_json(GenerateTextTask, msg.data)
+        with span("text_generator.generate", msg.headers,
+                  max_length=task.max_length):
+            if self.lm_generate is not None:
+                text = await asyncio.get_running_loop().run_in_executor(
+                    None, self.lm_generate, task.prompt or "", task.max_length)
+            else:
+                text = self.markov.generate(task.max_length)
+        out = GeneratedTextMessage(original_task_id=task.task_id,
+                                   generated_text=text,
+                                   timestamp_ms=current_timestamp_ms())
+        await self.bus.publish(subjects.EVENTS_TEXT_GENERATED,
+                               to_json_bytes(out),
+                               headers=child_headers(msg.headers))
+        metrics.inc("text_generator.generated")

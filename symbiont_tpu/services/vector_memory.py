@@ -1,0 +1,99 @@
+"""Vector-memory service — bus adapter over the TPU-native vector store.
+
+Parity with reference: services/vector_memory_service/src/main.rs:
+- startup ensure_collection (main.rs:24-119);
+- data.text.with_embeddings → one point per sentence, uuid ids, 6-field
+  QdrantPointPayload (main.rs:121-228), ack-after-durable (wait=true, :196);
+- tasks.search.semantic.request request-reply with typed error replies
+  (main.rs:230-456).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.memory.vector_store import VectorStore
+from symbiont_tpu.schema import (
+    QdrantPointPayload,
+    SemanticSearchNatsResult,
+    SemanticSearchNatsTask,
+    SemanticSearchResultItem,
+    TextWithEmbeddingsMessage,
+    from_json,
+    to_json_bytes,
+)
+from symbiont_tpu.services.base import Service
+from symbiont_tpu.utils.ids import current_timestamp_ms, generate_uuid
+from symbiont_tpu.utils.telemetry import child_headers, metrics, span
+
+log = logging.getLogger(__name__)
+
+
+class VectorMemoryService(Service):
+    name = "vector_memory"
+
+    def __init__(self, bus, store: VectorStore):
+        super().__init__(bus)
+        self.store = store
+        self.store.ensure_collection()
+
+    async def _setup(self) -> None:
+        await self._subscribe_loop(subjects.DATA_TEXT_WITH_EMBEDDINGS,
+                                   self._handle_upsert,
+                                   queue=subjects.QUEUE_VECTOR_MEMORY)
+        await self._subscribe_loop(subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
+                                   self._handle_search,
+                                   queue=subjects.QUEUE_VECTOR_MEMORY)
+
+    async def _handle_upsert(self, msg: Msg) -> None:
+        m = from_json(TextWithEmbeddingsMessage, msg.data)
+        now = current_timestamp_ms()
+        points = []
+        for order, se in enumerate(m.embeddings_data):
+            payload = QdrantPointPayload(
+                original_document_id=m.original_id,
+                source_url=m.source_url,
+                sentence_text=se.sentence_text,
+                sentence_order=order,
+                model_name=m.model_name,
+                processed_at_ms=now,
+            )
+            points.append((generate_uuid(), se.embedding,
+                           dataclasses.asdict(payload)))
+        with span("vector_memory.upsert", msg.headers, points=len(points)):
+            n = self.store.upsert(points)
+        metrics.inc("vector_memory.points_upserted", n)
+
+    async def _handle_search(self, msg: Msg) -> None:
+        if not msg.reply:
+            log.warning("search task without reply inbox")
+            return
+        try:
+            task = from_json(SemanticSearchNatsTask, msg.data)
+        except Exception as e:
+            err = SemanticSearchNatsResult(request_id="unknown", results=[],
+                                           error_message=f"bad request: {e}")
+            await self.bus.publish(msg.reply, to_json_bytes(err))
+            return
+        try:
+            with span("vector_memory.search", msg.headers, top_k=task.top_k):
+                hits = self.store.search(task.query_embedding, task.top_k)
+            results = [
+                SemanticSearchResultItem(
+                    qdrant_point_id=h.id, score=h.score,
+                    payload=QdrantPointPayload(**h.payload))
+                for h in hits
+            ]
+            result = SemanticSearchNatsResult(request_id=task.request_id,
+                                              results=results, error_message=None)
+        except Exception as e:
+            log.exception("search failed")
+            result = SemanticSearchNatsResult(request_id=task.request_id,
+                                              results=[], error_message=str(e))
+        await self.bus.publish(msg.reply, to_json_bytes(result),
+                               headers=child_headers(msg.headers))
+        metrics.inc("vector_memory.searches")

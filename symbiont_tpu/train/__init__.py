@@ -1,0 +1,25 @@
+"""Training — a capability the reference lacks entirely (SURVEY.md §5.4: "no
+model training, so no checkpoints"; its only 'learning' is the Markov chain
+rebuilt from one hardcoded sentence each boot).
+
+trainer    : sharded train steps — contrastive (InfoNCE, in-batch negatives)
+             fine-tuning for the embedding models, and next-token CE for the
+             decoder LMs — jitted over the mesh with DP batch sharding and
+             (for LMs) megatron TP param sharding
+checkpoint : params/opt-state persistence so engine restarts skip
+             reconversion (SURVEY.md §5.4 plan)
+"""
+
+from symbiont_tpu.train.trainer import (
+    contrastive_train_step,
+    lm_train_step,
+    make_embedder_train_state,
+    make_lm_train_state,
+)
+
+__all__ = [
+    "contrastive_train_step",
+    "lm_train_step",
+    "make_embedder_train_state",
+    "make_lm_train_state",
+]

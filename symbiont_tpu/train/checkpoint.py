@@ -1,0 +1,76 @@
+"""Parameter/state checkpointing (SURVEY.md §5.4 plan).
+
+The reference re-downloads + re-converts model weights at every preprocessing
+service boot (reference: embedding_generator.rs:25-58) and rebuilds its Markov
+state from a constant (text_generator_service/src/main.rs:169-173). Here
+converted JAX params are saved once and memory-mapped back on restart, and the
+Markov state persists via its to_state/from_state hooks.
+
+Format: a directory with a flat .npz of leaves + a JSON treedef — dependency-
+free and mmap-friendly. (orbax is available in the image; this avoids its
+async machinery for what is a cold-path save/restore.)
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+Params = Any
+
+_SEP = "\x1f"  # unit separator — safe key joiner
+
+
+def _flatten(tree: Params, prefix: str = "") -> dict:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}{_SEP}"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}#{i}{_SEP}"))
+    else:
+        out[prefix.rstrip(_SEP)] = np.asarray(tree)
+    return out
+
+
+def _shape_of(tree: Params) -> Any:
+    if isinstance(tree, dict):
+        return {k: _shape_of(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_shape_of(v) for v in tree]
+    return None  # leaf marker
+
+
+def _unflatten(shape: Any, flat: dict, prefix: str = "") -> Params:
+    if isinstance(shape, dict):
+        return {k: _unflatten(v, flat, f"{prefix}{k}{_SEP}") for k, v in shape.items()}
+    if isinstance(shape, list):
+        return [_unflatten(v, flat, f"{prefix}#{i}{_SEP}")
+                for i, v in enumerate(shape)]
+    return flat[prefix.rstrip(_SEP)]
+
+
+def save_params(path: str | Path, params: Params, meta: Optional[dict] = None) -> None:
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(params)
+    np.savez(path / "params.npz", **flat)
+    (path / "tree.json").write_text(json.dumps(
+        {"tree": _shape_of(params), "meta": meta or {}}))
+
+
+def load_params(path: str | Path) -> tuple[Params, dict]:
+    path = Path(path)
+    spec = json.loads((path / "tree.json").read_text())
+    with np.load(path / "params.npz") as npz:
+        flat = {k: npz[k] for k in npz.files}
+    return _unflatten(spec["tree"], flat), spec.get("meta", {})
+
+
+def exists(path: str | Path) -> bool:
+    path = Path(path)
+    return (path / "params.npz").exists() and (path / "tree.json").exists()

@@ -1,0 +1,141 @@
+"""Sharded training steps.
+
+Pure-function design: a TrainState pytree (params, opt_state, step) and a
+step function `(state, batch, key) -> (state, metrics)`; sharding is applied
+by placing the state/batch on the mesh (DP batch axis, TP param shards for
+LMs) and jitting — XLA inserts the gradient psums (scaling-book recipe; no
+hand-written collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from symbiont_tpu.models import bert as bert_mod
+from symbiont_tpu.models import gpt as gpt_mod
+
+Params = Any
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def _adamw(learning_rate: float, weight_decay: float = 0.01):
+    return optax.adamw(learning_rate, weight_decay=weight_decay)
+
+
+# ---------------------------------------------------------------- embedder
+
+
+def make_embedder_train_state(params: Params, learning_rate: float = 1e-4
+                              ) -> Tuple[TrainState, optax.GradientTransformation]:
+    tx = _adamw(learning_rate)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
+
+
+def contrastive_loss(params: Params, batch: dict, cfg: bert_mod.BertConfig,
+                     temperature: float = 0.05) -> jax.Array:
+    """InfoNCE with in-batch negatives over (query, positive) pairs —
+    the standard sentence-embedding fine-tune (bge/e5 recipe)."""
+    q = bert_mod.embed_sentences(params, batch["q_ids"], batch["q_mask"], cfg,
+                                 normalize=True)
+    p = bert_mod.embed_sentences(params, batch["p_ids"], batch["p_mask"], cfg,
+                                 normalize=True)
+    logits = (q @ p.T) / temperature  # [B, B]
+    labels = jnp.arange(q.shape[0])
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+@partial(jax.jit, static_argnames=("cfg", "tx"), donate_argnums=(0,))
+def contrastive_train_step(state: TrainState, batch: dict, cfg, tx
+                           ) -> Tuple[TrainState, dict]:
+    loss, grads = jax.value_and_grad(contrastive_loss)(state.params, batch, cfg)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    gnorm = optax.global_norm(grads)
+    return (TrainState(params, opt_state, state.step + 1),
+            {"loss": loss, "grad_norm": gnorm})
+
+
+# ---------------------------------------------------------------------- lm
+
+
+def make_lm_train_state(params: Params, learning_rate: float = 3e-4
+                        ) -> Tuple[TrainState, optax.GradientTransformation]:
+    tx = _adamw(learning_rate)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
+
+
+def lm_loss(params: Params, batch: dict, cfg: gpt_mod.GPTConfig) -> jax.Array:
+    """Next-token cross-entropy over [B, S] token batches (mask-weighted)."""
+    ids = batch["ids"]  # [B, S]
+    mask = batch["mask"].astype(jnp.float32)  # [B, S]
+    B, S = ids.shape
+    cache = gpt_mod.init_cache(cfg, B, S, jnp.dtype(cfg.dtype))
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    logits, _ = gpt_mod.forward(params, ids, cache, positions, cfg)
+    targets = ids[:, 1:]
+    w = mask[:, 1:] * mask[:, :-1]
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+@partial(jax.jit, static_argnames=("cfg", "tx"), donate_argnums=(0,))
+def lm_train_step(state: TrainState, batch: dict, cfg, tx
+                  ) -> Tuple[TrainState, dict]:
+    loss, grads = jax.value_and_grad(lm_loss)(state.params, batch, cfg)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return (TrainState(params, opt_state, state.step + 1),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)})
+
+
+# ------------------------------------------------------------- sharded lm
+
+
+def shard_lm_train_state(mesh, state: TrainState, arch: str) -> TrainState:
+    """Place a TrainState on the mesh: params per the megatron TP spec
+    (symbiont_tpu.parallel.sharding), opt-state mirrors params, step
+    replicated. The batch goes on the 'data' axis (caller)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbiont_tpu.parallel.sharding import gpt_param_sharding
+
+    spec = gpt_param_sharding(mesh, state.params, arch=arch)
+
+    def put(tree, spec_tree):
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            tree, spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    params = put(state.params, spec)
+    # adamw state: (ScaleByAdamState(count, mu, nu), wd, ...) — mu/nu mirror
+    # the param tree; count and scalars replicate.
+    def put_opt(x):
+        if isinstance(x, (jnp.ndarray, jax.Array)) and x.ndim == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        return x
+
+    opt_state = jax.tree.map(put_opt, state.opt_state)
+    # mu/nu subtrees share the param structure; re-place them with the spec
+    import optax as _optax
+
+    def reshard_like_params(os):
+        if isinstance(os, _optax.ScaleByAdamState):
+            return _optax.ScaleByAdamState(
+                count=jax.device_put(os.count, NamedSharding(mesh, P())),
+                mu=put(os.mu, spec), nu=put(os.nu, spec))
+        return os
+
+    opt_state = tuple(reshard_like_params(os) for os in opt_state)
+    step = jax.device_put(state.step, NamedSharding(mesh, P()))
+    return TrainState(params, opt_state, step)

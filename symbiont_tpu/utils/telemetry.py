@@ -1,0 +1,121 @@
+"""Tracing + metrics — the observability layer the reference lacks.
+
+Reference state (SURVEY.md §5.1/§5.5): bare env_logger lines with bracket tags,
+ids carried only inside payloads, NATS monitoring port exposed but unscraped,
+zero counters. Here:
+
+- Trace: every message carries trace/span ids in bus headers
+  (X-Trace-Id/X-Span-Id); `child_headers` propagates across hops; `span`
+  times a handler and logs a structured line.
+- Metrics: process-global registry of counters and histograms (p50/p95/p99),
+  rendered as JSON (api /api/metrics) — these produce the BASELINE.md numbers
+  (per-subject consumed/published/failed, embed throughput, search latency).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from symbiont_tpu.utils.ids import generate_uuid
+
+log = logging.getLogger("symbiont.trace")
+
+TRACE_HEADER = "X-Trace-Id"
+SPAN_HEADER = "X-Span-Id"
+
+
+def new_trace_headers() -> Dict[str, str]:
+    return {TRACE_HEADER: generate_uuid(), SPAN_HEADER: generate_uuid()}
+
+
+def child_headers(parent: Optional[Dict[str, str]]) -> Dict[str, str]:
+    """Same trace, fresh span; starts a new trace when no parent context."""
+    if not parent or TRACE_HEADER not in parent:
+        return new_trace_headers()
+    return {TRACE_HEADER: parent[TRACE_HEADER], SPAN_HEADER: generate_uuid()}
+
+
+@contextmanager
+def span(name: str, headers: Optional[Dict[str, str]] = None, **fields):
+    """Timed span with structured log line (duration_ms, trace id, extras)."""
+    t0 = time.perf_counter()
+    trace_id = (headers or {}).get(TRACE_HEADER, "-")
+    try:
+        yield
+        status = "ok"
+    except Exception:
+        status = "error"
+        raise
+    finally:
+        dur_ms = (time.perf_counter() - t0) * 1000
+        metrics.observe(f"span.{name}.ms", dur_ms)
+        log.info(json.dumps({"span": name, "trace": trace_id, "status": status,
+                             "duration_ms": round(dur_ms, 3), **fields},
+                            ensure_ascii=False))
+
+
+class _Histogram:
+    __slots__ = ("values", "count", "total")
+
+    def __init__(self) -> None:
+        self.values: list = []  # sorted reservoir (bounded)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        bisect.insort(self.values, v)
+        if len(self.values) > 4096:
+            # drop alternating samples to stay bounded but keep the shape
+            del self.values[::2]
+
+    def quantile(self, q: float) -> float:
+        if not self.values:
+            return 0.0
+        idx = min(len(self.values) - 1, int(q * len(self.values)))
+        return self.values[idx]
+
+    def summary(self) -> dict:
+        return {"count": self.count,
+                "mean": self.total / self.count if self.count else 0.0,
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._hists: Dict[str, _Histogram] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists.setdefault(name, _Histogram()).observe(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "histograms": {k: h.summary() for k, h in self._hists.items()}}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+
+
+metrics = Metrics()

@@ -1,0 +1,122 @@
+"""In-proc bus semantics: pub/sub, queue groups, wildcards, request-reply."""
+
+import asyncio
+
+import pytest
+
+from symbiont_tpu.bus.core import subject_matches
+from symbiont_tpu.bus.inproc import InprocBus
+
+
+def test_subject_matching():
+    assert subject_matches("a.b.c", "a.b.c")
+    assert not subject_matches("a.b.c", "a.b")
+    assert not subject_matches("a.b", "a.b.c")
+    assert subject_matches("a.*.c", "a.x.c")
+    assert not subject_matches("a.*.c", "a.x.y")
+    assert subject_matches("a.>", "a.b.c")
+    assert subject_matches("a.>", "a.b")
+    assert not subject_matches("a.>", "a")
+    assert not subject_matches("x.>", "a.b")
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_pub_sub_fanout():
+    async def main():
+        bus = InprocBus()
+        s1 = await bus.subscribe("t.x")
+        s2 = await bus.subscribe("t.x")
+        await bus.publish("t.x", b"hello")
+        m1 = await s1.next(1)
+        m2 = await s2.next(1)
+        assert m1.data == m2.data == b"hello"
+        await bus.close()
+
+    _run(main())
+
+
+def test_queue_group_delivers_to_one_member():
+    async def main():
+        bus = InprocBus()
+        a = await bus.subscribe("work", queue="g")
+        b = await bus.subscribe("work", queue="g")
+        plain = await bus.subscribe("work")
+        for i in range(10):
+            await bus.publish("work", str(i).encode())
+        got_a = got_b = 0
+        for _ in range(10):
+            if await a.next(0.01):
+                got_a += 1
+        # drain b
+        while await b.next(0.01):
+            got_b += 1
+        got_plain = 0
+        while await plain.next(0.01):
+            got_plain += 1
+        assert got_a + got_b == 10  # shared exactly once
+        assert got_a == 5 and got_b == 5  # round-robin
+        assert got_plain == 10  # plain sub still sees everything
+        await bus.close()
+
+    _run(main())
+
+
+def test_request_reply_and_timeout():
+    async def main():
+        bus = InprocBus()
+        sub = await bus.subscribe("svc.echo")
+
+        async def responder():
+            msg = await sub.next(2)
+            await bus.publish(msg.reply, b"pong:" + msg.data)
+
+        task = asyncio.create_task(responder())
+        reply = await bus.request("svc.echo", b"ping", timeout=2)
+        assert reply.data == b"pong:ping"
+        await task
+        with pytest.raises(TimeoutError):
+            await bus.request("svc.nobody", b"x", timeout=0.05)
+        await bus.close()
+
+    _run(main())
+
+
+def test_headers_propagate():
+    async def main():
+        bus = InprocBus()
+        sub = await bus.subscribe("h.test")
+        await bus.publish("h.test", b"x", headers={"X-Trace-Id": "t-123"})
+        msg = await sub.next(1)
+        assert msg.headers["X-Trace-Id"] == "t-123"
+        await bus.close()
+
+    _run(main())
+
+
+def test_slow_consumer_drops_not_blocks():
+    async def main():
+        bus = InprocBus()
+        sub = await bus.subscribe("flood", maxsize=4)
+        for i in range(10):
+            await bus.publish("flood", str(i).encode())
+        assert bus.stats["dropped"] == 6
+        got = 0
+        while await sub.next(0.01):
+            got += 1
+        assert got == 4
+        await bus.close()
+
+    _run(main())
+
+
+def test_publish_after_close_raises():
+    async def main():
+        bus = InprocBus()
+        await bus.close()
+        with pytest.raises(RuntimeError):
+            await bus.publish("x", b"y")
+
+    _run(main())

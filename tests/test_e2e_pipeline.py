@@ -1,0 +1,245 @@
+"""End-to-end pipeline tests over the in-proc bus + real HTTP/SSE surface.
+
+The integration tier the reference never had (SURVEY.md §4: "the implicit
+integration test is manual docker-compose + curl"). Covers the three call
+stacks of SURVEY.md §3: ingest (3.1), search (3.2), generate→SSE (3.3), plus
+the restored knowledge-graph path (3.5).
+"""
+
+import asyncio
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from symbiont_tpu.bus.inproc import InprocBus
+from symbiont_tpu.config import (
+    ApiConfig,
+    EngineConfig,
+    GraphStoreConfig,
+    SymbiontConfig,
+    VectorStoreConfig,
+)
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.runner import SymbiontStack
+
+FAKE_PAGES = {
+    "http://example.com/doc1": """
+      <html><body><article>
+        <h1>Symbiont systems</h1>
+        <p>TPUs accelerate matrix multiplication. They excel at embeddings!</p>
+        <p>Vector memory stores every sentence.</p>
+      </article></body></html>""",
+    "http://example.com/doc2": """
+      <html><body><main>
+        <p>Knowledge graphs link tokens to documents. Search finds meaning?</p>
+      </main></body></html>""",
+}
+
+
+def _fake_fetcher(url: str) -> str:
+    if url in FAKE_PAGES:
+        return FAKE_PAGES[url]
+    raise OSError(f"unreachable {url}")
+
+
+@pytest.fixture()
+def stack_config(tmp_path):
+    return SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
+                            batch_buckets=[2, 8], max_batch=8, dtype="float32",
+                            data_parallel=False, flush_deadline_ms=2.0),
+        vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
+    )
+
+
+async def _start_stack(stack_config):
+    stack = SymbiontStack(stack_config, bus=InprocBus(), fetcher=_fake_fetcher)
+    await stack.start()
+    return stack
+
+
+def _http(method, port, path, body=None, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+async def _wait_until(pred, timeout=15.0):
+    t = 0.0
+    while t < timeout:
+        if pred():
+            return True
+        await asyncio.sleep(0.05)
+        t += 0.05
+    return False
+
+
+def test_ingest_search_generate_roundtrip(stack_config):
+    async def scenario():
+        stack = await _start_stack(stack_config)
+        port = stack.api.port
+        loop = asyncio.get_running_loop()
+
+        def http(*a, **kw):
+            return loop.run_in_executor(None, lambda: _http(*a, **kw))
+
+        try:
+            # --- 3.1 ingest ---------------------------------------------
+            status, body = await http("POST", port, "/api/submit-url",
+                                      {"url": "http://example.com/doc1"})
+            assert status == 200
+            assert "submitted successfully" in body["message"]
+            await http("POST", port, "/api/submit-url",
+                       {"url": "http://example.com/doc2"})
+            ok = await _wait_until(lambda: stack.vector_store.count() >= 5)
+            assert ok, f"pipeline stalled; count={stack.vector_store.count()}"
+
+            # --- 3.2 search (2-hop request-reply) ------------------------
+            status, body = await http("POST", port, "/api/search/semantic",
+                                      {"query_text": "matrix multiplication",
+                                       "top_k": 3})
+            assert status == 200, body
+            assert body["error_message"] is None
+            assert len(body["results"]) == 3
+            hit = body["results"][0]
+            assert set(hit) == {"qdrant_point_id", "score", "payload"}
+            assert set(hit["payload"]) == {
+                "original_document_id", "source_url", "sentence_text",
+                "sentence_order", "model_name", "processed_at_ms"}
+
+            # --- 3.5 knowledge graph (un-orphaned) -----------------------
+            ok = await _wait_until(
+                lambda: stack.graph_store.counts()["Document"] >= 2)
+            assert ok
+            docs = stack.graph_store.documents_containing_token("tpus")
+            assert len(docs) == 1
+
+            # --- 3.3 generate → SSE --------------------------------------
+            sse_lines: list = []
+
+            async def sse_reader():
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.write(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
+                await writer.drain()
+                while True:
+                    line = await reader.readline()
+                    if line.startswith(b"data: "):
+                        sse_lines.append(line[6:].strip())
+                        break
+                writer.close()
+
+            reader_task = asyncio.create_task(sse_reader())
+            await asyncio.sleep(0.2)
+            status, body = await http("POST", port, "/api/generate-text",
+                                      {"task_id": "t-1", "prompt": None,
+                                       "max_length": 10})
+            assert status == 200
+            await asyncio.wait_for(reader_task, timeout=10)
+            event = json.loads(sse_lines[0])
+            assert event["original_task_id"] == "t-1"
+            assert event["generated_text"]
+
+            # trained-on-ingest: generator saw scraped docs, so vocabulary
+            # beyond the seed corpus is reachable
+            assert stack.services[-1].markov.chain  # non-empty
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
+
+
+def test_api_validation_parity(stack_config):
+    async def scenario():
+        stack = await _start_stack(stack_config)
+        port = stack.api.port
+        loop = asyncio.get_running_loop()
+
+        def http(*a, **kw):
+            return loop.run_in_executor(None, lambda: _http(*a, **kw))
+
+        try:
+            # empty URL → 400 (reference: main.rs:48-53)
+            status, body = await http("POST", port, "/api/submit-url", {"url": "  "})
+            assert (status, body["message"]) == (400, "URL cannot be empty")
+            # empty task_id → 400 (main.rs:125-131)
+            status, body = await http("POST", port, "/api/generate-text",
+                                      {"task_id": " ", "prompt": None,
+                                       "max_length": 5})
+            assert (status, body["message"]) == (400, "task_id cannot be empty")
+            # max_length out of range → 400 with task_id echoed (main.rs:133-142)
+            status, body = await http("POST", port, "/api/generate-text",
+                                      {"task_id": "t", "prompt": None,
+                                       "max_length": 1001})
+            assert status == 400
+            assert body["message"] == "max_length must be between 1 and 1000"
+            assert body["task_id"] == "t"
+            # unknown route
+            status, _ = await http("GET", port, "/api/nope")
+            assert status == 404
+            # metrics + health (our additions)
+            status, body = await http("GET", port, "/api/metrics")
+            assert status == 200 and "counters" in body
+            status, body = await http("GET", port, "/healthz")
+            assert status == 200 and body["status"] == "ok"
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
+
+
+def test_search_timeout_maps_to_503(stack_config):
+    """No preprocessing service running → embed hop times out → 503
+    (reference status mapping, main.rs:317-349)."""
+
+    async def scenario():
+        from symbiont_tpu.bus.inproc import InprocBus
+        from symbiont_tpu.config import BusConfig
+        from symbiont_tpu.services.api import ApiService
+
+        bus = InprocBus()
+        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0),
+                         BusConfig(request_timeout_embed_s=0.2))
+        await api.start()
+        loop = asyncio.get_running_loop()
+        try:
+            status, body = await loop.run_in_executor(
+                None, lambda: _http("POST", api.port, "/api/search/semantic",
+                                    {"query_text": "q", "top_k": 1}))
+            assert status == 503
+            assert "Failed to get embedding" in body["error_message"]
+        finally:
+            await api.stop()
+
+    asyncio.run(scenario())
+
+
+def test_scrape_failure_drops_silently(stack_config):
+    """Unreachable URL: 200 at submit (fire-and-forget enqueue ack,
+    reference main.rs:91-98), then nothing downstream."""
+
+    async def scenario():
+        stack = await _start_stack(stack_config)
+        port = stack.api.port
+        loop = asyncio.get_running_loop()
+        try:
+            status, _ = await loop.run_in_executor(
+                None, lambda: _http("POST", port, "/api/submit-url",
+                                    {"url": "http://unreachable.example"}))
+            assert status == 200
+            await asyncio.sleep(0.3)
+            assert stack.vector_store.count() == 0
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())

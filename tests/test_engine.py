@@ -1,0 +1,194 @@
+"""Engine tests: text parity, bucketing, executable cache, DP mesh, batcher."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from symbiont_tpu.config import EngineConfig
+from symbiont_tpu.engine.bucketing import choose_bucket, pad_to_bucket, plan_batches
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.engine.text import clean_text, split_sentences, tokenize_words
+from symbiont_tpu.engine.tokenizer import HashTokenizer
+
+
+# ------------------------------------------------------------------- text
+
+def test_clean_text_whitespace_parity():
+    # reference: preprocessing_service/src/main.rs:28-33
+    assert clean_text("  a\t b\n\nc  ") == "a b c"
+    assert clean_text("\n \t ") == ""
+
+
+def test_split_sentences_parity():
+    # reference: preprocessing_service/src/main.rs:41-62
+    assert split_sentences("One. Two? Three!") == ["One.", "Two?", "Three!"]
+    assert split_sentences("No delimiter here") == ["No delimiter here"]
+    assert split_sentences("Trailing remainder. extra") == ["Trailing remainder.", "extra"]
+    assert split_sentences("Привет мир. Как дела?") == ["Привет мир.", "Как дела?"]
+    # consecutive delimiters produce empty-trimmed slices like the reference
+    assert split_sentences("Hi!! Done.") == ["Hi!", "!", "Done."]
+
+
+def test_tokenize_words():
+    assert tokenize_words("a b  c") == ["a", "b", "c"]
+
+
+# -------------------------------------------------------------- bucketing
+
+def test_choose_bucket():
+    assert choose_bucket(5, [32, 64]) == 32
+    assert choose_bucket(33, [32, 64]) == 64
+    assert choose_bucket(100, [32, 64]) == 64  # clamp to max
+
+
+def test_pad_to_bucket():
+    ids, mask = pad_to_bucket([[1, 2], [3]], 4, pad_id=9)
+    np.testing.assert_array_equal(ids, [[1, 2, 9, 9], [3, 9, 9, 9]])
+    np.testing.assert_array_equal(mask, [[1, 1, 0, 0], [1, 0, 0, 0]])
+
+
+def test_plan_batches_groups_by_bucket_and_limits_size():
+    lengths = [5, 60, 6, 61, 7, 8]
+    plans = plan_batches(lengths, [32, 64], max_batch=2)
+    # all short ones in 32-bucket batches of ≤2, long ones in 64
+    got = {}
+    for bucket, idxs in plans:
+        got.setdefault(bucket, []).extend(idxs)
+        assert len(idxs) <= 2
+    assert sorted(got[32]) == [0, 2, 4, 5]
+    assert sorted(got[64]) == [1, 3]
+
+
+# ----------------------------------------------------------------- engine
+
+def _small_engine(**kw):
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16], batch_buckets=[2, 4],
+                       max_batch=4, dtype="float32", data_parallel=False)
+    return TpuEngine(cfg, **kw)
+
+
+def test_embed_texts_order_and_shape():
+    eng = _small_engine()
+    texts = ["short one", "a much longer sentence with many words repeated " * 3,
+             "mid size text here", "tiny"]
+    out = eng.embed_texts(texts)
+    assert out.shape == (4, 32)
+    assert np.isfinite(out).all()
+    # order must be restored after sort-by-length batching
+    solo = np.stack([eng.embed_texts([t])[0] for t in texts])
+    np.testing.assert_allclose(out, solo, atol=1e-4, rtol=1e-3)
+
+
+def test_embed_empty_and_query():
+    eng = _small_engine()
+    assert eng.embed_texts([]).shape == (0, 32)
+    q = eng.embed_query("hello world")
+    assert q.shape == (32,)
+
+
+def test_executable_cache_bounded_and_reused():
+    eng = _small_engine()
+    eng.embed_texts(["one two"])
+    c0 = eng.stats["compiles"]
+    eng.embed_texts(["three four"])  # same (bucket, batch) → no new compile
+    assert eng.stats["compiles"] == c0
+    eng.embed_texts(["w " * 14])  # longer → next bucket → one new compile
+    assert eng.stats["compiles"] == c0 + 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_engine_data_parallel_matches_single():
+    from symbiont_tpu.parallel import build_mesh
+
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[8, 16], max_batch=16, dtype="float32")
+    mesh = build_mesh()
+    eng_dp = TpuEngine(cfg, mesh=mesh)
+    eng_1 = TpuEngine(
+        EngineConfig(embedding_dim=32, length_buckets=[8, 16], batch_buckets=[8, 16],
+                     max_batch=16, dtype="float32", data_parallel=False))
+    texts = [f"sentence number {i} with words" for i in range(12)]
+    np.testing.assert_allclose(eng_dp.embed_texts(texts), eng_1.embed_texts(texts),
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_rerank_with_synthetic_cross_encoder():
+    import jax as _jax
+
+    from symbiont_tpu.models import bert as bert_mod
+
+    ccfg = bert_mod.BertConfig(vocab_size=30000, hidden_size=32, num_layers=2,
+                               num_heads=2, intermediate_size=64,
+                               max_position_embeddings=64, dtype="float32")
+    cparams = bert_mod.init_params(_jax.random.key(7), ccfg, with_pooler=True)
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[16, 32], batch_buckets=[2, 4],
+                       max_batch=4, dtype="float32", data_parallel=False)
+    eng = TpuEngine(cfg, cross_params=cparams, cross_cfg=ccfg)
+    scores = eng.rerank("what is tpu", ["tpu is an accelerator", "bananas are yellow",
+                                        "tensor processing unit"])
+    assert scores.shape == (3,)
+    assert np.isfinite(scores).all()
+
+
+def test_rerank_without_model_raises():
+    eng = _small_engine()
+    with pytest.raises(RuntimeError, match="no cross-encoder"):
+        eng.rerank("q", ["p"])
+
+
+# ---------------------------------------------------------------- batcher
+
+def test_micro_batcher_batches_and_returns_in_order():
+    from symbiont_tpu.engine.batcher import MicroBatcher
+
+    eng = _small_engine()
+
+    async def main():
+        b = MicroBatcher(eng, max_batch=8, flush_deadline_ms=10)
+        await b.start()
+        r1, r2 = await asyncio.gather(
+            b.embed(["alpha beta", "gamma"]),
+            b.embed(["delta epsilon zeta"]),
+        )
+        await b.close()
+        return r1, r2
+
+    r1, r2 = asyncio.run(main())
+    assert r1.shape == (2, 32) and r2.shape == (1, 32)
+    ref = eng.embed_texts(["alpha beta", "gamma", "delta epsilon zeta"])
+    np.testing.assert_allclose(np.vstack([r1, r2]), ref, atol=1e-4, rtol=1e-3)
+
+
+def test_micro_batcher_propagates_errors():
+    from symbiont_tpu.engine.batcher import MicroBatcher
+
+    eng = _small_engine()
+
+    def boom(texts):
+        raise ValueError("device on fire")
+
+    eng.embed_texts = boom  # type: ignore
+
+    async def main():
+        b = MicroBatcher(eng, max_batch=2, flush_deadline_ms=5)
+        await b.start()
+        with pytest.raises(ValueError, match="device on fire"):
+            await b.embed(["x"])
+        await b.close()
+
+    asyncio.run(main())
+
+
+def test_hash_tokenizer_deterministic():
+    t = HashTokenizer(1000)
+    a = t.encode("Hello, World", 16)
+    b = t.encode("hello world", 16)
+    assert a[0] == t.cls_id and a[-1] == t.sep_id
+    # case-insensitive, punctuation tokenized separately
+    assert a[1] == b[1]
+    ids, types = t.encode_pair("a b", "c d e", 32)
+    assert len(ids) == len(types)
+    assert types[0] == 0 and types[-1] == 1

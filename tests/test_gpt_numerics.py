@@ -133,6 +133,33 @@ def test_generate_respects_eos():
     assert int(np.asarray(toks2)[0, 0]) == first
 
 
+def test_pad_content_cannot_leak_into_generation(torch_gpt2):
+    """Regression: padding-slot K/V must never be attended. Same prompt with
+    different garbage in the pad region must generate identical tokens."""
+    model, hf_cfg = torch_gpt2
+    cfg = _fp32(GPTConfig.from_hf(hf_cfg.to_dict()))
+    params = convert_gpt(model.state_dict(), cfg)
+    b = np.array([50, 12, 30], np.int32)
+    P = 6
+    mask = np.zeros((1, P), np.int32)
+    mask[0, :3] = 1
+    ids_a = np.zeros((1, P), np.int32)
+    ids_a[0, :3] = b
+    ids_b = np.full((1, P), 55, np.int32)  # different pad garbage
+    ids_b[0, :3] = b
+    t_a, _ = generate(params, jnp.asarray(ids_a), jnp.asarray(mask),
+                      jax.random.key(0), cfg, max_new_tokens=5, temperature=0.0)
+    t_b, _ = generate(params, jnp.asarray(ids_b), jnp.asarray(mask),
+                      jax.random.key(0), cfg, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(t_a), np.asarray(t_b))
+    # and padded equals unpadded solo decode
+    t_solo, _ = generate(params, jnp.asarray(b[None, :]),
+                         jnp.asarray(np.ones((1, 3), np.int32)),
+                         jax.random.key(0), cfg, max_new_tokens=5,
+                         temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(t_a), np.asarray(t_solo))
+
+
 def test_ragged_batch_prompt_lengths(torch_gpt2):
     """Rows with different prompt lengths decode from their own last token."""
     model, hf_cfg = torch_gpt2

@@ -1,0 +1,88 @@
+"""Graph store tests: MERGE semantics parity with save_to_neo4j
+(reference: services/knowledge_graph_service/src/main.rs:23-140)."""
+
+from symbiont_tpu.schema import TokenizedTextMessage
+from symbiont_tpu.graph import GraphStore
+
+
+def _msg(**kw):
+    base = dict(original_id="doc-1", source_url="http://x",
+                tokens=["Hello", "world", "hello"],
+                sentences=["Hello world.", "Second one."],
+                timestamp_ms=1000)
+    base.update(kw)
+    return TokenizedTextMessage(**base)
+
+
+def _store(tmp_path):
+    return GraphStore(path=str(tmp_path / "g.sqlite3"))
+
+
+def test_save_creates_nodes_and_edges(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg())
+    c = g.counts()
+    assert c["Document"] == 1
+    assert c["Sentence"] == 2
+    # tokens are lowercase-keyed: Hello and hello merge (main.rs:110-118)
+    assert c["Token"] == 2
+    assert g.document_sentences("doc-1") == ["Hello world.", "Second one."]
+    assert g.documents_containing_token("HELLO") == ["doc-1"]
+
+
+def test_document_merge_updates_not_duplicates(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg())
+    g.save_tokenized(_msg(source_url="http://y", timestamp_ms=2000))
+    assert g.counts()["Document"] == 1
+    doc = g.get_document("doc-1")
+    assert doc["source_url"] == "http://y"  # ON MATCH SET (main.rs:38-40)
+    assert doc["processed_at_ms"] == 2000
+
+
+def test_empty_sentences_and_tokens_skipped(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg(sentences=["ok.", "  ", ""], tokens=["a", " ", ""]))
+    c = g.counts()
+    assert c["Sentence"] == 1 and c["Token"] == 1
+
+
+def test_shared_sentences_across_documents(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg())
+    g.save_tokenized(_msg(original_id="doc-2", sentences=["Hello world."],
+                          tokens=["shared"]))
+    c = g.counts()
+    assert c["Document"] == 2
+    assert c["Sentence"] == 2  # "Hello world." merged across docs
+    assert sorted(g.documents_containing_token("hello")) == ["doc-1"]
+
+
+def test_token_case_updates_original(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg(tokens=["WORLD"]))
+    g.save_tokenized(_msg(tokens=["world"]))
+    # last write wins on text_original_case (ON MATCH SET, main.rs:113-116)
+    rows = g._db.execute(
+        "SELECT props FROM nodes WHERE label='Token' AND merge_key='world'"
+    ).fetchall()
+    import json
+
+    assert json.loads(rows[0][0])["text_original_case"] == "world"
+
+
+def test_persistence_across_reopen(tmp_path):
+    path = tmp_path / "g.sqlite3"
+    g = GraphStore(path=str(path))
+    g.save_tokenized(_msg())
+    g.close()
+    g2 = GraphStore(path=str(path))
+    assert g2.counts()["Document"] == 1
+    assert g2.document_sentences("doc-1") == ["Hello world.", "Second one."]
+
+
+def test_unicode_tokens(tmp_path):
+    g = _store(tmp_path)
+    g.save_tokenized(_msg(tokens=["Привет", "МИР"], sentences=["Привет мир."]))
+    assert g.documents_containing_token("привет") == ["doc-1"]
+    assert g.documents_containing_token("мир") == ["doc-1"]

@@ -1,0 +1,71 @@
+"""Scraper extraction tests on HTML fixtures (reference extraction logic:
+services/perception_service/src/main.rs:86-170 — untested there)."""
+
+from symbiont_tpu.services.html_extract import extract_main_text
+
+
+def test_article_preferred_over_body():
+    html = """
+    <html><body>
+      <div><p>sidebar junk</p></div>
+      <article><h1>Title</h1><p>Body text.</p></article>
+    </body></html>"""
+    out = extract_main_text(html)
+    assert "Title" in out and "Body text." in out
+    assert "sidebar junk" not in out
+
+
+def test_selector_cascade_order():
+    # div.content chosen when no article/main/div[role=main]
+    html = """
+    <html><body>
+      <div class="content wide"><p>the content</p></div>
+      <div class="entry-content"><p>entry</p></div>
+    </body></html>"""
+    out = extract_main_text(html)
+    assert "the content" in out
+    assert "entry" not in out
+
+
+def test_div_role_main():
+    html = "<body><div role='main'><p>roled</p></div><p>outside</p></body>"
+    out = extract_main_text(html)
+    assert out == "roled"
+
+
+def test_body_fallback_and_text_selectors():
+    html = """
+    <body><h2>H</h2><ul><li>item one</li><li>item two</li></ul>
+    <span>a span</span><table><td>not extracted</td></table></body>"""
+    out = extract_main_text(html)
+    assert "H" in out and "item one" in out and "a span" in out
+    assert "not extracted" not in out  # td is not in the text-selector list
+
+
+def test_script_and_style_excluded():
+    html = """<body><article>
+      <p>keep<script>var x = 'drop';</script></p>
+      <style>.c{}</style><p>also keep</p></article></body>"""
+    out = extract_main_text(html)
+    assert "keep" in out and "also keep" in out
+    assert "drop" not in out and ".c{}" not in out
+
+
+def test_text_nodes_trimmed_and_joined():
+    # a text node's internal newline survives to the final line-split pass
+    # (reference trims whole nodes, then trims lines: main.rs:135-152)
+    html = "<body><p>  a \n  b  <b>c</b>  </p></body>"
+    assert extract_main_text(html) == "a\nb c"
+    assert extract_main_text("<body><p> x  <b>y</b> </p></body>") == "x y"
+
+
+def test_empty_and_garbage_html():
+    assert extract_main_text("") == ""
+    assert extract_main_text("<<<not html>>>") == ""
+    assert extract_main_text("<body><p>   </p></body>") == ""
+
+
+def test_malformed_nesting_tolerated():
+    html = "<body><article><p>one<p>two</article>"
+    out = extract_main_text(html)
+    assert "one" in out and "two" in out

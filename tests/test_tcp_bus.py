@@ -1,0 +1,203 @@
+"""Native symbus broker + TCP client: same semantics as the in-proc bus,
+exercised against the real C++ broker over a real socket."""
+
+import asyncio
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def broker():
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
+                   capture_output=True)
+    port = _free_port()
+    proc = subprocess.Popen(
+        [str(REPO / "native" / "build" / "symbus_broker"), "--port", str(port),
+         "--host", "127.0.0.1"],
+        stderr=subprocess.PIPE)
+    # wait for listen
+    for _ in range(100):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("broker did not start")
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def _connect(port):
+    from symbiont_tpu.bus.tcp import TcpBus
+
+    async def go():
+        bus = TcpBus("127.0.0.1", port)
+        await bus.connect()
+        return bus
+
+    return go
+
+
+def test_pub_sub_over_tcp(broker):
+    async def main():
+        a = await _connect(broker)()
+        b = await _connect(broker)()
+        sub = await b.subscribe("greet.*")
+        await asyncio.sleep(0.05)  # let SUB land before PUB
+        await a.publish("greet.world", "привет".encode(),
+                        headers={"X-Trace-Id": "t1"})
+        msg = await sub.next(2)
+        assert msg is not None
+        assert msg.subject == "greet.world"
+        assert msg.data.decode() == "привет"
+        assert msg.headers["X-Trace-Id"] == "t1"
+        await a.close()
+        await b.close()
+
+    asyncio.run(main())
+
+
+def test_queue_group_sharding_over_tcp(broker):
+    async def main():
+        pub = await _connect(broker)()
+        w1 = await _connect(broker)()
+        w2 = await _connect(broker)()
+        s1 = await w1.subscribe("jobs", queue="workers")
+        s2 = await w2.subscribe("jobs", queue="workers")
+        await asyncio.sleep(0.05)
+        for i in range(10):
+            await pub.publish("jobs", str(i).encode())
+        got1 = got2 = 0
+        deadline = time.time() + 3
+        while got1 + got2 < 10 and time.time() < deadline:
+            m1 = await s1.next(0.05)
+            m2 = await s2.next(0.05)
+            got1 += m1 is not None
+            got2 += m2 is not None
+        assert got1 + got2 == 10
+        assert got1 > 0 and got2 > 0  # actually shared
+        for bus in (pub, w1, w2):
+            await bus.close()
+
+    asyncio.run(main())
+
+
+def test_request_reply_over_tcp(broker):
+    async def main():
+        server = await _connect(broker)()
+        client = await _connect(broker)()
+        sub = await server.subscribe("svc.echo")
+
+        async def responder():
+            msg = await sub.next(3)
+            await server.publish(msg.reply, b"pong:" + msg.data)
+
+        await asyncio.sleep(0.05)
+        task = asyncio.create_task(responder())
+        reply = await client.request("svc.echo", b"ping", timeout=3)
+        assert reply.data == b"pong:ping"
+        await task
+        with pytest.raises(TimeoutError):
+            await client.request("svc.nobody", b"x", timeout=0.2)
+        await server.close()
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_large_payload_over_tcp(broker):
+    """Embeddings cross the wire as JSON (SURVEY.md §1-L3 note) — a whole
+    document's vectors can be megabytes."""
+
+    async def main():
+        a = await _connect(broker)()
+        b = await _connect(broker)()
+        sub = await b.subscribe("big")
+        await asyncio.sleep(0.05)
+        payload = b"x" * (4 * 1024 * 1024)
+        await a.publish("big", payload)
+        msg = await sub.next(5)
+        assert msg is not None and len(msg.data) == len(payload)
+        await a.close()
+        await b.close()
+
+    asyncio.run(main())
+
+
+def test_unsubscribe_stops_delivery(broker):
+    async def main():
+        a = await _connect(broker)()
+        b = await _connect(broker)()
+        sub = await b.subscribe("u.x")
+        await asyncio.sleep(0.05)
+        await a.publish("u.x", b"1")
+        assert (await sub.next(2)).data == b"1"
+        sub.close()
+        await asyncio.sleep(0.1)
+        await a.publish("u.x", b"2")
+        assert await sub.next(0.3) is None
+        await a.close()
+        await b.close()
+
+    asyncio.run(main())
+
+
+def test_full_stack_over_native_broker(broker, tmp_path):
+    """The entire service stack runs against the C++ broker instead of the
+    in-proc bus — multi-transport parity for the pipeline."""
+    from tests.test_e2e_pipeline import _fake_fetcher, _http
+    from symbiont_tpu.config import (ApiConfig, EngineConfig, GraphStoreConfig,
+                                     SymbiontConfig, VectorStoreConfig)
+    from symbiont_tpu.runner import SymbiontStack
+
+    cfg = SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
+                            batch_buckets=[2, 8], max_batch=8, dtype="float32",
+                            data_parallel=False, flush_deadline_ms=2.0),
+        vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
+    )
+    cfg.bus.url = f"symbus://127.0.0.1:{broker}"
+
+    async def scenario():
+        stack = SymbiontStack(cfg, fetcher=_fake_fetcher)
+        await stack.start()
+        loop = asyncio.get_running_loop()
+        try:
+            port = stack.api.port
+            status, _ = await loop.run_in_executor(
+                None, lambda: _http("POST", port, "/api/submit-url",
+                                    {"url": "http://example.com/doc1"}))
+            assert status == 200
+            deadline = time.time() + 20
+            while stack.vector_store.count() < 3 and time.time() < deadline:
+                await asyncio.sleep(0.1)
+            assert stack.vector_store.count() >= 3
+            status, body = await loop.run_in_executor(
+                None, lambda: _http("POST", port, "/api/search/semantic",
+                                    {"query_text": "embeddings", "top_k": 2}))
+            assert status == 200 and len(body["results"]) == 2
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,113 @@
+"""Training-step tests: loss decreases, sharded DP+TP step runs on the
+8-device mesh, checkpoint round-trip."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbiont_tpu.models import bert as bert_mod
+from symbiont_tpu.models import gpt as gpt_mod
+from symbiont_tpu.train.trainer import (
+    contrastive_train_step,
+    lm_train_step,
+    make_embedder_train_state,
+    make_lm_train_state,
+    shard_lm_train_state,
+)
+
+
+def _bert_cfg():
+    return bert_mod.BertConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                               num_heads=2, intermediate_size=32,
+                               max_position_embeddings=32, dtype="float32")
+
+
+def _gpt_cfg(**kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                intermediate_size=64, max_position_embeddings=32,
+                dtype="float32")
+    base.update(kw)
+    return gpt_mod.GPTConfig(**base)
+
+
+def test_contrastive_loss_decreases():
+    cfg = _bert_cfg()
+    params = bert_mod.init_params(jax.random.key(0), cfg)
+    state, tx = make_embedder_train_state(params, learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    B, S = 8, 10
+    batch = {
+        "q_ids": jnp.asarray(rng.integers(3, 64, (B, S)), jnp.int32),
+        "q_mask": jnp.ones((B, S), jnp.int32),
+        "p_ids": jnp.asarray(rng.integers(3, 64, (B, S)), jnp.int32),
+        "p_mask": jnp.ones((B, S), jnp.int32),
+    }
+    losses = []
+    for _ in range(8):
+        state, m = contrastive_train_step(state, batch, cfg, tx)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 8
+
+
+def test_lm_loss_decreases_and_masks_padding():
+    cfg = _gpt_cfg()
+    params = gpt_mod.init_params(jax.random.key(1), cfg)
+    state, tx = make_lm_train_state(params, learning_rate=1e-3)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 64, (4, 16)).astype(np.int32)
+    mask = np.ones((4, 16), np.int32)
+    mask[2, 10:] = 0
+    batch = {"ids": jnp.asarray(ids), "mask": jnp.asarray(mask)}
+    losses = []
+    for _ in range(8):
+        state, m = lm_train_step(state, batch, cfg, tx)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_lm_train_step_dp_tp():
+    """Full train step with TP-sharded params + DP-sharded batch on a 4x2
+    mesh — the multi-chip training path dryrun_multichip exercises."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbiont_tpu.parallel import build_mesh
+
+    mesh = build_mesh([4, 2])
+    cfg = _gpt_cfg(num_heads=4)
+    params = gpt_mod.init_params(jax.random.key(2), cfg)
+    state, tx = make_lm_train_state(params)
+    state = shard_lm_train_state(mesh, state, arch="gpt2")
+    rng = np.random.default_rng(2)
+    batch = {
+        "ids": jax.device_put(
+            jnp.asarray(rng.integers(1, 64, (8, 16)), jnp.int32),
+            NamedSharding(mesh, P("data"))),
+        "mask": jax.device_put(jnp.ones((8, 16), jnp.int32),
+                               NamedSharding(mesh, P("data"))),
+    }
+    state2, m = lm_train_step(state, batch, cfg, tx)
+    assert np.isfinite(float(m["loss"]))
+    # params stay TP-sharded after the update
+    qk = state2.params["layers"][0]["q"]["kernel"]
+    assert "tensor" in str(qk.sharding.spec)
+    # and a second step composes
+    state3, m2 = lm_train_step(state2, batch, cfg, tx)
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_checkpoint_round_trip(tmp_path):
+    from symbiont_tpu.train import checkpoint as ckpt
+
+    cfg = _bert_cfg()
+    params = bert_mod.init_params(jax.random.key(3), cfg)
+    ckpt.save_params(tmp_path / "ck", params, meta={"model": "test"})
+    assert ckpt.exists(tmp_path / "ck")
+    restored, meta = ckpt.load_params(tmp_path / "ck")
+    assert meta["model"] == "test"
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+                 params, restored)

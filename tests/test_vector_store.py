@@ -1,0 +1,123 @@
+"""Vector store tests: ensure/upsert/search parity, durability, sharding."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from symbiont_tpu.config import VectorStoreConfig
+from symbiont_tpu.memory import VectorStore
+
+
+def _cfg(tmp_path=None, **kw):
+    kw.setdefault("dim", 8)
+    kw.setdefault("shard_capacity", 16)
+    return VectorStoreConfig(data_dir=str(tmp_path) if tmp_path else "", **kw)
+
+
+def _unit(v):
+    v = np.asarray(v, np.float32)
+    return v / np.linalg.norm(v)
+
+
+def test_upsert_and_search_exact_cosine_order():
+    store = VectorStore(_cfg())
+    store.ensure_collection()
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(20, 8)).astype(np.float32)
+    store.upsert([(f"p{i}", vecs[i], {"sentence_text": f"s{i}", "sentence_order": i})
+                  for i in range(20)])
+    q = vecs[7]
+    hits = store.search(q, top_k=5)
+    assert hits[0].id == "p7"
+    assert hits[0].score == pytest.approx(1.0, abs=2e-2)  # bf16 matmul
+    # scores descending, exact order matches numpy cosine
+    cos = (vecs @ _unit(q)) / np.linalg.norm(vecs, axis=1)
+    expect = [f"p{i}" for i in np.argsort(-cos)[:5]]
+    assert [h.id for h in hits] == expect
+    assert hits[0].payload["sentence_text"] == "s7"
+
+
+def test_top_k_larger_than_corpus():
+    store = VectorStore(_cfg())
+    store.upsert([("a", np.ones(8), {}), ("b", -np.ones(8), {})])
+    hits = store.search(np.ones(8), top_k=10)
+    assert [h.id for h in hits] == ["a", "b"]
+
+
+def test_upsert_overwrites_existing_id():
+    store = VectorStore(_cfg())
+    store.upsert([("x", _unit(np.arange(1, 9)), {"v": 1})])
+    store.upsert([("x", -_unit(np.arange(1, 9)), {"v": 2})])
+    assert store.count() == 1
+    hits = store.search(-np.arange(1, 9, dtype=np.float32), top_k=1)
+    assert hits[0].payload["v"] == 2
+    assert hits[0].score > 0.9
+
+
+def test_dim_mismatch_raises():
+    store = VectorStore(_cfg())
+    with pytest.raises(ValueError, match="dim"):
+        store.upsert([("bad", np.ones(5), {})])
+    store.upsert([("ok", np.ones(8), {})])
+    with pytest.raises(ValueError):
+        store.ensure_collection(dim=16)  # existing data at dim 8
+    with pytest.raises(ValueError, match="dim"):
+        store.search(np.ones(3), top_k=1)
+
+
+def test_empty_store_and_zero_k():
+    store = VectorStore(_cfg())
+    assert store.search(np.ones(8), top_k=3) == []
+    store.upsert([("a", np.ones(8), {})])
+    assert store.search(np.ones(8), top_k=0) == []
+
+
+def test_growth_across_capacity_blocks():
+    store = VectorStore(_cfg())  # shard_capacity 16
+    rng = np.random.default_rng(1)
+    vecs = rng.normal(size=(40, 8)).astype(np.float32)  # 3 blocks
+    for i in range(40):
+        store.upsert([(f"p{i}", vecs[i], {})])
+    hits = store.search(vecs[33], top_k=1)
+    assert hits[0].id == "p33"
+
+
+def test_wal_durability_and_reload(tmp_path):
+    store = VectorStore(_cfg(tmp_path))
+    rng = np.random.default_rng(2)
+    vecs = rng.normal(size=(5, 8)).astype(np.float32)
+    store.upsert([(f"p{i}", vecs[i], {"i": i}) for i in range(5)])
+    # simulate crash: new store instance on same dir, no compact
+    store2 = VectorStore(_cfg(tmp_path))
+    assert store2.count() == 5
+    assert store2.search(vecs[3], top_k=1)[0].id == "p3"
+
+
+def test_compact_then_reload_with_wal_tail(tmp_path):
+    store = VectorStore(_cfg(tmp_path))
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(6, 8)).astype(np.float32)
+    store.upsert([(f"p{i}", vecs[i], {}) for i in range(4)])
+    store.compact()
+    store.upsert([(f"p{i}", vecs[i], {}) for i in range(4, 6)])  # post-snapshot WAL
+    store3 = VectorStore(_cfg(tmp_path))
+    assert store3.count() == 6
+    assert store3.search(vecs[5], top_k=1)[0].id == "p5"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_sharded_search_matches_unsharded():
+    from symbiont_tpu.parallel import build_mesh
+
+    rng = np.random.default_rng(4)
+    vecs = rng.normal(size=(64, 8)).astype(np.float32)
+    points = [(f"p{i}", vecs[i], {}) for i in range(64)]
+    plain = VectorStore(_cfg())
+    plain.upsert(points)
+    sharded = VectorStore(_cfg(), mesh=build_mesh())
+    sharded.upsert(points)
+    q = rng.normal(size=8).astype(np.float32)
+    h1 = [h.id for h in plain.search(q, top_k=8)]
+    h2 = [h.id for h in sharded.search(q, top_k=8)]
+    assert h1 == h2
