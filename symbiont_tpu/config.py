@@ -43,6 +43,9 @@ class EngineConfig:
     embedding_dim: int = 768
     force_cpu: bool = False  # reference: FORCE_CPU env, preprocessing main.rs:307
     dtype: str = "bfloat16"
+    # attention backend: "auto" → pallas flash kernel on TPU, einsum-XLA
+    # elsewhere; "flash"/"xla" force it.
+    attn_impl: str = "auto"
     # Length buckets replace the reference's pad-everything-to-max policy
     # (reference: embedding_generator.rs:83-91) — §5.7 of SURVEY.md.
     length_buckets: List[int] = field(default_factory=lambda: [32, 64, 128, 256, 512])

@@ -75,10 +75,20 @@ class TpuEngine:
                     dtype=self.config.dtype)
                 params = bert_mod.init_params(jax.random.key(0), model_cfg)
                 log.warning("engine running with RANDOM weights (no model_dir)")
-        if model_cfg.dtype != self.config.dtype:
-            import dataclasses
+        import dataclasses
 
+        if model_cfg.dtype != self.config.dtype:
             model_cfg = dataclasses.replace(model_cfg, dtype=self.config.dtype)
+        attn_impl = self.config.attn_impl
+        if attn_impl not in ("auto", "flash", "xla"):
+            raise ValueError(
+                f"attn_impl must be auto|flash|xla, got {attn_impl!r}")
+        if attn_impl == "auto":
+            attn_impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if model_cfg.attn_impl != attn_impl:
+            model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
+        if cross_cfg is not None and cross_cfg.attn_impl != attn_impl:
+            cross_cfg = dataclasses.replace(cross_cfg, attn_impl=attn_impl)
         self.model_cfg = model_cfg
         self.tokenizer = tokenizer or load_tokenizer(self.config.model_dir,
                                                      model_cfg.vocab_size)

@@ -53,6 +53,9 @@ class BertConfig:
     hidden_act: str = "gelu"
     # dtype for matmul compute; params may be stored fp32 and cast on entry.
     dtype: str = "bfloat16"
+    # "xla" = einsum attention (XLA fuses); "flash" = fused pallas kernel
+    # (symbiont_tpu.ops.flash_attention) — never materializes [B,NH,S,S].
+    attn_impl: str = "xla"
 
     @staticmethod
     def from_hf(cfg: dict) -> "BertConfig":
@@ -117,11 +120,19 @@ def attention(
     k = proj(params["key"])
     v = proj(params["value"])
 
-    # [B, nh, S, S] scores; softmax in fp32 for stability/parity.
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
-    scores = scores.astype(jnp.float32) + mask_bias.astype(jnp.float32)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
+    if cfg.attn_impl == "flash":
+        from symbiont_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), kv_bias=mask_bias[:, 0, 0, :],
+        ).transpose(0, 2, 1, 3).reshape(B, S, H)
+    else:
+        # [B, nh, S, S] scores; softmax in fp32 for stability/parity.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+        scores = scores.astype(jnp.float32) + mask_bias.astype(jnp.float32)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
     out = ctx @ params["out"]["kernel"] + params["out"]["bias"]
     return out
 

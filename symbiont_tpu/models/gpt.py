@@ -45,6 +45,10 @@ class GPTConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = True
     dtype: str = "bfloat16"
+    # "flash": prefill (S>1 against an EMPTY cache — generate()/train both
+    # qualify) runs the fused pallas kernel over the fresh K/V; decode steps
+    # (S==1) stay on the XLA cache-read path either way.
+    attn_impl: str = "xla"
 
     @property
     def kv_heads(self) -> int:
@@ -163,6 +167,22 @@ def _attn(
     new_cache = KVCache(cache.k.at[layer_idx].set(k_all),
                         cache.v.at[layer_idx].set(v_all), cache.length)
 
+    if cfg.attn_impl == "flash" and S > 1:
+        # Prefill-from-empty: attention over exactly the S fresh tokens (the
+        # cache holds nothing older — see forward()'s docstring contract), so
+        # the kernel runs on the just-projected K/V, GQA handled inside.
+        from symbiont_tpu.ops.flash_attention import flash_attention
+
+        bias = None
+        if kv_valid is not None:
+            bias = jnp.where(kv_valid[:, :S], 0.0, -1e9).astype(jnp.float32)
+        ctx = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), kv_bias=bias, causal=True,
+        ).transpose(0, 2, 1, 3).reshape(B, S, H)
+        out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
+        return out, new_cache
+
     if nkv != nh:
         rep = nh // nkv
         k_all = jnp.repeat(k_all, rep, axis=2)
@@ -218,7 +238,13 @@ def forward(
 
     Tokens are written at cache indices [cache.length, cache.length+S); when
     rows carry left-padding (batched generation), pass kv_valid=False on the
-    padding slots so attention never reads them."""
+    padding slots so attention never reads them.
+
+    With cfg.attn_impl == "flash", any S>1 call MUST be prefill against an
+    empty cache (cache.length == 0) — the fused kernel attends over exactly
+    the S fresh tokens and would silently ignore older cache entries.
+    generate() and the trainer both satisfy this; chunked prefill against a
+    partially-filled cache requires attn_impl == "xla"."""
     dtype = jnp.dtype(cfg.dtype)
     params = jax.tree.map(
         lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params

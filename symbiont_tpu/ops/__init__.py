@@ -1,0 +1,13 @@
+"""TPU ops: pallas kernels for the hot paths.
+
+The models in `symbiont_tpu.models` are pure XLA by default (XLA's fusion
+already covers most of what hand scheduling would buy); this package holds the
+kernels where a fused pallas implementation beats stock XLA — today that is
+attention (`flash_attention`), the FLOPs center of every forward in the zoo
+and the direct descendant of the reference's one compute core (reference:
+services/preprocessing_service/src/embedding_generator.rs:198).
+"""
+
+from symbiont_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,243 @@
+"""Flash attention, pallas-on-TPU.
+
+Blockwise fused attention with a streaming (online) softmax: QK^T, masking,
+softmax and PV happen inside one kernel, so the [B, NH, S, S] score matrix is
+never materialized in HBM — the usual HBM-bandwidth win of flash attention,
+plus MXU-friendly (block_q × block_k) tiles.
+
+Design:
+- grid = (batch, q_heads, q_blocks, kv_blocks). On TPU the last grid axis is
+  innermost & sequential, so the running max (m), normalizer (l) and output
+  accumulator live in VMEM scratch that persists across kv iterations —
+  the canonical pallas accumulation pattern.
+- padding masks enter as an additive f32 bias per kv position ([B, Sk],
+  0 for real tokens / -1e9 for pad), exactly the encoder-side convention of
+  `models/bert.py`; causal decode masking is computed from block indices with
+  `broadcasted_iota`, and fully-masked causal blocks are skipped via
+  `pl.when` (the flash-causal FLOP win).
+- GQA: kv heads may be fewer than q heads; the kv BlockSpec index map sends q
+  head h to kv head h // group, so K/V are never repeated in memory.
+- numerics: compute in f32 (scores, softmax, accumulator) regardless of input
+  dtype; output cast back to q.dtype. Masked-out positions use large-negative
+  finite biases, never -inf, so no NaN can escape `exp`.
+- autodiff: `jax.custom_vjp` whose backward is a dense f32 recompute (exact
+  softmax gradient). Sequences in this system are ≤512 (encoder buckets) or
+  ≤ a few k (LM training), where the dense backward is fine; the forward is
+  the latency-critical path.
+- fallback: shapes the kernel can't tile (non-divisible or tiny S) route to
+  the same dense reference implementation, so callers never need shape
+  special-cases.
+
+Replaces, at the bottom of the stack, the reference's candle
+`BertModel::forward` attention (reference:
+services/preprocessing_service/src/embedding_generator.rs:198) — which
+materializes full score matrices per layer — with the TPU-native fused form.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-negative finite stand-ins for -inf: m is initialized to _ACC_NEG and
+# masked scores are set to _MASK_NEG; keeping both finite (and _ACC_NEG well
+# below any reachable score) means exp() underflows to exactly 0.0 instead of
+# producing inf-inf NaNs.
+_ACC_NEG = -1e30
+_MASK_NEG = -1e9
+
+
+def _pick_block(s: int, pref: int) -> int:
+    """Largest power-of-two block ≤ pref that divides s (0 = no tiling)."""
+    b = pref
+    while b >= 8:
+        if b <= s and s % b == 0:
+            return b
+        b //= 2
+    return 0
+
+
+def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _ACC_NEG, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _compute():
+        # matmuls run in the input dtype (bf16 → native MXU multiply) with
+        # f32 accumulation via preferred_element_type; upcasting the operands
+        # themselves would force multi-pass f32 MXU matmuls (~3× slower).
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]  # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        # bias arrives pre-blocked [B, nk, 1, bk] so the BlockSpec index map
+        # (not an in-kernel dynamic lane slice, which Mosaic can't tile-prove)
+        # selects this kv window; [1, bk] broadcasts over q rows
+        s = s + bias_ref[0, 0]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _MASK_NEG)
+        m_prev = m_scr[:, :1]  # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip blocks entirely above the diagonal
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_call(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    B, NH, Sq, D = q.shape
+    NKV, Sk = k.shape[1], k.shape[2]
+    group = NH // NKV
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    grid = (B, NH, Sq // bq, Sk // bk)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # bias pre-blocked [B, nk, 1, bk]: the block equals the array on
+            # the last two dims, which TPU tiling rules always allow
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, qi, ki: (b, ki, 0, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, D),
+                         lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, NH, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((bq, D), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(bias.reshape(B, Sk // bk, 1, bk), q, k, v)
+
+
+def _dense_reference(q, k, v, bias, causal, scale):
+    """f32 dense attention — fallback path and backward-pass recompute."""
+    NH, NKV = q.shape[1], k.shape[1]
+    if NH != NKV:
+        k = jnp.repeat(k, NH // NKV, axis=1)
+        v = jnp.repeat(v, NH // NKV, axis=1)
+    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    s = s + bias[:, None, None, :]
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        qpos = jnp.arange(Sq)[:, None]
+        kpos = jnp.arange(Sk)[None, :]
+        s = jnp.where(qpos >= kpos, s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf), (p, qf, kf, vf)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    if block_q == 0 or block_k == 0:
+        out, _ = _dense_reference(q, k, v, bias, causal, scale)
+        return out.astype(q.dtype)
+    return _flash_call(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out = _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, bias)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, bias = res
+    NH, NKV = q.shape[1], k.shape[1]
+    group = NH // NKV
+    _, (p, qf, kf, vf) = _dense_reference(q, k, v, bias, causal, scale)
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    if group > 1:
+        B, _, Sk, D = dk.shape
+        dk = dk.reshape(B, NKV, group, Sk, D).sum(axis=2)
+        dv = dv.reshape(B, NKV, group, Sk, D).sum(axis=2)
+    dbias = ds.sum(axis=(1, 2))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias.astype(bias.dtype))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, NH, Sq, D]
+    k: jax.Array,  # [B, NKV, Sk, D] — NKV divides NH (GQA)
+    v: jax.Array,  # [B, NKV, Sk, D]
+    kv_bias: jax.Array | None = None,  # [B, Sk] additive f32 (0 / -1e9)
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused attention → [B, NH, Sq, D] in q.dtype.
+
+    `interpret=None` auto-selects: compiled kernel on TPU, pallas interpreter
+    elsewhere (CPU tests run the same kernel code path bit-for-bit).
+    """
+    B, NH, Sq, D = q.shape
+    NKV, Sk = k.shape[1], k.shape[2]
+    if NH % NKV != 0:
+        raise ValueError(f"q heads {NH} not a multiple of kv heads {NKV}")
+    if v.shape != k.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if kv_bias is None:
+        kv_bias = jnp.zeros((B, Sk), jnp.float32)
+    kv_bias = kv_bias.astype(jnp.float32)
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    return _flash(q, k, v, kv_bias, causal, float(scale),
+                  _pick_block(Sq, block_q), _pick_block(Sk, block_k), interpret)
