@@ -59,6 +59,31 @@ class EngineConfig:
 
 
 @dataclass
+class LmConfig:
+    """Decoder-LM generation (BASELINE.md config #5). Off by default: the
+    reference-parity Markov backend serves tasks.generation.text until this
+    is enabled (reference: text_generator_service/src/main.rs:13-109)."""
+
+    enabled: bool = False
+    model_dir: Optional[str] = None  # GPT-2/Llama checkpoint dir (safetensors)
+    # synthetic-mode geometry (used when model_dir is None; byte-level vocab)
+    arch: str = "llama"
+    hidden_size: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    intermediate_size: int = 1536
+    max_positions: int = 2048
+    dtype: str = "bfloat16"
+    attn_impl: str = "auto"
+    # static-shape buckets: one decode executable per (prompt, new) pair
+    prompt_buckets: List[int] = field(default_factory=lambda: [16, 64, 256, 1024])
+    new_token_buckets: List[int] = field(default_factory=lambda: [16, 64, 128, 256, 1024])
+    temperature: float = 0.8
+    top_k: int = 40
+    seed: int = 0
+
+
+@dataclass
 class VectorStoreConfig:
     # reference: collection name + dim 768 + cosine hardcoded
     # (reference: services/vector_memory_service/src/main.rs:20-22,34-42)
@@ -105,6 +130,7 @@ class ParallelConfig:
 class SymbiontConfig:
     bus: BusConfig = field(default_factory=BusConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
+    lm: LmConfig = field(default_factory=LmConfig)
     vector_store: VectorStoreConfig = field(default_factory=VectorStoreConfig)
     graph_store: GraphStoreConfig = field(default_factory=GraphStoreConfig)
     api: ApiConfig = field(default_factory=ApiConfig)

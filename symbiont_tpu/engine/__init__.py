@@ -18,5 +18,6 @@ engine    : TpuEngine — embed / rerank / generate over the mesh
 """
 
 from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.engine.lm import LmEngine
 
-__all__ = ["TpuEngine"]
+__all__ = ["TpuEngine", "LmEngine"]

@@ -1,0 +1,200 @@
+"""LmEngine — autoregressive text generation on TPU (BASELINE.md config #5).
+
+The reference's "generation" is an order-1 Markov chain trained on one
+hardcoded sentence that ignores the prompt (reference:
+services/text_generator_service/src/main.rs:13-109,120-123). The Markov model
+is kept for parity (models/markov.py); this module is the north-star upgrade
+named in SURVEY.md §2 item 7: decoder-LM generation (GPT-2 / TinyLlama
+layouts) with a static-shape KV-cache decode loop.
+
+TPU shape discipline mirrors the embed path: prompts pad to a small set of
+length buckets and max_new_tokens rounds up to a bucket, so each
+(prompt_bucket, new_bucket) pair is one compiled executable (the inner
+`lax.scan` decode loop never retraces). Sampling params are static too —
+they're part of the scan body.
+
+Tokenization: a local HF tokenizer.json when the model dir has one; otherwise
+a byte-level tokenizer (vocab 256+specials) so the full pipeline — including
+decode back to text — runs with zero model assets.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from symbiont_tpu.config import LmConfig
+from symbiont_tpu.models import gpt as gpt_mod
+from symbiont_tpu.models.gpt import GPTConfig
+
+log = logging.getLogger(__name__)
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..255 = bytes, 256 = BOS/pad.
+
+    File-free and lossless (any text round-trips), so synthetic-weight dev
+    and bench runs produce decodable output without model assets."""
+
+    vocab_size = 257
+    bos_id = 256
+    pad_id = 256
+
+    def encode(self, text: str, max_len: int) -> list:
+        ids = [self.bos_id] + list(text.encode("utf-8"))
+        return ids[:max_len]
+
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class LmHFTokenizer:
+    """tokenizer.json wrapper with decode (generation needs the reverse map)."""
+
+    def __init__(self, tokenizer_file):
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(str(tokenizer_file))
+        self._tok.no_padding()
+        self._tok.no_truncation()
+        self.pad_id = self._tok.token_to_id("<pad>") or 0
+        eos = None
+        for name in ("<|endoftext|>", "</s>", "<|end_of_text|>"):
+            eos = self._tok.token_to_id(name)
+            if eos is not None:
+                break
+        self.eos_id = -1 if eos is None else eos
+        self.bos_id = self.eos_id if self.eos_id >= 0 else 0
+
+    def encode(self, text: str, max_len: int) -> list:
+        return self._tok.encode(text).ids[:max_len]
+
+    def decode(self, ids) -> str:
+        return self._tok.decode([int(i) for i in ids])
+
+
+def _round_up(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class LmEngine:
+    """Owns LM params + decode executables. Thread-safe, single device owner
+    (same stance as TpuEngine — SURVEY.md §5.2's fix for the reference's
+    concurrent-forward hazard)."""
+
+    def __init__(self, config: Optional[LmConfig] = None, params=None,
+                 model_cfg: Optional[GPTConfig] = None, tokenizer=None):
+        import dataclasses
+
+        import jax
+
+        self.config = config or LmConfig()
+        cfg = self.config
+
+        if params is None or model_cfg is None:
+            if cfg.model_dir:
+                from symbiont_tpu.models.convert import load_gpt_model
+
+                params, model_cfg = load_gpt_model(cfg.model_dir)
+                log.info("loaded LM checkpoint from %s", cfg.model_dir)
+            else:
+                # synthetic mode: byte-level vocab, random weights — decodable
+                # gibberish; throughput-true for bench, asset-free for dev
+                model_cfg = GPTConfig(
+                    vocab_size=ByteTokenizer.vocab_size,
+                    hidden_size=cfg.hidden_size, num_layers=cfg.num_layers,
+                    num_heads=cfg.num_heads,
+                    intermediate_size=cfg.intermediate_size,
+                    max_position_embeddings=cfg.max_positions,
+                    arch=cfg.arch, dtype=cfg.dtype)
+                params = gpt_mod.init_params(jax.random.key(0), model_cfg)
+                log.warning("LM running with RANDOM weights (no lm model_dir)")
+        if model_cfg.dtype != cfg.dtype:
+            model_cfg = dataclasses.replace(model_cfg, dtype=cfg.dtype)
+        attn_impl = cfg.attn_impl
+        if attn_impl not in ("auto", "flash", "xla"):
+            raise ValueError(f"attn_impl must be auto|flash|xla, got {attn_impl!r}")
+        if attn_impl == "auto":
+            attn_impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if model_cfg.attn_impl != attn_impl:
+            model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
+        self.model_cfg = model_cfg
+        self.params = jax.device_put(params)
+
+        if tokenizer is None:
+            tokenizer = ByteTokenizer()
+            if cfg.model_dir:
+                from pathlib import Path
+
+                f = Path(cfg.model_dir) / "tokenizer.json"
+                if f.exists():
+                    tokenizer = LmHFTokenizer(f)
+        self.tokenizer = tokenizer
+        self._key = jax.random.key(cfg.seed)
+        self._lock = threading.Lock()
+        self.stats = {"generate_calls": 0, "tokens_generated": 0,
+                      "decode_s": 0.0}
+
+    # ------------------------------------------------------------------ gen
+
+    def generate(self, prompt: str, max_new_tokens: int,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None) -> str:
+        """Prompt → generated text (the tasks.generation.text LM backend)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
+
+        new_bucket = _round_up(max_new_tokens, cfg.new_token_buckets)
+        # P + new_bucket must fit in max_position_embeddings, so prompt
+        # buckets above that cap are unusable for this request.
+        cap = self.model_cfg.max_position_embeddings - new_bucket
+        if cap < 1:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} (bucket {new_bucket}) "
+                f"leaves no room in {self.model_cfg.max_position_embeddings} "
+                "positions")
+        avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
+        max_prompt = avail[-1]
+        ids = self.tokenizer.encode(prompt or "", 1 << 30)
+        ids = ids[-max_prompt:]  # keep the tail: recent context wins
+        if not ids:
+            ids = [getattr(self.tokenizer, "bos_id", 0)]
+        P = _round_up(len(ids), avail)
+
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        prompt_ids = np.full((1, P), pad, np.int32)
+        prompt_ids[0, : len(ids)] = ids
+        prompt_mask = np.zeros((1, P), np.int32)
+        prompt_mask[0, : len(ids)] = 1
+
+        eos_id = getattr(self.tokenizer, "eos_id", -1)
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            t0 = time.perf_counter()
+            tokens, lengths = gpt_mod.generate(
+                self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
+                sub, self.model_cfg, max_new_tokens=new_bucket,
+                temperature=float(temperature), top_k=int(top_k),
+                eos_id=int(eos_id))
+            tokens = np.asarray(tokens)  # materialize → full decode done
+            n = int(np.asarray(lengths)[0])
+            dt = time.perf_counter() - t0
+            self.stats["generate_calls"] += 1
+            self.stats["tokens_generated"] += min(n, max_new_tokens)
+            self.stats["decode_s"] += dt
+        return self.tokenizer.decode(tokens[0, : min(n, max_new_tokens)])
+
+    def warmup(self, new_bucket: Optional[int] = None) -> None:
+        """Pre-compile the hot (prompt, new) executable pair."""
+        self.generate("warmup", new_bucket or self.config.new_token_buckets[0])

@@ -45,6 +45,7 @@ class SymbiontStack:
         self.services: list = []
         self.bus = None
         self.engine = None
+        self.lm = None
         self.vector_store = None
         self.graph_store = None
         self.api: Optional[ApiService] = None
@@ -64,13 +65,23 @@ class SymbiontStack:
         self.vector_store = VectorStore(vs_cfg, mesh=self._mesh)
         self.graph_store = GraphStore(cfg.graph_store)
 
+        lm_generate = None
+        if cfg.lm.enabled:
+            from symbiont_tpu.engine.lm import LmEngine
+
+            self.lm = LmEngine(cfg.lm)
+            lm_generate = self.lm.generate
+
         self.api = ApiService(self.bus, cfg.api, cfg.bus)
         self.services = [
             PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher),
             PreprocessingService(self.bus, self.engine),
             VectorMemoryService(self.bus, self.vector_store),
             KnowledgeGraphService(self.bus, self.graph_store),
-            TextGeneratorService(self.bus),
+            # with the LM backend active, skip Markov ingest training — the
+            # chain would grow unboundedly while never being used to generate
+            TextGeneratorService(self.bus, lm_generate=lm_generate,
+                                 train_on_ingest=lm_generate is None),
         ]
         for s in self.services:
             await s.start()

@@ -1,0 +1,110 @@
+"""LmEngine: byte tokenizer, bucketed decode executables, service wiring."""
+
+import asyncio
+
+import pytest
+
+from symbiont_tpu.config import LmConfig
+from symbiont_tpu.engine.lm import ByteTokenizer, LmEngine, _round_up
+
+TINY = LmConfig(enabled=True, arch="llama", hidden_size=32, num_layers=2,
+                num_heads=4, intermediate_size=64, max_positions=256,
+                dtype="float32", prompt_buckets=[8, 16, 64],
+                new_token_buckets=[8, 16], temperature=0.0)
+
+
+def test_byte_tokenizer_roundtrip():
+    t = ByteTokenizer()
+    for s in ["hello world", "юникод работает", "emoji 🌱 ok", ""]:
+        ids = t.encode(s, 512)
+        assert ids[0] == t.bos_id
+        assert t.decode(ids) == s
+    assert len(t.encode("x" * 100, 8)) == 8
+
+
+def test_round_up():
+    assert _round_up(1, [8, 16]) == 8
+    assert _round_up(9, [8, 16]) == 16
+    assert _round_up(99, [8, 16]) == 16  # clamps at the top bucket
+
+
+def test_generate_deterministic_greedy():
+    lm = LmEngine(TINY)
+    a = lm.generate("seed text", 8)
+    b = lm.generate("seed text", 8)
+    assert isinstance(a, str)
+    assert a == b  # greedy (temperature=0) ignores the advancing key
+    assert lm.stats["generate_calls"] == 2
+    assert lm.stats["tokens_generated"] > 0
+
+
+def test_generate_respects_max_new_tokens():
+    lm = LmEngine(TINY)
+    out = lm.generate("abc", 3)  # bucket rounds to 8, result trimmed to ≤3
+    assert len(out.encode("utf-8", errors="replace")) <= 3
+
+
+def test_prompt_longer_than_top_bucket_truncates():
+    lm = LmEngine(TINY)
+    out = lm.generate("word " * 500, 8)
+    assert isinstance(out, str)
+
+
+def test_prompt_bucket_never_overflows_positions():
+    # regression: P + new_bucket must fit max_positions even when rounding
+    # up would select a bucket past the cap (64-pos model, new bucket 16 →
+    # prompt bucket 64 would overflow; must clamp to 48)
+    cfg = LmConfig(enabled=True, arch="llama", hidden_size=32, num_layers=1,
+                   num_heads=4, intermediate_size=64, max_positions=64,
+                   dtype="float32", prompt_buckets=[8, 16, 64],
+                   new_token_buckets=[16], temperature=0.0)
+    lm = LmEngine(cfg)
+    out = lm.generate("x" * 200, 16)  # 200-byte prompt rounds toward 64
+    assert isinstance(out, str)
+
+    # hard error path: even the smallest new bucket cannot fit the positions
+    small = LmConfig(enabled=True, arch="llama", hidden_size=32, num_layers=1,
+                     num_heads=4, intermediate_size=64, max_positions=8,
+                     dtype="float32", prompt_buckets=[8],
+                     new_token_buckets=[16], temperature=0.0)
+    with pytest.raises(ValueError):
+        LmEngine(small).generate("hi", 16)
+
+
+def test_long_prompt_keeps_tail():
+    # regression: the window fed to the model must be the prompt's TAIL
+    lm = LmEngine(TINY)
+    marker = "ZQX"
+    long_prompt = ("a" * 5000) + marker  # tail marker far past any cap
+    ids = lm.tokenizer.encode(long_prompt, 1 << 30)
+    assert lm.tokenizer.decode(ids[-16:]).endswith(marker)
+    out = lm.generate(long_prompt, 8)
+    assert isinstance(out, str)
+
+
+def test_text_generator_service_uses_lm():
+    from symbiont_tpu import subjects
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.schema import (
+        GeneratedTextMessage,
+        GenerateTextTask,
+        from_json,
+        to_json_bytes,
+    )
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        lm = LmEngine(TINY)
+        svc = TextGeneratorService(bus, lm_generate=lm.generate)
+        await svc.start()
+        sub = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+        task = GenerateTextTask(task_id="t-lm", prompt="hello", max_length=8)
+        await bus.publish(subjects.TASKS_GENERATION_TEXT, to_json_bytes(task))
+        msg = await asyncio.wait_for(sub.__aiter__().__anext__(), timeout=60)
+        out = from_json(GeneratedTextMessage, msg.data)
+        await svc.stop()
+        assert out.original_task_id == "t-lm"
+        assert isinstance(out.generated_text, str)
+
+    asyncio.run(run())
