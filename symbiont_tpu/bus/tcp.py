@@ -150,8 +150,17 @@ class TcpBus:
             self._subs.pop(sid, None)
             if not self._closed and self._writer is not None:
                 body = struct.pack("<BI", OP_UNSUB, sid)
+
+                async def send_unsub() -> None:
+                    # benign if the connection drops before the UNSUB flushes
+                    # (e.g. bus.close() right after a request completes)
+                    try:
+                        await self._send_frame(body)
+                    except (ConnectionError, OSError):
+                        pass
+
                 try:
-                    asyncio.get_running_loop().create_task(self._send_frame(body))
+                    asyncio.get_running_loop().create_task(send_unsub())
                 except RuntimeError:
                     pass  # no loop (interpreter teardown)
 

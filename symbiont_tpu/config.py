@@ -127,6 +127,20 @@ class ParallelConfig:
 
 
 @dataclass
+class RunnerConfig:
+    """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
+
+    "all", or a comma list among: perception, preprocessing, vector_memory,
+    knowledge_graph, text_generator, api, engine. "engine" is the engine.*
+    request-reply plane (services/engine_service.py) that the native C++
+    worker shells call into — a deployment of native workers runs a Python
+    process with just `engine` plus the C++ binaries against the broker.
+    """
+
+    services: str = "all"
+
+
+@dataclass
 class SymbiontConfig:
     bus: BusConfig = field(default_factory=BusConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
@@ -136,6 +150,7 @@ class SymbiontConfig:
     api: ApiConfig = field(default_factory=ApiConfig)
     perception: PerceptionConfig = field(default_factory=PerceptionConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    runner: RunnerConfig = field(default_factory=RunnerConfig)
 
 
 # Reference-era env aliases → (section, field) (reference: .env.example:1-12).

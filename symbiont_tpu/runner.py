@@ -50,43 +50,79 @@ class SymbiontStack:
         self.graph_store = None
         self.api: Optional[ApiService] = None
 
+    KNOWN_SERVICES = {"all", "perception", "preprocessing", "vector_memory",
+                      "knowledge_graph", "text_generator", "api", "engine"}
+
     async def start(self) -> None:
         cfg = self.config
-        self.bus = self._bus_override or await connect(cfg.bus.url)
-        self.engine = self._engine_override or TpuEngine(cfg.engine,
-                                                         mesh=self._mesh)
-        # vector store dim follows the engine's actual hidden size
-        vs_cfg = cfg.vector_store
-        if vs_cfg.dim != self.engine.model_cfg.hidden_size:
-            import dataclasses
+        want = {s.strip() for s in cfg.runner.services.split(",") if s.strip()}
+        unknown = want - self.KNOWN_SERVICES
+        if unknown or not want:
+            raise ValueError(
+                f"unknown service name(s) {sorted(unknown)} in runner.services; "
+                f"known: {sorted(self.KNOWN_SERVICES)}")
 
-            vs_cfg = dataclasses.replace(
-                vs_cfg, dim=self.engine.model_cfg.hidden_size)
-        self.vector_store = VectorStore(vs_cfg, mesh=self._mesh)
-        self.graph_store = GraphStore(cfg.graph_store)
+        def on(name: str) -> bool:
+            return "all" in want or name in want
+
+        self.services = []
+        self.bus = self._bus_override or await connect(cfg.bus.url)
+        if on("preprocessing") or on("engine"):
+            self.engine = self._engine_override or TpuEngine(cfg.engine,
+                                                             mesh=self._mesh)
+        if on("vector_memory") or on("engine"):
+            # vector store dim follows the engine's actual hidden size; in a
+            # standalone vector_memory worker (no engine in-process) the
+            # configured dim must match the remote engine's model
+            vs_cfg = cfg.vector_store
+            if self.engine and vs_cfg.dim != self.engine.model_cfg.hidden_size:
+                import dataclasses
+
+                vs_cfg = dataclasses.replace(
+                    vs_cfg, dim=self.engine.model_cfg.hidden_size)
+            elif self.engine is None:
+                log.warning("vector store dim=%d taken from config "
+                            "(no in-process engine to follow)", vs_cfg.dim)
+            self.vector_store = VectorStore(vs_cfg, mesh=self._mesh)
+        if on("knowledge_graph") or on("engine"):
+            self.graph_store = GraphStore(cfg.graph_store)
 
         lm_generate = None
-        if cfg.lm.enabled:
+        if cfg.lm.enabled and (on("text_generator") or on("engine")):
             from symbiont_tpu.engine.lm import LmEngine
 
             self.lm = LmEngine(cfg.lm)
             lm_generate = self.lm.generate
 
-        self.api = ApiService(self.bus, cfg.api, cfg.bus)
-        self.services = [
-            PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher),
-            PreprocessingService(self.bus, self.engine),
-            VectorMemoryService(self.bus, self.vector_store),
-            KnowledgeGraphService(self.bus, self.graph_store),
+        if on("perception"):
+            self.services.append(
+                PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher))
+        if on("preprocessing"):
+            self.services.append(PreprocessingService(self.bus, self.engine))
+        if on("vector_memory"):
+            self.services.append(VectorMemoryService(self.bus, self.vector_store))
+        if on("knowledge_graph"):
+            self.services.append(KnowledgeGraphService(self.bus, self.graph_store))
+        if on("text_generator"):
             # with the LM backend active, skip Markov ingest training — the
             # chain would grow unboundedly while never being used to generate
-            TextGeneratorService(self.bus, lm_generate=lm_generate,
-                                 train_on_ingest=lm_generate is None),
-        ]
+            self.services.append(
+                TextGeneratorService(self.bus, lm_generate=lm_generate,
+                                     train_on_ingest=lm_generate is None))
+        if on("engine"):
+            from symbiont_tpu.services.engine_service import EngineService
+
+            self.services.append(EngineService(
+                self.bus, engine=self.engine, lm=self.lm,
+                vector_store=self.vector_store, graph_store=self.graph_store))
         for s in self.services:
             await s.start()
-        await self.api.start()
-        log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
+        if on("api"):
+            self.api = ApiService(self.bus, cfg.api, cfg.bus)
+            await self.api.start()
+            log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
+        else:
+            log.info("symbiont stack up (no api): %s", sorted(want))
 
     async def stop(self) -> None:
         if self.api:

@@ -1,0 +1,206 @@
+"""Engine service — the TPU-owning process's bus frontend.
+
+This is the "sun" of the architecture (SURVEY.md §7 design stance): exactly one
+process owns the device (engine + LM + vector store + graph store), and every
+other worker — Python or native C++ — reaches compute and storage through
+request-reply on the `engine.*` subjects. The reference's equivalent decision
+was to put candle *inside* preprocessing_service (reference:
+services/preprocessing_service/src/embedding_generator.rs:9-14), which couples
+every scale-out of the bus workers to a GPU context and creates the
+concurrent-forward hazard SURVEY.md §5.2 documents. Splitting the plane here
+means:
+
+- native C++ shells (native/services/*.cpp) carry the bus/schema/business
+  logic with zero Python in-process;
+- all callers share ONE micro-batching queue in front of the device, so
+  interactive queries and bulk ingest coexist (SURVEY.md §7 hard part #4);
+- engine restart does not restart the pipeline workers (two-plane failure
+  semantics, §7 hard part #6).
+
+Payloads on this plane are plain JSON (framework-internal; the reference wire
+schema from SURVEY.md §1-L3 is untouched). Every reply carries
+`error_message: null | str` — the typed-error-reply convention the reference
+uses on its request-reply paths (reference:
+services/preprocessing_service/src/main.rs:183-196).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.engine.batcher import MicroBatcher
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.schema import TokenizedTextMessage, from_dict
+from symbiont_tpu.services.base import Service
+from symbiont_tpu.utils.telemetry import child_headers, metrics, span
+
+log = logging.getLogger(__name__)
+
+
+def _err(payload: dict) -> bytes:
+    payload.setdefault("error_message", None)
+    return json.dumps(payload).encode()
+
+
+class EngineService(Service):
+    name = "engine"
+
+    def __init__(self, bus, engine: Optional[TpuEngine] = None,
+                 batcher: Optional[MicroBatcher] = None, lm=None,
+                 vector_store=None, graph_store=None):
+        super().__init__(bus)
+        self.engine = engine
+        self.batcher = batcher or (MicroBatcher(engine) if engine else None)
+        self.lm = lm
+        self.vector_store = vector_store
+        self.graph_store = graph_store
+
+    async def start(self) -> None:
+        if self.batcher:
+            await self.batcher.start()
+        await super().start()
+
+    async def stop(self) -> None:
+        await super().stop()
+        if self.batcher:
+            await self.batcher.close()
+
+    async def _setup(self) -> None:
+        q = subjects.QUEUE_ENGINE
+        sub = self._subscribe_loop
+        if self.engine is not None:
+            await sub(subjects.ENGINE_EMBED_BATCH, self._embed_batch, queue=q)
+            await sub(subjects.ENGINE_EMBED_QUERY, self._embed_query, queue=q)
+            if self.engine.cross_params is not None:
+                await sub(subjects.ENGINE_RERANK, self._rerank, queue=q)
+        if self.lm is not None:
+            await sub(subjects.ENGINE_GENERATE, self._generate, queue=q)
+        if self.vector_store is not None:
+            await sub(subjects.ENGINE_VECTOR_UPSERT, self._vec_upsert, queue=q)
+            await sub(subjects.ENGINE_VECTOR_SEARCH, self._vec_search, queue=q)
+        if self.graph_store is not None:
+            await sub(subjects.ENGINE_GRAPH_SAVE, self._graph_save, queue=q)
+        await sub(subjects.ENGINE_HEALTH, self._health, queue=q)
+
+    # ------------------------------------------------------------- plumbing
+
+    async def _reply(self, msg: Msg, payload: dict) -> None:
+        if msg.reply:
+            await self.bus.publish(msg.reply, _err(payload),
+                                   headers=child_headers(msg.headers))
+
+    async def _handle(self, msg: Msg, op: str, fn) -> None:
+        """Decode → run op → reply; typed error reply on any failure."""
+        if not msg.reply:
+            log.warning("engine op %s without reply inbox dropped", op)
+            metrics.inc("engine.no_reply_inbox")
+            return
+        try:
+            req = json.loads(msg.data) if msg.data else {}
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+        except Exception as e:
+            await self._reply(msg, {"error_message": f"bad request: {e}"})
+            return
+        try:
+            with span(f"engine.{op}", msg.headers):
+                payload = await fn(req)
+            metrics.inc(f"engine.{op}")
+        except Exception as e:
+            log.exception("engine op %s failed", op)
+            metrics.inc(f"engine.{op}.failed")
+            payload = {"error_message": str(e)}
+        await self._reply(msg, payload)
+
+    async def _run_blocking(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    # ------------------------------------------------------------- compute
+
+    async def _embed_batch(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            texts = req["texts"]
+            if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
+                raise ValueError("texts must be a list of strings")
+            vecs = await self.batcher.embed(texts)
+            return {"vectors": [[float(x) for x in v] for v in vecs],
+                    "model_name": self.engine.config.model_name}
+        await self._handle(msg, "embed.batch", op)
+
+    async def _embed_query(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            text = req["text"]
+            if not isinstance(text, str):
+                raise ValueError("text must be a string")
+            vecs = await self.batcher.embed([text])
+            return {"vector": [float(x) for x in vecs[0]],
+                    "model_name": self.engine.config.model_name}
+        await self._handle(msg, "embed.query", op)
+
+    async def _rerank(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            scores = await self._run_blocking(
+                self.engine.rerank, req["query"], req["passages"])
+            return {"scores": [float(s) for s in scores]}
+        await self._handle(msg, "rerank", op)
+
+    async def _generate(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            prompt = req.get("prompt") or ""
+            max_new = int(req.get("max_new_tokens", 50))
+            text = await self._run_blocking(
+                self.lm.generate, prompt, max_new)
+            name = self.lm.config.model_dir or f"symbiont-lm/{self.lm.config.arch}"
+            return {"text": text, "model_name": name}
+        await self._handle(msg, "generate", op)
+
+    # ------------------------------------------------------------- storage
+
+    async def _vec_upsert(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            points = [(p["id"], p["vector"], p.get("payload", {}))
+                      for p in req["points"]]
+            n = await self._run_blocking(self.vector_store.upsert, points)
+            return {"upserted": n}
+        await self._handle(msg, "vector.upsert", op)
+
+    async def _vec_search(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            hits = await self._run_blocking(
+                self.vector_store.search, req["vector"], int(req["top_k"]))
+            return {"hits": [{"id": h.id, "score": float(h.score),
+                              "payload": h.payload} for h in hits]}
+        await self._handle(msg, "vector.search", op)
+
+    async def _graph_save(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            m = from_dict(TokenizedTextMessage, req["message"])
+            doc_id = await self._run_blocking(self.graph_store.save_tokenized, m)
+            return {"document_db_id": doc_id}
+        await self._handle(msg, "graph.save", op)
+
+    # -------------------------------------------------------------- health
+
+    async def _health(self, msg: Msg) -> None:
+        async def op(req: dict) -> dict:
+            out = {"ok": True, "backends": {
+                "embed": self.engine is not None,
+                "rerank": bool(self.engine is not None
+                               and self.engine.cross_params is not None),
+                "generate": self.lm is not None,
+                "vector": self.vector_store is not None,
+                "graph": self.graph_store is not None,
+            }}
+            if self.engine is not None:
+                out["embedding_dim"] = self.engine.model_cfg.hidden_size
+                out["model_name"] = self.engine.config.model_name
+                out["stats"] = dict(self.engine.stats)
+            if self.vector_store is not None:
+                out["vector_count"] = self.vector_store.count()
+            return out
+        await self._handle(msg, "health", op)

@@ -38,6 +38,21 @@ ALL_SUBJECTS = [
     TASKS_SEARCH_SEMANTIC_REQUEST,
 ]
 
+# engine plane (framework-internal, not part of the reference's wire surface):
+# request-reply subjects fronting the TPU-owning engine process, so native C++
+# worker shells stay thin (SURVEY.md §2 checklist item 4: "C++ worker talks to
+# it over [RPC]"). Riding the bus instead of a separate RPC port means every
+# engine op gets queue-group fan-in, trace headers, and micro-batching across
+# all callers for free.
+ENGINE_EMBED_BATCH = "engine.embed.batch"
+ENGINE_EMBED_QUERY = "engine.embed.query"
+ENGINE_RERANK = "engine.rerank"
+ENGINE_GENERATE = "engine.generate"
+ENGINE_VECTOR_UPSERT = "engine.vector.upsert"
+ENGINE_VECTOR_SEARCH = "engine.vector.search"
+ENGINE_GRAPH_SAVE = "engine.graph.save"
+ENGINE_HEALTH = "engine.health"
+
 # queue groups: the reference uses plain subscribe() with no queue groups, so a
 # second replica would double-process every message (SURVEY.md §1-L3 notes).
 # Every pipeline consumer here subscribes under a queue group so workers scale
@@ -47,3 +62,4 @@ QUEUE_PREPROCESSING = "q.preprocessing"
 QUEUE_VECTOR_MEMORY = "q.vector_memory"
 QUEUE_KNOWLEDGE_GRAPH = "q.knowledge_graph"
 QUEUE_TEXT_GENERATOR = "q.text_generator"
+QUEUE_ENGINE = "q.engine"

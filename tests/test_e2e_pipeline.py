@@ -152,7 +152,8 @@ def test_ingest_search_generate_roundtrip(stack_config):
 
             # trained-on-ingest: generator saw scraped docs, so vocabulary
             # beyond the seed corpus is reachable
-            assert stack.services[-1].markov.chain  # non-empty
+            textgen = next(s for s in stack.services if s.name == "text_generator")
+            assert textgen.markov.chain  # non-empty
         finally:
             await stack.stop()
 

@@ -1,0 +1,125 @@
+"""EngineService: the engine.* request-reply plane native workers call into.
+
+Covers every op (embed batch/query, generate, vector upsert/search, graph
+save, health) plus the typed-error-reply convention on bad input — the same
+convention the reference uses on its request-reply paths (reference:
+services/preprocessing_service/src/main.rs:183-196).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.inproc import InprocBus
+from symbiont_tpu.config import EngineConfig, GraphStoreConfig, VectorStoreConfig
+from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.graph.store import GraphStore
+from symbiont_tpu.memory.vector_store import VectorStore
+from symbiont_tpu.schema import TokenizedTextMessage, to_json
+from symbiont_tpu.services.engine_service import EngineService
+from symbiont_tpu.utils.ids import current_timestamp_ms
+
+
+def _engine():
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[2, 4], dtype="float32")
+    return TpuEngine(cfg)
+
+
+class _FakeLm:
+    class config:
+        model_dir = None
+        arch = "llama"
+
+    def generate(self, prompt, max_new_tokens, **kw):
+        return f"gen[{prompt}]x{max_new_tokens}"
+
+
+async def _req(bus, subject, payload, timeout=30.0):
+    msg = await bus.request(subject, json.dumps(payload).encode(), timeout)
+    return json.loads(msg.data)
+
+
+def _run(coro):
+    asyncio.run(coro)
+
+
+def test_engine_service_ops(tmp_path):
+    async def scenario():
+        bus = InprocBus()
+        store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path)))
+        graph = GraphStore(GraphStoreConfig(data_dir=str(tmp_path)))
+        svc = EngineService(bus, engine=_engine(), lm=_FakeLm(),
+                            vector_store=store, graph_store=graph)
+        await svc.start()
+        try:
+            # embed batch
+            r = await _req(bus, subjects.ENGINE_EMBED_BATCH,
+                           {"texts": ["hello world", "tpu"]})
+            assert r["error_message"] is None
+            assert len(r["vectors"]) == 2 and len(r["vectors"][0]) == 32
+
+            # embed query matches batch row
+            q = await _req(bus, subjects.ENGINE_EMBED_QUERY, {"text": "hello world"})
+            np.testing.assert_allclose(q["vector"], r["vectors"][0], rtol=1e-5)
+
+            # generate
+            g = await _req(bus, subjects.ENGINE_GENERATE,
+                           {"prompt": "abc", "max_new_tokens": 7})
+            assert g["text"] == "gen[abc]x7"
+
+            # vector upsert + search round-trip
+            up = await _req(bus, subjects.ENGINE_VECTOR_UPSERT, {"points": [
+                {"id": "00000000-0000-0000-0000-000000000001",
+                 "vector": q["vector"], "payload": {"sentence_text": "hello world"}},
+            ]})
+            assert up["upserted"] == 1
+            hits = await _req(bus, subjects.ENGINE_VECTOR_SEARCH,
+                              {"vector": q["vector"], "top_k": 1})
+            assert hits["hits"][0]["payload"]["sentence_text"] == "hello world"
+            assert hits["hits"][0]["score"] == pytest.approx(1.0, abs=1e-3)
+
+            # graph save
+            tok = TokenizedTextMessage(
+                original_id="doc-1", source_url="http://x",
+                tokens=["Hello", "world"], sentences=["Hello world."],
+                timestamp_ms=current_timestamp_ms())
+            gs = await _req(bus, subjects.ENGINE_GRAPH_SAVE,
+                            {"message": json.loads(to_json(tok))})
+            assert gs["error_message"] is None
+            assert graph.get_document("doc-1") is not None
+
+            # health reflects wired backends
+            h = await _req(bus, subjects.ENGINE_HEALTH, {})
+            assert h["ok"] and h["backends"] == {
+                "embed": True, "rerank": False, "generate": True,
+                "vector": True, "graph": True}
+            assert h["embedding_dim"] == 32 and h["vector_count"] == 1
+        finally:
+            await svc.stop()
+
+    _run(scenario())
+
+
+def test_engine_service_error_replies(tmp_path):
+    async def scenario():
+        bus = InprocBus()
+        svc = EngineService(bus, engine=_engine())
+        await svc.start()
+        try:
+            r = await _req(bus, subjects.ENGINE_EMBED_BATCH, {"texts": "nope"})
+            assert "list of strings" in r["error_message"]
+            # non-JSON body
+            msg = await bus.request(subjects.ENGINE_EMBED_QUERY, b"{bad", 10.0)
+            assert "bad request" in json.loads(msg.data)["error_message"]
+            # an op with no backend wired is simply not subscribed: request
+            # times out rather than half-answering
+            with pytest.raises(TimeoutError):
+                await bus.request(subjects.ENGINE_GENERATE, b"{}", 0.2)
+        finally:
+            await svc.stop()
+
+    _run(scenario())
