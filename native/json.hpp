@@ -35,7 +35,7 @@ class Value {
   Value() : type_(Type::Null) {}
   explicit Value(bool b) : type_(Type::Bool), bool_(b) {}
   explicit Value(double n) : type_(Type::Number), num_(n) {}
-  explicit Value(float n) : type_(Type::Number), num_(n), is_f32_(true) {}
+  explicit Value(float n) : type_(Type::Number), is_f32_(true), num_(n) {}
   explicit Value(int n) : type_(Type::Number), num_(n) {}
   explicit Value(const std::string& s) : type_(Type::String), str_(s) {}
   explicit Value(std::string&& s) : type_(Type::String), str_(std::move(s)) {}

@@ -1,0 +1,142 @@
+// Shared shell infrastructure for the native C++ workers.
+//
+// Each worker binary is the C++ equivalent of one reference Rust service
+// (SURVEY.md §2 native-components checklist): env config → bus connect →
+// subscribe under a queue group → handler loop. Compute and storage stay
+// behind the engine.* request-reply plane owned by the Python TPU process
+// (symbiont_tpu/services/engine_service.py), so these shells never link
+// against JAX or any ML runtime.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <map>
+#include <random>
+#include <string>
+
+#include "../symbus/client.hpp"
+
+namespace symbiont {
+
+// ---- subjects (mirror of symbiont_tpu/subjects.py; the reference hardcodes
+// these per service, e.g. reference: services/api_service/src/main.rs:20-24)
+namespace subjects {
+inline const char* TASKS_PERCEIVE_URL = "tasks.perceive.url";
+inline const char* DATA_RAW_TEXT_DISCOVERED = "data.raw_text.discovered";
+inline const char* DATA_TEXT_WITH_EMBEDDINGS = "data.text.with_embeddings";
+inline const char* DATA_PROCESSED_TEXT_TOKENIZED = "data.processed_text.tokenized";
+inline const char* TASKS_GENERATION_TEXT = "tasks.generation.text";
+inline const char* EVENTS_TEXT_GENERATED = "events.text.generated";
+inline const char* TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query";
+inline const char* TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request";
+inline const char* ENGINE_EMBED_BATCH = "engine.embed.batch";
+inline const char* ENGINE_EMBED_QUERY = "engine.embed.query";
+inline const char* ENGINE_GENERATE = "engine.generate";
+inline const char* ENGINE_VECTOR_UPSERT = "engine.vector.upsert";
+inline const char* ENGINE_VECTOR_SEARCH = "engine.vector.search";
+inline const char* ENGINE_GRAPH_SAVE = "engine.graph.save";
+inline const char* Q_PERCEPTION = "q.perception";
+inline const char* Q_PREPROCESSING = "q.preprocessing";
+inline const char* Q_VECTOR_MEMORY = "q.vector_memory";
+inline const char* Q_KNOWLEDGE_GRAPH = "q.knowledge_graph";
+inline const char* Q_TEXT_GENERATOR = "q.text_generator";
+}  // namespace subjects
+
+inline const char* TRACE_HEADER = "X-Trace-Id";
+inline const char* SPAN_HEADER = "X-Span-Id";
+
+inline std::string env_or(const char* key, const std::string& dflt) {
+  const char* v = std::getenv(key);
+  return (v && *v) ? std::string(v) : dflt;
+}
+
+// uuid4 (same shape as the Python side's generate_uuid)
+inline std::string uuid4() {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  uint64_t a = rng(), b = rng();
+  a = (a & 0xffffffffffff0fffULL) | 0x0000000000004000ULL;  // version 4
+  b = (b & 0x3fffffffffffffffULL) | 0x8000000000000000ULL;  // variant 10
+  char out[37];
+  std::snprintf(out, sizeof(out),
+                "%08x-%04x-%04x-%04x-%04x%08x",
+                (uint32_t)(a >> 32), (uint32_t)((a >> 16) & 0xffff),
+                (uint32_t)(a & 0xffff), (uint32_t)(b >> 48),
+                (uint32_t)((b >> 32) & 0xffff), (uint32_t)(b & 0xffffffff));
+  return std::string(out);
+}
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000 + (uint64_t)ts.tv_nsec / 1000000;
+}
+
+// Trace propagation: same trace, fresh span (telemetry.child_headers parity).
+inline std::map<std::string, std::string> child_headers(
+    const std::map<std::string, std::string>& parent) {
+  std::map<std::string, std::string> h;
+  auto it = parent.find(TRACE_HEADER);
+  h[TRACE_HEADER] = it != parent.end() ? it->second : uuid4();
+  h[SPAN_HEADER] = uuid4();
+  return h;
+}
+
+// Structured one-line log: ts level service msg key=value... trace=...
+inline void logline(const char* level, const std::string& service,
+                    const std::string& msg,
+                    const std::map<std::string, std::string>& headers = {}) {
+  auto it = headers.find(TRACE_HEADER);
+  std::fprintf(stderr, "%llu %s %s %s trace=%s\n",
+               (unsigned long long)now_ms(), level, service.c_str(),
+               msg.c_str(),
+               it != headers.end() ? it->second.c_str() : "-");
+}
+
+// Bus URL: symbus://host:port (nats:// accepted as a reference-era alias,
+// same stance as symbiont_tpu/bus/connect.py).
+struct BusAddr {
+  std::string host = "127.0.0.1";
+  int port = 4233;
+};
+
+inline BusAddr parse_bus_url(const std::string& url) {
+  BusAddr a;
+  std::string rest = url;
+  auto scheme = rest.find("://");
+  if (scheme != std::string::npos) rest = rest.substr(scheme + 3);
+  while (!rest.empty() && rest.back() == '/') rest.pop_back();
+  auto colon = rest.rfind(':');
+  if (colon == std::string::npos) {
+    if (!rest.empty()) a.host = rest;
+  } else {
+    if (colon > 0) a.host = rest.substr(0, colon);
+    a.port = std::atoi(rest.c_str() + colon + 1);
+  }
+  return a;
+}
+
+// Connect with retry — the reference's clients retry their backends at
+// startup (e.g. reference: services/vector_memory_service/src/main.rs:505-532,
+// 5 attempts x 5s); workers outliving broker restarts matters more here.
+inline bool connect_with_retry(symbus::Client& c, const std::string& service,
+                               int attempts = 30, int delay_ms = 1000) {
+  BusAddr addr = parse_bus_url(env_or("SYMBIONT_BUS_URL",
+                                      env_or("NATS_URL", "symbus://127.0.0.1:4233")));
+  for (int i = 0; i < attempts; ++i) {
+    try {
+      c.connect(addr.host, addr.port);
+      logline("INFO", service,
+              "connected to bus " + addr.host + ":" + std::to_string(addr.port));
+      return true;
+    } catch (const std::exception& e) {
+      logline("WARN", service, std::string("bus connect failed: ") + e.what());
+      struct timespec ts {delay_ms / 1000, (long)(delay_ms % 1000) * 1000000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  return false;
+}
+
+}  // namespace symbiont

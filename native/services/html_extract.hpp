@@ -1,0 +1,386 @@
+// HTML main-content extraction — native twin of
+// symbiont_tpu/services/html_extract.py; parity with the reference's scraper
+// cascade (reference: services/perception_service/src/main.rs:86-170):
+// 1. first element matching, in order: article, main, div[role='main'],
+//    div.content, div.post-content, div.entry-content, body — else whole doc;
+// 2. within it, for each of h1..h6, p, li, span in that order, each element's
+//    trimmed space-joined text nodes, skipping empties;
+// 3. join with newlines, trim lines, drop empty lines.
+//
+// The parser is a tolerant single-pass tag scanner (no external deps):
+// nearest-matching-open-tag close semantics, void elements, raw-text
+// script/style/noscript/template skipping, comment/doctype skipping, and
+// decoding of the common character references (Python's html.parser decodes
+// all named refs; the long tail of exotic entities passes through verbatim).
+#pragma once
+
+#include <cctype>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace symbiont {
+namespace html {
+
+struct Node {
+  std::string tag;
+  std::map<std::string, std::string> attrs;
+  std::vector<std::unique_ptr<Node>> children;  // ownership
+  // ordered child stream: element (node != nullptr) or text run
+  struct Item {
+    Node* node = nullptr;
+    std::string text;
+  };
+  std::vector<Item> stream;
+};
+
+inline bool is_void_element(const std::string& t) {
+  static const char* kVoid[] = {"area", "base", "br",     "col",  "embed",
+                                "hr",   "img",  "input",  "link", "meta",
+                                "param", "source", "track", "wbr"};
+  for (const char* v : kVoid)
+    if (t == v) return true;
+  return false;
+}
+
+inline bool is_rawtext_element(const std::string& t) {
+  return t == "script" || t == "style" || t == "noscript" || t == "template";
+}
+
+inline std::string decode_entities(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size();) {
+    if (s[i] != '&') {
+      out += s[i++];
+      continue;
+    }
+    size_t semi = s.find(';', i);
+    if (semi == std::string::npos || semi - i > 12) {
+      out += s[i++];
+      continue;
+    }
+    std::string ent = s.substr(i + 1, semi - i - 1);
+    std::string rep;
+    if (ent == "amp") rep = "&";
+    else if (ent == "lt") rep = "<";
+    else if (ent == "gt") rep = ">";
+    else if (ent == "quot") rep = "\"";
+    else if (ent == "apos") rep = "'";
+    else if (ent == "nbsp") rep = "\xc2\xa0";
+    else if (!ent.empty() && ent[0] == '#') {
+      long cp = -1;
+      try {
+        cp = (ent.size() > 1 && (ent[1] == 'x' || ent[1] == 'X'))
+                 ? std::stol(ent.substr(2), nullptr, 16)
+                 : std::stol(ent.substr(1));
+      } catch (...) {
+      }
+      if (cp >= 0 && cp <= 0x10ffff) {  // encode UTF-8
+        if (cp < 0x80) rep += (char)cp;
+        else if (cp < 0x800) {
+          rep += (char)(0xc0 | (cp >> 6));
+          rep += (char)(0x80 | (cp & 0x3f));
+        } else if (cp < 0x10000) {
+          rep += (char)(0xe0 | (cp >> 12));
+          rep += (char)(0x80 | ((cp >> 6) & 0x3f));
+          rep += (char)(0x80 | (cp & 0x3f));
+        } else {
+          rep += (char)(0xf0 | (cp >> 18));
+          rep += (char)(0x80 | ((cp >> 12) & 0x3f));
+          rep += (char)(0x80 | ((cp >> 6) & 0x3f));
+          rep += (char)(0x80 | (cp & 0x3f));
+        }
+      }
+    }
+    if (rep.empty() && !(ent == "#0")) {
+      out += s[i++];  // unknown entity: pass through verbatim
+    } else {
+      out += rep;
+      i = semi + 1;
+    }
+  }
+  return out;
+}
+
+inline std::string ascii_lower(std::string s) {
+  for (auto& c : s) c = (char)std::tolower((unsigned char)c);
+  return s;
+}
+
+class Parser {
+ public:
+  std::unique_ptr<Node> parse(const std::string& src) {
+    auto root = std::make_unique<Node>();
+    root->tag = "#document";
+    stack_.clear();
+    stack_.push_back(root.get());
+    size_t i = 0;
+    const size_t n = src.size();
+    while (i < n) {
+      if (src[i] == '<') {
+        if (src.compare(i, 4, "<!--") == 0) {
+          size_t end = src.find("-->", i + 4);
+          i = end == std::string::npos ? n : end + 3;
+          continue;
+        }
+        if (i + 1 < n && (src[i + 1] == '!' || src[i + 1] == '?')) {
+          size_t end = src.find('>', i);
+          i = end == std::string::npos ? n : end + 1;
+          continue;
+        }
+        if (i + 1 < n && src[i + 1] == '/') {
+          size_t end = src.find('>', i);
+          if (end == std::string::npos) break;
+          std::string tag = ascii_lower(trim(src.substr(i + 2, end - i - 2)));
+          close_tag(tag);
+          i = end + 1;
+          continue;
+        }
+        // open tag
+        size_t end = find_tag_end(src, i);
+        if (end == std::string::npos) {  // stray '<' at EOF: treat as text
+          append_text(src.substr(i));
+          break;
+        }
+        bool self_close = end >= 2 && src[end - 1] == '/';
+        parse_open_tag(src.substr(i + 1, end - i - 1 - (self_close ? 1 : 0)),
+                       self_close);
+        i = end + 1;
+        // raw-text elements: consume until the matching close tag
+        if (!stack_.empty() && is_rawtext_element(stack_.back()->tag) &&
+            !self_close) {
+          std::string closer = "</" + stack_.back()->tag;
+          size_t close_at = find_ci(src, closer, i);
+          size_t gt = close_at == std::string::npos
+                          ? std::string::npos
+                          : src.find('>', close_at);
+          // raw text content is intentionally dropped (SKIP_TEXT_IN)
+          close_tag(stack_.back()->tag);
+          i = gt == std::string::npos ? n : gt + 1;
+        }
+        continue;
+      }
+      size_t next = src.find('<', i);
+      if (next == std::string::npos) next = n;
+      append_text(src.substr(i, next - i));
+      i = next;
+    }
+    return root;
+  }
+
+ private:
+  static std::string trim(const std::string& s) {
+    size_t b = s.find_first_not_of(" \t\r\n\f\v");
+    if (b == std::string::npos) return "";
+    size_t e = s.find_last_not_of(" \t\r\n\f\v");
+    return s.substr(b, e - b + 1);
+  }
+
+  // '>' inside quoted attribute values does not end the tag
+  static size_t find_tag_end(const std::string& s, size_t start) {
+    char quote = 0;
+    for (size_t i = start + 1; i < s.size(); ++i) {
+      char c = s[i];
+      if (quote) {
+        if (c == quote) quote = 0;
+      } else if (c == '"' || c == '\'') {
+        quote = c;
+      } else if (c == '>') {
+        return i;
+      }
+    }
+    return std::string::npos;
+  }
+
+  static size_t find_ci(const std::string& hay, const std::string& needle,
+                        size_t from) {
+    if (needle.empty()) return from;
+    for (size_t i = from; i + needle.size() <= hay.size(); ++i) {
+      size_t j = 0;
+      while (j < needle.size() &&
+             std::tolower((unsigned char)hay[i + j]) ==
+                 std::tolower((unsigned char)needle[j]))
+        ++j;
+      if (j == needle.size()) return i;
+    }
+    return std::string::npos;
+  }
+
+  void parse_open_tag(const std::string& body, bool self_close) {
+    size_t i = 0;
+    const size_t n = body.size();
+    while (i < n && !std::isspace((unsigned char)body[i])) ++i;
+    std::string tag = ascii_lower(body.substr(0, i));
+    if (tag.empty()) return;
+    auto node = std::make_unique<Node>();
+    node->tag = tag;
+    // attributes
+    while (i < n) {
+      while (i < n && std::isspace((unsigned char)body[i])) ++i;
+      if (i >= n) break;
+      size_t name_start = i;
+      while (i < n && !std::isspace((unsigned char)body[i]) && body[i] != '=')
+        ++i;
+      std::string name = ascii_lower(body.substr(name_start, i - name_start));
+      while (i < n && std::isspace((unsigned char)body[i])) ++i;
+      std::string value;
+      if (i < n && body[i] == '=') {
+        ++i;
+        while (i < n && std::isspace((unsigned char)body[i])) ++i;
+        if (i < n && (body[i] == '"' || body[i] == '\'')) {
+          char q = body[i++];
+          size_t vstart = i;
+          while (i < n && body[i] != q) ++i;
+          value = body.substr(vstart, i - vstart);
+          if (i < n) ++i;
+        } else {
+          size_t vstart = i;
+          while (i < n && !std::isspace((unsigned char)body[i])) ++i;
+          value = body.substr(vstart, i - vstart);
+        }
+      }
+      if (!name.empty()) node->attrs[name] = decode_entities(value);
+    }
+    Node* raw = node.get();
+    stack_.back()->stream.push_back({raw, ""});
+    stack_.back()->children.push_back(std::move(node));
+    if (!self_close && !is_void_element(tag)) stack_.push_back(raw);
+  }
+
+  void close_tag(const std::string& tag) {
+    // close the nearest matching open tag (tolerant of malformed HTML)
+    for (size_t i = stack_.size(); i-- > 1;) {
+      if (stack_[i]->tag == tag) {
+        stack_.resize(i);
+        return;
+      }
+    }
+  }
+
+  void append_text(const std::string& raw) {
+    if (raw.empty()) return;
+    stack_.back()->stream.push_back({nullptr, decode_entities(raw)});
+  }
+
+  std::vector<Node*> stack_;
+};
+
+// ---- selector support: tag | tag.class | tag[attr='value'] -----------------
+
+inline bool matches(const Node& node, const std::string& selector) {
+  auto br = selector.find('[');
+  if (br != std::string::npos) {
+    std::string tag = selector.substr(0, br);
+    std::string rest = selector.substr(br + 1);
+    if (!rest.empty() && rest.back() == ']') rest.pop_back();
+    auto eq = rest.find('=');
+    if (eq == std::string::npos) return false;
+    std::string attr = rest.substr(0, eq);
+    std::string value = rest.substr(eq + 1);
+    while (!value.empty() && (value.front() == '\'' || value.front() == '"'))
+      value.erase(value.begin());
+    while (!value.empty() && (value.back() == '\'' || value.back() == '"'))
+      value.pop_back();
+    auto it = node.attrs.find(attr);
+    return node.tag == tag && it != node.attrs.end() && it->second == value;
+  }
+  auto dot = selector.find('.');
+  if (dot != std::string::npos) {
+    std::string tag = selector.substr(0, dot);
+    std::string cls = selector.substr(dot + 1);
+    if (node.tag != tag) return false;
+    auto it = node.attrs.find("class");
+    if (it == node.attrs.end()) return false;
+    std::istringstream in(it->second);
+    std::string c;
+    while (in >> c)
+      if (c == cls) return true;
+    return false;
+  }
+  return node.tag == selector;
+}
+
+inline void walk(Node& node, const std::string& selector,
+                 std::vector<Node*>& out) {
+  for (auto& item : node.stream) {
+    if (item.node) {
+      if (matches(*item.node, selector)) out.push_back(item.node);
+      walk(*item.node, selector, out);
+    }
+  }
+}
+
+inline Node* find_first(Node& root, const std::string& selector) {
+  std::vector<Node*> out;
+  walk(root, selector, out);
+  return out.empty() ? nullptr : out.front();
+}
+
+inline void collect_text(const Node& node, std::vector<std::string>& parts) {
+  if (is_rawtext_element(node.tag)) return;
+  for (const auto& item : node.stream) {
+    if (item.node) collect_text(*item.node, parts);
+    else parts.push_back(item.text);
+  }
+}
+
+inline std::string trim_copy(const std::string& s) {
+  size_t b = s.find_first_not_of(" \t\r\n\f\v");
+  if (b == std::string::npos) return "";
+  size_t e = s.find_last_not_of(" \t\r\n\f\v");
+  return s.substr(b, e - b + 1);
+}
+
+// Trimmed text nodes joined with single spaces (reference main.rs:133-142).
+inline std::string element_text(const Node& node) {
+  std::vector<std::string> raw;
+  collect_text(node, raw);
+  std::string out;
+  for (auto& t : raw) {
+    std::string p = trim_copy(t);
+    if (p.empty()) continue;
+    if (!out.empty()) out += ' ';
+    out += p;
+  }
+  return out;
+}
+
+// Full cascade (reference main.rs:100-160).
+inline std::string extract_main_text(const std::string& src) {
+  static const char* kContentSelectors[] = {
+      "article", "main", "div[role='main']", "div.content",
+      "div.post-content", "div.entry-content", "body"};
+  static const char* kTextSelectors[] = {"h1", "h2", "h3", "h4", "h5",
+                                         "h6", "p",  "li", "span"};
+  Parser parser;
+  auto doc = parser.parse(src);
+  Node* scope = nullptr;
+  for (const char* sel : kContentSelectors) {
+    scope = find_first(*doc, sel);
+    if (scope) break;
+  }
+  if (!scope) scope = doc.get();
+  std::vector<std::string> parts;
+  for (const char* sel : kTextSelectors) {
+    std::vector<Node*> els;
+    walk(*scope, sel, els);
+    for (Node* el : els) {
+      std::string text = element_text(*el);
+      if (!text.empty()) parts.push_back(text);
+    }
+  }
+  std::string out;
+  for (auto& p : parts) {
+    std::string line = trim_copy(p);
+    if (line.empty()) continue;
+    if (!out.empty()) out += '\n';
+    out += line;
+  }
+  return out;
+}
+
+}  // namespace html
+}  // namespace symbiont

@@ -1,0 +1,248 @@
+// perception worker — C++ equivalent of the reference's perception_service
+// (SURVEY.md §2 checklist item 2; reference:
+// services/perception_service/src/main.rs): consumes PerceiveUrlTask,
+// fetches the page with a 15s budget + custom UA (main.rs:89-94), extracts
+// main content via the selector cascade (html_extract.hpp), publishes
+// RawTextMessage to data.raw_text.discovered (main.rs:67-69). Empty
+// extractions and fetch failures are dropped with a warning
+// (scrape_and_publish, main.rs:15-84).
+//
+// The fetcher is a raw-socket HTTP/1.1 client (the toolchain image ships no
+// libcurl/OpenSSL headers): plain http:// is fetched natively with redirect
+// following; https:// URLs are reported as unsupported by this worker — route
+// TLS targets to the Python perception service, or terminate TLS at a proxy
+// (SYMBIONT_HTTP_PROXY) the same way the reference delegates TLS to reqwest.
+//
+// Usage: perception [SYMBIONT_BUS_URL=...]
+
+#include <string>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+#include "html_extract.hpp"
+
+namespace {
+
+const char* SERVICE = "perception";
+
+struct Url {
+  std::string host;
+  int port = 80;
+  std::string path = "/";
+};
+
+bool parse_http_url(const std::string& url, Url& out, std::string& err) {
+  if (url.rfind("https://", 0) == 0) {
+    err = "https is not supported by the native fetcher (no TLS runtime); "
+          "set SYMBIONT_HTTP_PROXY or use the Python perception service";
+    return false;
+  }
+  if (url.rfind("http://", 0) != 0) {
+    err = "unsupported scheme (need http://)";
+    return false;
+  }
+  std::string rest = url.substr(7);
+  auto slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  auto colon = hostport.rfind(':');
+  if (colon == std::string::npos) {
+    out.host = hostport;
+    out.port = 80;
+  } else {
+    out.host = hostport.substr(0, colon);
+    out.port = std::atoi(hostport.c_str() + colon + 1);
+  }
+  if (out.host.empty()) {
+    err = "empty host";
+    return false;
+  }
+  return true;
+}
+
+// Minimal HTTP/1.1 GET with Content-Length / close-delimited bodies and
+// chunked transfer decoding; follows up to 5 redirects. deadline_ms caps the
+// whole scrape (reference: 15s total budget, main.rs:89-91).
+std::string http_get(const std::string& url, const std::string& user_agent,
+                     int64_t deadline_ms, int redirects_left = 5) {
+  Url u;
+  std::string err;
+  // proxy mode: send the absolute URL through a forward proxy
+  std::string proxy = symbiont::env_or("SYMBIONT_HTTP_PROXY", "");
+  std::string target_url = url;
+  if (!proxy.empty()) {
+    if (!parse_http_url(proxy, u, err))
+      throw std::runtime_error("bad proxy url: " + err);
+  } else if (!parse_http_url(url, u, err)) {
+    throw std::runtime_error(err);
+  }
+
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = ::getaddrinfo(u.host.c_str(), std::to_string(u.port).c_str(),
+                         &hints, &res);
+  if (rc != 0)
+    throw std::runtime_error("resolve " + u.host + ": " + gai_strerror(rc));
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect failed: " + u.host);
+
+  auto remaining = [&]() -> int {
+    int64_t left = deadline_ms - (int64_t)symbiont::now_ms();
+    return left < 0 ? 0 : (int)left;
+  };
+  struct FdGuard {
+    int fd;
+    ~FdGuard() { ::close(fd); }
+  } guard{fd};
+
+  std::string path_or_url = proxy.empty() ? u.path : target_url;
+  Url host_of;
+  if (!proxy.empty()) parse_http_url(target_url, host_of, err);
+  const Url& hu = proxy.empty() ? u : host_of;
+  std::string req = "GET " + path_or_url + " HTTP/1.1\r\nHost: " + hu.host +
+                    "\r\nUser-Agent: " + user_agent +
+                    "\r\nAccept: text/html\r\nConnection: close\r\n\r\n";
+  size_t off = 0;
+  while (off < req.size()) {
+    ssize_t n = ::send(fd, req.data() + off, req.size() - off, 0);
+    if (n <= 0) throw std::runtime_error("send failed");
+    off += (size_t)n;
+  }
+
+  std::string buf;
+  char chunk[65536];
+  for (;;) {
+    struct pollfd p {fd, POLLIN, 0};
+    int wait = remaining();
+    if (wait <= 0) throw std::runtime_error("scrape timeout");
+    int prc = ::poll(&p, 1, wait);
+    if (prc == 0) throw std::runtime_error("scrape timeout");
+    if (prc < 0) {
+      if (errno == EINTR) continue;
+      throw std::runtime_error("poll failed");
+    }
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n < 0) throw std::runtime_error("recv failed");
+    if (n == 0) break;
+    buf.append(chunk, (size_t)n);
+    if (buf.size() > 32 * 1024 * 1024) throw std::runtime_error("response too large");
+  }
+
+  auto hdr_end = buf.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) throw std::runtime_error("bad http response");
+  std::string headers = buf.substr(0, hdr_end);
+  std::string body = buf.substr(hdr_end + 4);
+
+  // status line
+  auto sp = headers.find(' ');
+  int status = sp == std::string::npos ? 0 : std::atoi(headers.c_str() + sp + 1);
+
+  // header lookup (case-insensitive)
+  auto header_value = [&](const std::string& name) -> std::string {
+    std::string low = symbiont::html::ascii_lower(headers);
+    std::string needle = "\r\n" + symbiont::html::ascii_lower(name) + ":";
+    auto at = low.find(needle);
+    if (at == std::string::npos) return "";
+    auto vstart = at + needle.size();
+    auto vend = low.find("\r\n", vstart);
+    std::string v = headers.substr(vstart, vend - vstart);
+    return symbiont::html::trim_copy(v);
+  };
+
+  if (status >= 301 && status <= 308 && status != 304) {
+    if (redirects_left <= 0) throw std::runtime_error("too many redirects");
+    std::string loc = header_value("Location");
+    if (loc.empty()) throw std::runtime_error("redirect without Location");
+    if (loc.rfind("http", 0) != 0) {  // relative redirect
+      loc = "http://" + hu.host +
+            (hu.port != 80 ? ":" + std::to_string(hu.port) : "") +
+            (loc[0] == '/' ? loc : "/" + loc);
+    }
+    return http_get(loc, user_agent, deadline_ms, redirects_left - 1);
+  }
+  if (status < 200 || status >= 300)
+    throw std::runtime_error("http status " + std::to_string(status));
+
+  if (symbiont::html::ascii_lower(header_value("Transfer-Encoding"))
+          .find("chunked") != std::string::npos) {
+    std::string decoded;
+    size_t i = 0;
+    while (i < body.size()) {
+      auto eol = body.find("\r\n", i);
+      if (eol == std::string::npos) break;
+      long len = std::strtol(body.c_str() + i, nullptr, 16);
+      if (len <= 0) break;
+      decoded.append(body, eol + 2, (size_t)len);
+      i = eol + 2 + (size_t)len + 2;
+    }
+    return decoded;
+  }
+  return body;
+}
+
+}  // namespace
+
+int main() {
+  int timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_PERCEPTION_SCRAPE_TIMEOUT_S", "15").c_str()));
+  std::string user_agent = symbiont::env_or(
+      "SYMBIONT_PERCEPTION_USER_AGENT", "SymbiontTPU/0.1 (+research crawler)");
+
+  symbus::Client bus;
+  if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
+  uint32_t sid = bus.subscribe(symbiont::subjects::TASKS_PERCEIVE_URL,
+                               symbiont::subjects::Q_PERCEPTION);
+  symbiont::logline("INFO", SERVICE, "ready");
+
+  while (bus.connected()) {
+    auto msg = bus.next(1000);
+    if (!msg || msg->sid != sid) continue;
+    symbiont::PerceiveUrlTask task;
+    try {
+      task = symbiont::PerceiveUrlTask::parse(msg->data);
+    } catch (const std::exception& e) {
+      symbiont::logline("WARN", SERVICE,
+                        std::string("bad perceive task: ") + e.what(),
+                        msg->headers);
+      continue;
+    }
+    std::string html;
+    try {
+      html = http_get(task.url, user_agent,
+                      (int64_t)symbiont::now_ms() + timeout_ms);
+    } catch (const std::exception& e) {
+      symbiont::logline("WARN", SERVICE,
+                        "scrape failed for " + task.url + ": " + e.what(),
+                        msg->headers);
+      continue;
+    }
+    std::string text = symbiont::html::extract_main_text(html);
+    if (text.empty()) {
+      symbiont::logline("WARN", SERVICE,
+                        "no meaningful text extracted from " + task.url,
+                        msg->headers);
+      continue;
+    }
+    symbiont::RawTextMessage out;
+    out.id = symbiont::uuid4();
+    out.source_url = task.url;
+    out.raw_text = text;
+    out.timestamp_ms = symbiont::now_ms();
+    bus.publish(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED,
+                out.to_json_string(), "", symbiont::child_headers(msg->headers));
+    symbiont::logline("INFO", SERVICE, "published raw text for " + task.url,
+                      msg->headers);
+  }
+  symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
+  return 0;
+}

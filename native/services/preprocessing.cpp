@@ -1,0 +1,156 @@
+// preprocessing worker — C++ shell of the reference's preprocessing_service
+// (SURVEY.md §2 checklist item 3; reference:
+// services/preprocessing_service/src/main.rs), with the tensor compute
+// relocated to the TPU engine process behind engine.embed.* request-reply
+// (checklist item 4: the shell never touches the device).
+//
+// Two roles, same as the reference:
+// 1. pipeline: data.raw_text.discovered → clean/split (native, textproc.hpp)
+//    → engine.embed.batch → data.text.with_embeddings (main.rs:126-171);
+//    plus the un-orphaned data.processed_text.tokenized publish
+//    (SURVEY.md fact #3 — the reference's CHANGELOG.md:57-60 left it dead).
+// 2. query embedding request-reply on tasks.embedding.for_query with typed
+//    error replies even on undecodable input (main.rs:173-298).
+//
+// Usage: preprocessing [SYMBIONT_BUS_URL=...] [SYMBIONT_ENGINE_TIMEOUT_MS=...]
+
+#include <string>
+#include <vector>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+#include "textproc.hpp"
+
+namespace {
+
+const char* SERVICE = "preprocessing";
+
+struct EngineError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// engine.embed.batch / engine.embed.query → (vectors, model_name)
+std::pair<std::vector<std::vector<float>>, std::string> embed_batch(
+    symbus::Client& bus, const std::vector<std::string>& texts, int timeout_ms,
+    const std::map<std::string, std::string>& headers) {
+  json::Value req = json::Value::object();
+  req.set("texts", json::to_array(texts, [](const std::string& t) {
+    return json::Value(t);
+  }));
+  auto reply = bus.request(symbiont::subjects::ENGINE_EMBED_BATCH, req.dump(),
+                           timeout_ms, headers);
+  if (!reply) throw EngineError("engine.embed.batch timed out");
+  json::Value r = json::parse(reply->data);
+  if (!r.at("error_message").is_null())
+    throw EngineError("engine error: " + r.at("error_message").as_string());
+  std::vector<std::vector<float>> vectors;
+  for (const auto& row : r.at("vectors").as_array()) {
+    std::vector<float> v;
+    v.reserve(row.as_array().size());
+    for (const auto& x : row.as_array()) v.push_back((float)x.as_number());
+    vectors.push_back(std::move(v));
+  }
+  return {std::move(vectors), r.at("model_name").as_string()};
+}
+
+}  // namespace
+
+int main() {
+  int engine_timeout_ms =
+      std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
+
+  symbus::Client bus;
+  if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
+
+  uint32_t sid_raw = bus.subscribe(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED,
+                                   symbiont::subjects::Q_PREPROCESSING);
+  uint32_t sid_query = bus.subscribe(symbiont::subjects::TASKS_EMBEDDING_FOR_QUERY,
+                                     symbiont::subjects::Q_PREPROCESSING);
+  symbiont::logline("INFO", SERVICE, "ready");
+
+  while (bus.connected()) {
+    auto msg = bus.next(1000);
+    if (!msg) continue;
+
+    // ------------------------------------------------------------ pipeline
+    if (msg->sid == sid_raw) {
+      symbiont::RawTextMessage raw;
+      try {
+        raw = symbiont::RawTextMessage::parse(msg->data);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("bad raw-text message: ") + e.what(),
+                          msg->headers);
+        continue;
+      }
+      std::string cleaned = symbiont::clean_text(raw.raw_text);
+      if (cleaned.empty()) {
+        // empty cleaned text is an error at this stage (main.rs:33-39)
+        symbiont::logline("WARN", SERVICE, "cleaned text empty for id " + raw.id,
+                          msg->headers);
+        continue;
+      }
+      auto sentences = symbiont::split_sentences(cleaned);
+      auto headers = symbiont::child_headers(msg->headers);
+      try {
+        auto [vectors, model_name] =
+            embed_batch(bus, sentences, engine_timeout_ms, headers);
+        symbiont::TextWithEmbeddingsMessage out;
+        out.original_id = raw.id;
+        out.source_url = raw.source_url;
+        out.model_name = model_name;
+        out.timestamp_ms = symbiont::now_ms();
+        for (size_t i = 0; i < sentences.size(); ++i) {
+          symbiont::SentenceEmbedding se;
+          se.sentence_text = sentences[i];
+          se.embedding = vectors[i];
+          out.embeddings_data.push_back(std::move(se));
+        }
+        bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                    out.to_json_string(), "", headers);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("embed failed: ") + e.what(), headers);
+        continue;
+      }
+      // un-orphaned knowledge-graph feed (SURVEY.md fact #3)
+      symbiont::TokenizedTextMessage tok;
+      tok.original_id = raw.id;
+      tok.source_url = raw.source_url;
+      tok.tokens = symbiont::tokenize_words(cleaned);
+      tok.sentences = sentences;
+      tok.timestamp_ms = symbiont::now_ms();
+      bus.publish(symbiont::subjects::DATA_PROCESSED_TEXT_TOKENIZED,
+                  tok.to_json_string(), "", headers);
+      continue;
+    }
+
+    // ----------------------------------------------------- query embedding
+    if (msg->sid == sid_query) {
+      if (msg->reply.empty()) {
+        symbiont::logline("WARN", SERVICE, "query task without reply inbox",
+                          msg->headers);
+        continue;
+      }
+      symbiont::QueryEmbeddingResult result;
+      try {
+        auto task = symbiont::QueryForEmbeddingTask::parse(msg->data);
+        result.request_id = task.request_id;
+        auto headers = symbiont::child_headers(msg->headers);
+        auto [vectors, model_name] =
+            embed_batch(bus, {task.text_to_embed}, engine_timeout_ms, headers);
+        result.embedding = vectors.at(0);
+        result.model_name = model_name;
+      } catch (const std::exception& e) {
+        // typed error reply even on deserialize failure (main.rs:183-196)
+        if (result.request_id.empty()) result.request_id = "unknown";
+        result.error_message = e.what();
+      }
+      bus.publish(msg->reply, result.to_json_string(), "",
+                  symbiont::child_headers(msg->headers));
+      continue;
+    }
+  }
+  symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
+  return 0;
+}

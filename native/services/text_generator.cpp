@@ -1,0 +1,178 @@
+// text_generator worker — C++ equivalent of the reference's
+// text_generator_service (SURVEY.md §2 checklist item 7; reference:
+// services/text_generator_service/src/main.rs).
+//
+// Markov backend runs fully native (order-1 word chain, behavioral parity
+// with reference main.rs:13-109 — see MarkovModel below), trained
+// continuously on every ingested document instead of the reference's one
+// hardcoded boot sentence (main.rs:169-174). With
+// SYMBIONT_TEXTGEN_BACKEND=lm the worker instead forwards the prompt to the
+// TPU decoder LM over the engine.generate request-reply plane.
+//
+// Usage: text_generator [SYMBIONT_BUS_URL=symbus://host:port]
+
+#include <cstdint>
+#include <map>
+#include <random>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+
+namespace {
+
+const char* SERVICE = "text_generator";
+
+// the reference's single hardcoded training sentence (main.rs:170) — kept as
+// the cold-start corpus so an empty system still generates
+const char* SEED_CORPUS =
+    "Это первое предложение для обучения нашей марковской модели оно простое";
+
+std::vector<std::string> split_ws(const std::string& text) {
+  std::vector<std::string> out;
+  std::istringstream in(text);
+  std::string w;
+  while (in >> w) out.push_back(w);
+  return out;
+}
+
+// Order-1 word-level Markov chain; parity with the reference
+// (main.rs:29-108) and the Python twin (symbiont_tpu/models/markov.py):
+// - <2 words: record starter only; starters are sorted + deduped after every
+//   train; transitions are a multiset (duplicates weight the walk);
+// - generate: uniform starter, then up to max_length-1 uniform successor
+//   picks, stopping at a dead end; untrained → "Model not trained."
+class MarkovModel {
+ public:
+  void train(const std::string& text) {
+    auto words = split_ws(text);
+    if (words.empty()) return;
+    starters_.insert(words[0]);
+    if (words.size() < 2) return;
+    for (size_t i = 0; i + 1 < words.size(); ++i)
+      chain_[words[i]].push_back(words[i + 1]);
+  }
+
+  std::string generate(uint64_t max_length) {
+    if (chain_.empty() || starters_.empty()) return "Model not trained.";
+    std::vector<std::string> starters(starters_.begin(), starters_.end());
+    std::string current = starters[pick(starters.size())];
+    std::string out = current;
+    for (uint64_t i = 1; i < max_length; ++i) {
+      auto it = chain_.find(current);
+      if (it == chain_.end() || it->second.empty()) break;
+      current = it->second[pick(it->second.size())];
+      out += " ";
+      out += current;
+    }
+    return out;
+  }
+
+  size_t chain_size() const { return chain_.size(); }
+
+ private:
+  size_t pick(size_t n) {
+    std::uniform_int_distribution<size_t> d(0, n - 1);
+    return d(rng_);
+  }
+  std::map<std::string, std::vector<std::string>> chain_;
+  std::set<std::string> starters_;  // ordered == reference's sort+dedup
+  std::mt19937_64 rng_{std::random_device{}()};
+};
+
+}  // namespace
+
+int main() {
+  bool lm_backend = symbiont::env_or("SYMBIONT_TEXTGEN_BACKEND", "markov") == "lm";
+  int engine_timeout_ms =
+      std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
+  MarkovModel markov;
+  markov.train(SEED_CORPUS);
+
+  symbus::Client bus;
+  if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
+
+  uint32_t sid_gen = bus.subscribe(symbiont::subjects::TASKS_GENERATION_TEXT,
+                                   symbiont::subjects::Q_TEXT_GENERATOR);
+  // continuous learning from the pipeline (no queue group: every generator
+  // replica learns the full stream) — skipped in LM mode where the chain
+  // would grow unboundedly while never generating
+  uint32_t sid_train = 0;
+  if (!lm_backend)
+    sid_train = bus.subscribe(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED);
+
+  symbiont::logline("INFO", SERVICE,
+                    lm_backend ? "ready (backend=lm)" : "ready (backend=markov)");
+
+  while (bus.connected()) {
+    auto msg = bus.next(1000);
+    if (!msg) continue;
+    if (sid_train != 0 && msg->sid == sid_train) {
+      try {
+        auto raw = symbiont::RawTextMessage::parse(msg->data);
+        markov.train(raw.raw_text);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("bad raw-text message: ") + e.what(),
+                          msg->headers);
+      }
+      continue;
+    }
+    if (msg->sid != sid_gen) continue;
+
+    symbiont::GenerateTextTask task;
+    try {
+      task = symbiont::GenerateTextTask::parse(msg->data);
+    } catch (const std::exception& e) {
+      symbiont::logline("WARN", SERVICE,
+                        std::string("bad generate task: ") + e.what(),
+                        msg->headers);
+      continue;
+    }
+
+    std::string text;
+    if (lm_backend) {
+      json::Value req = json::Value::object();
+      req.set("prompt", task.prompt ? json::Value(*task.prompt) : json::Value());
+      req.set("max_new_tokens", json::Value((double)task.max_length));
+      auto reply = bus.request(symbiont::subjects::ENGINE_GENERATE, req.dump(),
+                               engine_timeout_ms,
+                               symbiont::child_headers(msg->headers));
+      if (!reply) {
+        symbiont::logline("WARN", SERVICE, "engine.generate timed out",
+                          msg->headers);
+        continue;
+      }
+      try {
+        json::Value r = json::parse(reply->data);
+        if (!r.at("error_message").is_null()) {
+          symbiont::logline("WARN", SERVICE,
+                            "engine error: " + r.at("error_message").as_string(),
+                            msg->headers);
+          continue;
+        }
+        text = r.at("text").as_string();
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("bad engine reply: ") + e.what(),
+                          msg->headers);
+        continue;
+      }
+    } else {
+      // the reference accepts but ignores the prompt (main.rs:120-123 TODO)
+      text = markov.generate(task.max_length);
+    }
+
+    symbiont::GeneratedTextMessage out;
+    out.original_task_id = task.task_id;
+    out.generated_text = text;
+    out.timestamp_ms = symbiont::now_ms();
+    bus.publish(symbiont::subjects::EVENTS_TEXT_GENERATED,
+                out.to_json_string(), "", symbiont::child_headers(msg->headers));
+  }
+  symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
+  return 0;
+}

@@ -1,0 +1,64 @@
+// Text cleaning / sentence splitting / word tokenization — native twin of
+// symbiont_tpu/engine/text.py, behavioral parity with the reference's
+// preprocessing core (reference: services/preprocessing_service/src/main.rs:28-70).
+//
+// The delimiters '.', '?', '!' are ASCII, and in UTF-8 no continuation byte
+// can equal an ASCII byte, so byte-wise scanning is codepoint-safe — the
+// multi-byte-slicing hazard SURVEY.md §4 flags in the reference cannot occur.
+#pragma once
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace symbiont {
+
+inline std::string clean_text(const std::string& raw) {
+  std::istringstream in(raw);
+  std::string w, out;
+  while (in >> w) {
+    if (!out.empty()) out += ' ';
+    out += w;
+  }
+  return out;
+}
+
+inline std::string trim_ws(const std::string& s) {
+  size_t b = s.find_first_not_of(" \t\r\n\f\v");
+  if (b == std::string::npos) return "";
+  size_t e = s.find_last_not_of(" \t\r\n\f\v");
+  return s.substr(b, e - b + 1);
+}
+
+inline bool is_sentence_delim(char c) { return c == '.' || c == '?' || c == '!'; }
+
+// A sentence ends at each '.', '?' or '!' (delimiter kept, slice trimmed);
+// trailing remainder becomes a final sentence; non-empty text with no
+// delimiters is one sentence (reference main.rs:41-62).
+inline std::vector<std::string> split_sentences(const std::string& cleaned) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (size_t i = 0; i < cleaned.size(); ++i) {
+    if (is_sentence_delim(cleaned[i])) {
+      std::string s = trim_ws(cleaned.substr(start, i + 1 - start));
+      if (!s.empty()) out.push_back(s);
+      start = i + 1;
+    }
+  }
+  if (start < cleaned.size()) {
+    std::string rest = trim_ws(cleaned.substr(start));
+    if (!rest.empty()) out.push_back(rest);
+  }
+  if (out.empty() && !cleaned.empty()) out.push_back(cleaned);
+  return out;
+}
+
+inline std::vector<std::string> tokenize_words(const std::string& cleaned) {
+  std::istringstream in(cleaned);
+  std::vector<std::string> out;
+  std::string w;
+  while (in >> w) out.push_back(w);
+  return out;
+}
+
+}  // namespace symbiont

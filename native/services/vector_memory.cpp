@@ -1,0 +1,144 @@
+// vector_memory worker — C++ shell of the reference's vector_memory_service
+// (SURVEY.md §2 checklist item 5; reference:
+// services/vector_memory_service/src/main.rs). The store itself is the
+// TPU-native vector store owned by the engine process (exact cosine top-k on
+// the MXU, symbiont_tpu/memory/vector_store.py) reached over
+// engine.vector.* request-reply — replacing the reference's Qdrant gRPC hop.
+//
+// Roles, same as the reference:
+// 1. data.text.with_embeddings → one point per sentence, uuid ids, 6-field
+//    payload, ack-after-durable upsert (main.rs:121-228; wait=true :196);
+// 2. tasks.search.semantic.request request-reply with typed error replies
+//    (main.rs:230-456).
+//
+// Usage: vector_memory [SYMBIONT_BUS_URL=...] [SYMBIONT_ENGINE_TIMEOUT_MS=...]
+
+#include <string>
+#include <vector>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+
+namespace {
+
+const char* SERVICE = "vector_memory";
+
+json::Value engine_call(symbus::Client& bus, const char* subject,
+                        const json::Value& req, int timeout_ms,
+                        const std::map<std::string, std::string>& headers) {
+  auto reply = bus.request(subject, req.dump(), timeout_ms, headers);
+  if (!reply) throw std::runtime_error(std::string(subject) + " timed out");
+  json::Value r = json::parse(reply->data);
+  if (!r.at("error_message").is_null())
+    throw std::runtime_error("engine error: " +
+                             r.at("error_message").as_string());
+  return r;
+}
+
+}  // namespace
+
+int main() {
+  int engine_timeout_ms =
+      std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
+
+  symbus::Client bus;
+  if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
+
+  uint32_t sid_store = bus.subscribe(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                                     symbiont::subjects::Q_VECTOR_MEMORY);
+  uint32_t sid_search = bus.subscribe(symbiont::subjects::TASKS_SEARCH_SEMANTIC_REQUEST,
+                                      symbiont::subjects::Q_VECTOR_MEMORY);
+  symbiont::logline("INFO", SERVICE, "ready");
+
+  while (bus.connected()) {
+    auto msg = bus.next(1000);
+    if (!msg) continue;
+
+    // ------------------------------------------------------------- upsert
+    if (msg->sid == sid_store) {
+      symbiont::TextWithEmbeddingsMessage m;
+      try {
+        m = symbiont::TextWithEmbeddingsMessage::parse(msg->data);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("bad embeddings message: ") + e.what(),
+                          msg->headers);
+        continue;
+      }
+      auto headers = symbiont::child_headers(msg->headers);
+      json::Value points = json::Value::array();
+      uint64_t now = symbiont::now_ms();
+      for (size_t order = 0; order < m.embeddings_data.size(); ++order) {
+        const auto& se = m.embeddings_data[order];
+        symbiont::QdrantPointPayload payload;
+        payload.original_document_id = m.original_id;
+        payload.source_url = m.source_url;
+        payload.sentence_text = se.sentence_text;
+        payload.sentence_order = order;
+        payload.model_name = m.model_name;
+        payload.processed_at_ms = now;
+        json::Value p = json::Value::object();
+        p.set("id", json::Value(symbiont::uuid4()));
+        p.set("vector", json::to_array(se.embedding, [](const float& x) {
+          return json::Value(x);
+        }));
+        p.set("payload", payload.to_json());
+        points.push_back(std::move(p));
+      }
+      json::Value req = json::Value::object();
+      req.set("points", std::move(points));
+      try {
+        // request-reply == ack-after-durable (reference wait=true, :196)
+        json::Value r = engine_call(bus, symbiont::subjects::ENGINE_VECTOR_UPSERT,
+                                    req, engine_timeout_ms, headers);
+        symbiont::logline("INFO", SERVICE,
+                          "upserted " +
+                              std::to_string((uint64_t)r.at("upserted").as_number()) +
+                              " points for doc " + m.original_id,
+                          headers);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("upsert failed: ") + e.what(), headers);
+      }
+      continue;
+    }
+
+    // ------------------------------------------------------------- search
+    if (msg->sid == sid_search) {
+      if (msg->reply.empty()) {
+        symbiont::logline("WARN", SERVICE, "search task without reply inbox",
+                          msg->headers);
+        continue;
+      }
+      symbiont::SemanticSearchNatsResult result;
+      try {
+        auto task = symbiont::SemanticSearchNatsTask::parse(msg->data);
+        result.request_id = task.request_id;
+        json::Value req = json::Value::object();
+        req.set("vector", json::to_array(task.query_embedding, [](const float& x) {
+          return json::Value(x);
+        }));
+        req.set("top_k", json::Value((double)task.top_k));
+        json::Value r = engine_call(bus, symbiont::subjects::ENGINE_VECTOR_SEARCH,
+                                    req, engine_timeout_ms,
+                                    symbiont::child_headers(msg->headers));
+        for (const auto& h : r.at("hits").as_array()) {
+          symbiont::SemanticSearchResultItem item;
+          item.qdrant_point_id = h.at("id").as_string();
+          item.score = (float)h.at("score").as_number();
+          item.payload = symbiont::QdrantPointPayload::from_json(h.at("payload"));
+          result.results.push_back(std::move(item));
+        }
+      } catch (const std::exception& e) {
+        // typed error reply even on deserialize failure (main.rs:240-251)
+        if (result.request_id.empty()) result.request_id = "unknown";
+        result.error_message = e.what();
+      }
+      bus.publish(msg->reply, result.to_json_string(), "",
+                  symbiont::child_headers(msg->headers));
+      continue;
+    }
+  }
+  symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
+  return 0;
+}

@@ -1,0 +1,260 @@
+// symbus C++ client — the bus face of every native worker shell.
+//
+// The reference's workers each hold one async-nats connection and run a
+// subscriber loop (reference: services/perception_service/src/main.rs:172-247).
+// This client gives the C++ services the same shape without an async runtime:
+// one TCP connection, a poll()-driven frame pump, and a FIFO of decoded
+// messages; next(timeout) is the `while let Some(msg) = sub.next().await` loop.
+// Request-reply mirrors the NATS inbox pattern the reference relies on
+// (reference: services/api_service/src/main.rs:309-316): subscribe a unique
+// _INBOX subject, publish with reply, wait for the inbox message while other
+// traffic keeps queueing.
+//
+// Thread model: NOT thread-safe by design — one Client per service loop
+// (single-owner, like the reference's per-service connection). Services that
+// want concurrency run multiple processes under a queue group.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <optional>
+#include <random>
+#include <stdexcept>
+#include <string>
+
+#include "protocol.hpp"
+
+namespace symbus {
+
+struct BusMsg {
+  uint32_t sid = 0;
+  std::string subject;
+  std::string reply;
+  std::map<std::string, std::string> headers;
+  std::string data;
+};
+
+class Client {
+ public:
+  Client() = default;
+  ~Client() { close(); }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  void connect(const std::string& host, int port) {
+    struct addrinfo hints {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string ports = std::to_string(port);
+    int rc = ::getaddrinfo(host.c_str(), ports.c_str(), &hints, &res);
+    if (rc != 0) throw std::runtime_error("resolve " + host + ": " + gai_strerror(rc));
+    int fd = -1;
+    for (auto* ai = res; ai; ai = ai->ai_next) {
+      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd < 0) throw std::runtime_error("connect " + host + ":" + ports + " failed");
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+    fd_ = fd;
+  }
+
+  bool connected() const { return fd_ >= 0; }
+
+  void close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  uint32_t subscribe(const std::string& subject, const std::string& queue = "") {
+    uint32_t sid = next_sid_++;
+    Writer w;
+    w.u8(OP_SUB);
+    w.u32(sid);
+    w.str(subject);
+    w.str(queue);
+    send_frame(w);
+    return sid;
+  }
+
+  void unsubscribe(uint32_t sid) {
+    Writer w;
+    w.u8(OP_UNSUB);
+    w.u32(sid);
+    send_frame(w);
+  }
+
+  void publish(const std::string& subject, const std::string& data,
+               const std::string& reply = "",
+               const std::map<std::string, std::string>& headers = {}) {
+    Writer w;
+    w.u8(OP_PUB);
+    w.str(subject);
+    w.str(reply);
+    w.u16((uint16_t)headers.size());
+    for (const auto& [k, v] : headers) {
+      w.str(k);
+      w.str(v);
+    }
+    w.data(data);
+    send_frame(w);
+  }
+
+  // Next queued message from any subscription. timeout_ms < 0 blocks forever.
+  std::optional<BusMsg> next(int timeout_ms) {
+    auto deadline = now_ms() + timeout_ms;
+    for (;;) {
+      if (!inbox_.empty()) {
+        BusMsg m = std::move(inbox_.front());
+        inbox_.pop_front();
+        return m;
+      }
+      int wait = timeout_ms < 0 ? -1 : (int)(deadline - now_ms());
+      if (timeout_ms >= 0 && wait <= 0) return std::nullopt;
+      if (!pump(wait)) return std::nullopt;  // timed out (or closed)
+    }
+  }
+
+  // Inbox request-reply (reference: api_service/src/main.rs:309-316 pattern).
+  // Messages for other subscriptions arriving meanwhile stay queued for next().
+  std::optional<BusMsg> request(const std::string& subject, const std::string& data,
+                                int timeout_ms,
+                                const std::map<std::string, std::string>& headers = {}) {
+    std::string inbox = "_INBOX." + random_token();
+    uint32_t sid = subscribe(inbox, "");
+    publish(subject, data, inbox, headers);
+    auto deadline = now_ms() + timeout_ms;
+    std::optional<BusMsg> out;
+    for (;;) {
+      // scan queued messages for the reply
+      for (auto it = inbox_.begin(); it != inbox_.end(); ++it) {
+        if (it->sid == sid) {
+          out = std::move(*it);
+          inbox_.erase(it);
+          break;
+        }
+      }
+      if (out) break;
+      int wait = (int)(deadline - now_ms());
+      if (wait <= 0 || !pump(wait)) break;
+    }
+    unsubscribe(sid);
+    return out;
+  }
+
+  void ping() {
+    Writer w;
+    w.u8(OP_PING);
+    send_frame(w);
+  }
+
+  static std::string random_token() {
+    static thread_local std::mt19937_64 rng{std::random_device{}()};
+    static const char* hex = "0123456789abcdef";
+    std::string s(24, '0');
+    for (auto& c : s) c = hex[rng() & 15];
+    return s;
+  }
+
+ private:
+  static int64_t now_ms() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void send_frame(const Writer& w) {
+    if (fd_ < 0) throw std::runtime_error("symbus client not connected");
+    std::string f = w.frame();
+    size_t off = 0;
+    while (off < f.size()) {
+      ssize_t n = ::send(fd_, f.data() + off, f.size() - off, 0);
+      if (n <= 0) {
+        close();
+        throw std::runtime_error("symbus send failed");
+      }
+      off += (size_t)n;
+    }
+  }
+
+  // Read until at least one full frame is decoded or the timeout passes.
+  // Returns false on timeout or connection close.
+  bool pump(int timeout_ms) {
+    if (fd_ < 0) return false;
+    auto deadline = timeout_ms < 0 ? INT64_MAX : now_ms() + timeout_ms;
+    size_t had = inbox_.size();
+    for (;;) {
+      // decode any complete frames already buffered
+      while (try_decode_frame()) {
+      }
+      if (inbox_.size() > had) return true;
+      int wait = timeout_ms < 0 ? -1 : (int)(deadline - now_ms());
+      if (timeout_ms >= 0 && wait <= 0) return false;
+      struct pollfd p {fd_, POLLIN, 0};
+      int rc = ::poll(&p, 1, wait);
+      if (rc == 0) return false;
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        close();
+        return false;
+      }
+      char buf[65536];
+      ssize_t n = ::recv(fd_, buf, sizeof(buf), 0);
+      if (n <= 0) {
+        close();
+        return false;
+      }
+      rxbuf_.append(buf, (size_t)n);
+    }
+  }
+
+  bool try_decode_frame() {
+    if (rxbuf_.size() < 4) return false;
+    uint32_t len = 0;
+    for (int i = 0; i < 4; ++i) len |= ((uint32_t)(uint8_t)rxbuf_[i]) << (8 * i);
+    if (len == 0 || len > MAX_FRAME) throw std::runtime_error("bad frame length");
+    if (rxbuf_.size() < 4 + (size_t)len) return false;
+    Reader r(rxbuf_.data() + 4, len);
+    uint8_t op = r.u8();
+    if (op == OP_MSG) {
+      BusMsg m;
+      m.sid = r.u32();
+      m.subject = r.str();
+      m.reply = r.str();
+      uint16_t nh = r.u16();
+      for (uint16_t i = 0; i < nh; ++i) {
+        std::string k = r.str();
+        m.headers[k] = r.str();
+      }
+      m.data = r.data();
+      inbox_.push_back(std::move(m));
+    } else if (op == OP_ERR) {
+      last_error_ = r.str();
+    }  // OP_PONG: frame consumed, nothing queued
+    rxbuf_.erase(0, 4 + (size_t)len);
+    return true;
+  }
+
+  int fd_ = -1;
+  uint32_t next_sid_ = 1;
+  std::string rxbuf_;
+  std::deque<BusMsg> inbox_;
+  std::string last_error_;
+};
+
+}  // namespace symbus

@@ -94,11 +94,22 @@ class SymbiontStack:
             self.lm = LmEngine(cfg.lm)
             lm_generate = self.lm.generate
 
+        # ONE micro-batching queue in front of the device, shared by every
+        # in-process caller (preprocessing pipeline + engine.* plane) — two
+        # queues would mean concurrent forwards on one engine, the hazard
+        # SURVEY.md §5.2 exists to prevent
+        batcher = None
+        if self.engine is not None:
+            from symbiont_tpu.engine.batcher import MicroBatcher
+
+            batcher = MicroBatcher(self.engine)
+
         if on("perception"):
             self.services.append(
                 PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher))
         if on("preprocessing"):
-            self.services.append(PreprocessingService(self.bus, self.engine))
+            self.services.append(
+                PreprocessingService(self.bus, self.engine, batcher=batcher))
         if on("vector_memory"):
             self.services.append(VectorMemoryService(self.bus, self.vector_store))
         if on("knowledge_graph"):
@@ -113,7 +124,7 @@ class SymbiontStack:
             from symbiont_tpu.services.engine_service import EngineService
 
             self.services.append(EngineService(
-                self.bus, engine=self.engine, lm=self.lm,
+                self.bus, engine=self.engine, batcher=batcher, lm=self.lm,
                 vector_store=self.vector_store, graph_store=self.graph_store))
         for s in self.services:
             await s.start()

@@ -1,0 +1,384 @@
+"""Native C++ worker services, driven end-to-end over the real broker.
+
+Each test spawns the C++ broker plus one or more native worker binaries
+(native/services/*.cpp) and talks to them from the Python TCP client —
+proving the full cross-language contract: symbus wire protocol, generated
+schema structs, queue groups, trace headers, and the engine.* request-reply
+plane (SURVEY.md §2 native-components checklist).
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from symbiont_tpu import subjects
+from symbiont_tpu.schema import (
+    GeneratedTextMessage,
+    GenerateTextTask,
+    RawTextMessage,
+    from_json,
+    to_json_bytes,
+)
+from symbiont_tpu.utils.ids import current_timestamp_ms, generate_uuid
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def broker():
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
+                   capture_output=True)
+    port = _free_port()
+    proc = subprocess.Popen(
+        [str(REPO / "native" / "build" / "symbus_broker"), "--port", str(port),
+         "--host", "127.0.0.1"], stderr=subprocess.PIPE)
+    for _ in range(100):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("broker did not start")
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def spawn_worker(name: str, port: int, extra_env: dict | None = None):
+    env = dict(os.environ,
+               SYMBIONT_BUS_URL=f"symbus://127.0.0.1:{port}",
+               **(extra_env or {}))
+    proc = subprocess.Popen([str(REPO / "native" / "build" / name)],
+                            env=env, stderr=subprocess.PIPE)
+    return proc
+
+
+def stop_worker(proc) -> str:
+    proc.terminate()
+    try:
+        _, err = proc.communicate(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        _, err = proc.communicate()
+    return (err or b"").decode(errors="replace")
+
+
+async def _tcp_bus(port):
+    from symbiont_tpu.bus.tcp import TcpBus
+
+    bus = TcpBus("127.0.0.1", port)
+    await bus.connect()
+    return bus
+
+
+async def _wait_ready(proc, pattern: bytes = b"ready", timeout: float = 10.0):
+    """Wait for the worker's structured ready log line on stderr."""
+    os.set_blocking(proc.stderr.fileno(), False)
+    buf = b""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        chunk = proc.stderr.read()
+        if chunk:
+            buf += chunk
+            if pattern in buf:
+                return buf
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f"worker not ready; stderr so far: {buf!r}")
+
+
+def test_text_generator_markov(broker):
+    async def scenario():
+        proc = spawn_worker("text_generator", broker)
+        try:
+            await _wait_ready(proc)
+            bus = await _tcp_bus(broker)
+            sub = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+
+            # cold start: seed corpus only (reference main.rs:170 parity)
+            task = GenerateTextTask(task_id=generate_uuid(), prompt=None,
+                                    max_length=8)
+            await bus.publish(subjects.TASKS_GENERATION_TEXT, to_json_bytes(task))
+            msg = await sub.next(10.0)
+            assert msg is not None, "no generated event"
+            out = from_json(GeneratedTextMessage, msg.data)
+            assert out.original_task_id == task.task_id
+            assert out.generated_text != "Model not trained."
+            seed_words = set("Это первое предложение для обучения нашей "
+                             "марковской модели оно простое".split())
+            assert set(out.generated_text.split()) <= seed_words
+            assert len(out.generated_text.split()) <= 8
+            # trace header propagated outward
+            assert "X-Trace-Id" in msg.headers
+
+            # continuous learning: feed a doc, then generate from its words
+            raw = RawTextMessage(
+                id=generate_uuid(), source_url="http://t",
+                raw_text="alpha beta gamma delta epsilon zeta",
+                timestamp_ms=current_timestamp_ms())
+            await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                              to_json_bytes(raw))
+            await asyncio.sleep(0.3)
+            seen_new = False
+            for _ in range(30):
+                task = GenerateTextTask(task_id=generate_uuid(), prompt=None,
+                                        max_length=6)
+                await bus.publish(subjects.TASKS_GENERATION_TEXT,
+                                  to_json_bytes(task))
+                msg = await sub.next(10.0)
+                out = from_json(GeneratedTextMessage, msg.data)
+                if out.generated_text.split()[0] == "alpha":
+                    seen_new = True
+                    break
+            assert seen_new, "markov chain never used the ingested document"
+            await bus.close()
+        finally:
+            stop_worker(proc)
+
+    asyncio.run(scenario())
+
+
+def test_native_pipeline_preprocessing_vector_memory(broker):
+    """The reference's main pipeline (SURVEY.md §3.1/§3.2) with BOTH worker
+    shells native: raw text → C++ preprocessing (clean/split in C++, embed via
+    engine.embed.batch) → C++ vector_memory (upsert via engine.vector.upsert)
+    → semantic search through the C++ shell — Python only owns the device."""
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.schema import (
+            QueryEmbeddingResult,
+            QueryForEmbeddingTask,
+            SemanticSearchNatsResult,
+            SemanticSearchNatsTask,
+            TextWithEmbeddingsMessage,
+            TokenizedTextMessage,
+        )
+        from symbiont_tpu.services.engine_service import EngineService
+
+        import tempfile
+
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], dtype="float32"))
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, engine=eng, vector_store=store)
+            await svc.start()
+            pre = spawn_worker("preprocessing", broker)
+            vm = spawn_worker("vector_memory", broker)
+            try:
+                await _wait_ready(pre)
+                await _wait_ready(vm)
+                bus = await _tcp_bus(broker)
+                sub_emb = await bus.subscribe(subjects.DATA_TEXT_WITH_EMBEDDINGS)
+                sub_tok = await bus.subscribe(subjects.DATA_PROCESSED_TEXT_TOKENIZED)
+
+                raw = RawTextMessage(
+                    id=generate_uuid(), source_url="http://doc",
+                    raw_text="  The MXU  does matmuls. HBM is the bottleneck! ok ",
+                    timestamp_ms=current_timestamp_ms())
+                await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                  to_json_bytes(raw))
+
+                emb_msg = await sub_emb.next(60.0)
+                assert emb_msg is not None, "no with_embeddings published"
+                emb = from_json(TextWithEmbeddingsMessage, emb_msg.data)
+                assert [se.sentence_text for se in emb.embeddings_data] == [
+                    "The MXU does matmuls.", "HBM is the bottleneck!", "ok"]
+                assert all(len(se.embedding) == 32 for se in emb.embeddings_data)
+                assert emb.original_id == raw.id
+
+                tok_msg = await sub_tok.next(10.0)
+                tok = from_json(TokenizedTextMessage, tok_msg.data)
+                assert tok.tokens[0] == "The" and tok.sentences == [
+                    s.sentence_text for s in emb.embeddings_data]
+
+                # vector_memory consumed the same publish → wait for upsert
+                for _ in range(100):
+                    if store.count() >= 3:
+                        break
+                    await asyncio.sleep(0.1)
+                assert store.count() == 3
+
+                # query-embedding request-reply through the C++ shell
+                qtask = QueryForEmbeddingTask(request_id=generate_uuid(),
+                                              text_to_embed="HBM is the bottleneck!")
+                qmsg = await bus.request(subjects.TASKS_EMBEDDING_FOR_QUERY,
+                                         to_json_bytes(qtask), 60.0)
+                qres = from_json(QueryEmbeddingResult, qmsg.data)
+                assert qres.error_message is None
+                assert qres.request_id == qtask.request_id
+                assert len(qres.embedding) == 32
+
+                # semantic search request-reply through the C++ shell
+                stask = SemanticSearchNatsTask(request_id=generate_uuid(),
+                                               query_embedding=qres.embedding,
+                                               top_k=2)
+                smsg = await bus.request(subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
+                                         to_json_bytes(stask), 60.0)
+                sres = from_json(SemanticSearchNatsResult, smsg.data)
+                assert sres.error_message is None
+                assert len(sres.results) == 2
+                top = sres.results[0]
+                assert top.payload.sentence_text == "HBM is the bottleneck!"
+                # query vector crossed two f32-JSON hops (C++ shells), so the
+                # self-match cosine is 1.0 only to ~1e-2
+                assert top.score == pytest.approx(1.0, abs=2e-2)
+                assert top.payload.original_document_id == raw.id
+                assert top.payload.sentence_order == 1
+
+                # typed error reply on an undecodable search task
+                bad = await bus.request(subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
+                                        b'{"nope": 1}', 30.0)
+                bres = from_json(SemanticSearchNatsResult, bad.data)
+                assert bres.error_message is not None
+                assert bres.request_id == "unknown"
+                await bus.close()
+            finally:
+                err_pre = stop_worker(pre)
+                err_vm = stop_worker(vm)
+                await svc.stop()
+                await engine_bus.close()
+                assert "upserted 3 points" in err_vm, err_vm
+                assert "WARN" not in err_pre.split("ready")[1] if "ready" in err_pre else True
+
+    asyncio.run(scenario())
+
+
+FIXTURE_HTML = """<!doctype html>
+<html><head><title>t</title><style>.c{display:none}</style>
+<script>var drop = 1;</script></head>
+<body><nav><span>menu junk</span></nav>
+<article>
+  <h1>TPU &amp; XLA</h1>
+  <p>The MXU does large matmuls.   It likes bf16!</p>
+  <ul><li>first point</li><li>second &#8212; point</li></ul>
+  <p>Closing <b>thought</b>.</p>
+</article>
+<footer><span>footer junk</span></footer></body></html>"""
+
+
+def test_native_perception_scrape(broker):
+    """C++ perception fetches a local HTTP page, runs the native selector
+    cascade, and publishes RawTextMessage — and its extraction matches the
+    Python twin byte-for-byte (two implementations, one spec)."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/redirect":
+                self.send_response(302)
+                self.send_header("Location", "/page.html")
+                self.end_headers()
+                return
+            body = FIXTURE_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    http_port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    async def scenario():
+        proc = spawn_worker("perception", broker)
+        try:
+            await _wait_ready(proc)
+            bus = await _tcp_bus(broker)
+            sub = await bus.subscribe(subjects.DATA_RAW_TEXT_DISCOVERED)
+
+            from symbiont_tpu.schema import PerceiveUrlTask
+            from symbiont_tpu.services.html_extract import extract_main_text
+
+            # plain fetch, then via a redirect
+            for path in ("/page.html", "/redirect"):
+                task = PerceiveUrlTask(
+                    url=f"http://127.0.0.1:{http_port}{path}")
+                await bus.publish(subjects.TASKS_PERCEIVE_URL,
+                                  to_json_bytes(task))
+                msg = await sub.next(15.0)
+                assert msg is not None, f"no raw text for {path}"
+                raw = from_json(RawTextMessage, msg.data)
+                assert raw.source_url == task.url
+                assert raw.raw_text == extract_main_text(FIXTURE_HTML)
+                assert "TPU & XLA" in raw.raw_text
+                assert "junk" not in raw.raw_text and "drop" not in raw.raw_text
+
+            # https is refused with a warning, not a crash
+            task = PerceiveUrlTask(url="https://example.com/x")
+            await bus.publish(subjects.TASKS_PERCEIVE_URL, to_json_bytes(task))
+            assert await sub.next(1.0) is None
+            await bus.close()
+        finally:
+            err = stop_worker(proc)
+            httpd.shutdown()
+            assert "https is not supported" in err
+
+    asyncio.run(scenario())
+
+
+def test_text_generator_lm_backend(broker):
+    """LM mode: the C++ worker forwards prompts to engine.generate — served
+    here by the Python EngineService over the same broker (the real
+    native-shell ↔ TPU-engine topology)."""
+
+    async def scenario():
+        from symbiont_tpu.services.engine_service import EngineService
+
+        class FakeLm:
+            class config:
+                model_dir = None
+                arch = "test"
+
+            def generate(self, prompt, max_new_tokens, **kw):
+                return f"lm says: {prompt}!"
+
+        engine_bus = await _tcp_bus(broker)
+        svc = EngineService(engine_bus, lm=FakeLm())
+        await svc.start()
+        proc = spawn_worker("text_generator", broker,
+                            {"SYMBIONT_TEXTGEN_BACKEND": "lm"})
+        try:
+            await _wait_ready(proc, b"backend=lm")
+            bus = await _tcp_bus(broker)
+            sub = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+            task = GenerateTextTask(task_id=generate_uuid(),
+                                    prompt="hello tpu", max_length=32)
+            await bus.publish(subjects.TASKS_GENERATION_TEXT, to_json_bytes(task))
+            msg = await sub.next(15.0)
+            assert msg is not None, "no generated event"
+            out = from_json(GeneratedTextMessage, msg.data)
+            assert out.generated_text == "lm says: hello tpu!"
+            assert out.original_task_id == task.task_id
+            await bus.close()
+        finally:
+            stop_worker(proc)
+            await svc.stop()
+            await engine_bus.close()
+
+    asyncio.run(scenario())
