@@ -1,0 +1,613 @@
+// api_gateway worker — C++ equivalent of the reference's api_service
+// (SURVEY.md §2 checklist item 8; reference: services/api_service/src/main.rs).
+// HTTP/1.1 + SSE server, bus client behind; the reference's Next.js frontend
+// works against this unmodified (§1-L4 contract):
+//
+// - POST /api/submit-url      → publish tasks.perceive.url (main.rs:42-111)
+// - POST /api/generate-text   → validate task_id / 1..=max_length, publish
+//                               tasks.generation.text (main.rs:113-188)
+// - GET  /api/events          → SSE stream of events.text.generated, 15s
+//                               keep-alive comments, drop-on-lag
+//                               (main.rs:190-270; broadcast cap 32 :537)
+// - POST /api/search/semantic → 2-hop request-reply orchestration, 15s/20s
+//                               timeouts, the reference's exact status-code
+//                               mapping: hop timeout → 503, service-reported
+//                               error → 500 (main.rs:272-512)
+// - CORS on localhost/127.0.0.1 origins (main.rs:555-567)
+// - GET /api/metrics, /healthz (SURVEY.md §5.5/§5.3 additions)
+//
+// Thread model: accept loop + one detached thread per HTTP connection. Each
+// search hop uses its own short-lived bus connection (symbus::Client is
+// single-owner); publishes share a mutex-guarded client; one bridge thread
+// owns the events.text.generated subscription and fans out to SSE clients
+// through bounded per-client queues (capacity 32, drop-on-lag).
+//
+// Usage: api_gateway [SYMBIONT_BUS_URL=...] [SYMBIONT_API_HOST/PORT=...]
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+
+namespace {
+
+const char* SERVICE = "api_gateway";
+
+// ------------------------------------------------------------------ metrics
+
+class Metrics {
+ public:
+  void inc(const std::string& name, uint64_t n = 1) {
+    std::lock_guard<std::mutex> g(mu_);
+    counters_[name] += n;
+  }
+  std::string snapshot_json() {
+    std::lock_guard<std::mutex> g(mu_);
+    json::Value o = json::Value::object();
+    json::Value c = json::Value::object();
+    for (const auto& [k, v] : counters_) c.set(k, json::Value((double)v));
+    o.set("counters", std::move(c));
+    o.set("histograms", json::Value::object());
+    return o.dump();
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, uint64_t> counters_;
+};
+
+Metrics g_metrics;
+
+// ------------------------------------------------------------------ sse hub
+
+class SseHub {
+ public:
+  struct Queue {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<std::string> items;
+    bool closed = false;
+  };
+
+  std::shared_ptr<Queue> register_client() {
+    auto q = std::make_shared<Queue>();
+    std::lock_guard<std::mutex> g(mu_);
+    clients_.push_back(q);
+    return q;
+  }
+
+  void unregister(const std::shared_ptr<Queue>& q) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = clients_.begin(); it != clients_.end(); ++it)
+      if (*it == q) {
+        clients_.erase(it);
+        break;
+      }
+  }
+
+  void broadcast(const std::string& payload, size_t capacity) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& q : clients_) {
+      std::lock_guard<std::mutex> qg(q->mu);
+      if (q->items.size() >= capacity) {
+        g_metrics.inc("api.sse_dropped");
+        symbiont::logline("WARN", SERVICE, "SSE client lagged; dropping message");
+        continue;
+      }
+      q->items.push_back(payload);
+      q->cv.notify_one();
+    }
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<std::shared_ptr<Queue>> clients_;
+};
+
+SseHub g_hub;
+
+// ----------------------------------------------------------------- http bits
+
+struct HttpRequest {
+  std::string method, path;
+  std::map<std::string, std::string> headers;  // lowercase keys
+  std::string body;
+};
+
+bool read_http_request(int fd, HttpRequest& req, int timeout_ms) {
+  std::string buf;
+  char chunk[16384];
+  size_t header_end = std::string::npos;
+  int64_t deadline = (int64_t)symbiont::now_ms() + timeout_ms;
+  while (header_end == std::string::npos) {
+    int wait = (int)(deadline - (int64_t)symbiont::now_ms());
+    if (wait <= 0) return false;
+    struct pollfd p {fd, POLLIN, 0};
+    int rc = ::poll(&p, 1, wait);
+    if (rc <= 0) return false;
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    buf.append(chunk, (size_t)n);
+    if (buf.size() > 8 * 1024 * 1024) return false;
+    header_end = buf.find("\r\n\r\n");
+  }
+  std::string head = buf.substr(0, header_end);
+  req.body = buf.substr(header_end + 4);
+
+  size_t line_end = head.find("\r\n");
+  std::string start = head.substr(0, line_end);
+  auto sp1 = start.find(' ');
+  auto sp2 = start.find(' ', sp1 + 1);
+  if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+  req.method = start.substr(0, sp1);
+  req.path = start.substr(sp1 + 1, sp2 - sp1 - 1);
+  auto qmark = req.path.find('?');
+  if (qmark != std::string::npos) req.path.resize(qmark);
+
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos) eol = head.size();
+    std::string line = head.substr(pos, eol - pos);
+    auto colon = line.find(':');
+    if (colon != std::string::npos) {
+      std::string k = line.substr(0, colon);
+      for (auto& c : k) c = (char)std::tolower((unsigned char)c);
+      std::string v = line.substr(colon + 1);
+      size_t b = v.find_first_not_of(" \t");
+      req.headers[k] = b == std::string::npos ? "" : v.substr(b);
+    }
+    pos = eol + 2;
+  }
+
+  long long announced = 0;
+  auto cl = req.headers.find("content-length");
+  if (cl != req.headers.end()) announced = std::atoll(cl->second.c_str());
+  // cap the client-supplied length: negative wraps and huge values OOM
+  if (announced < 0 || announced > 16 * 1024 * 1024) return false;
+  size_t want = (size_t)announced;
+  while (req.body.size() < want) {
+    int wait = (int)(deadline - (int64_t)symbiont::now_ms());
+    if (wait <= 0) return false;
+    struct pollfd p {fd, POLLIN, 0};
+    if (::poll(&p, 1, wait) <= 0) return false;
+    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+    if (n <= 0) return false;
+    req.body.append(chunk, (size_t)n);
+  }
+  req.body.resize(want);
+  return true;
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += (size_t)n;
+  }
+  return true;
+}
+
+// exact host (+optional port): http://localhost.evil.com must NOT match
+// (reference: main.rs:555-567)
+std::string cors_headers(const std::map<std::string, std::string>& headers) {
+  auto it = headers.find("origin");
+  if (it == headers.end()) return "";
+  const std::string& o = it->second;
+  std::string rest;
+  if (o.rfind("http://", 0) == 0) rest = o.substr(7);
+  else if (o.rfind("https://", 0) == 0) rest = o.substr(8);
+  else return "";
+  std::string host = rest;
+  auto colon = rest.find(':');
+  if (colon != std::string::npos) {
+    host = rest.substr(0, colon);
+    std::string port = rest.substr(colon + 1);
+    if (port.empty()) return "";
+    for (char c : port)
+      if (!std::isdigit((unsigned char)c)) return "";
+  }
+  if (host != "localhost" && host != "127.0.0.1") return "";
+  return "Access-Control-Allow-Origin: " + o +
+         "\r\nAccess-Control-Allow-Methods: GET, POST, OPTIONS\r\n"
+         "Access-Control-Allow-Headers: Content-Type\r\nVary: Origin\r\n";
+}
+
+void write_response(int fd, int status, const std::string& body,
+                    const std::map<std::string, std::string>& req_headers,
+                    bool keep_alive) {
+  const char* reason = status == 200   ? "OK"
+                       : status == 400 ? "Bad Request"
+                       : status == 404 ? "Not Found"
+                       : status == 503 ? "Service Unavailable"
+                                       : "Internal Server Error";
+  std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                     "\r\nContent-Type: application/json\r\nContent-Length: " +
+                     std::to_string(body.size()) + "\r\n" +
+                     cors_headers(req_headers) +
+                     (keep_alive ? "Connection: keep-alive\r\n\r\n"
+                                 : "Connection: close\r\n\r\n");
+  send_all(fd, head + body);
+}
+
+// Bundled single-page UI (frontend/index.html), loaded once at startup.
+// Missing file is fine: the gateway serves the API without the UI, same as
+// the reference where the frontend is a separate container
+// (docker-compose.yml:131-145).
+std::string g_frontend_html;
+
+void load_frontend() {
+  std::string path = symbiont::env_or("SYMBIONT_FRONTEND_PATH",
+                                      "frontend/index.html");
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return;
+  char buf[65536];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0)
+    g_frontend_html.append(buf, n);
+  std::fclose(f);
+}
+
+std::string msg_json(const std::string& message) {
+  json::Value o = json::Value::object();
+  o.set("message", json::Value(message));
+  o.set("task_id", json::Value());
+  return o.dump();
+}
+
+// ------------------------------------------------------------------- config
+
+struct Config {
+  std::string host;
+  int port;
+  int max_gen_length;
+  int sse_keepalive_ms;
+  size_t sse_capacity;
+  int embed_timeout_ms;
+  int search_timeout_ms;
+};
+
+Config g_cfg;
+
+// per-request bus connection (symbus::Client is single-owner)
+bool fresh_bus(symbus::Client& c) {
+  symbiont::BusAddr addr = symbiont::parse_bus_url(symbiont::env_or(
+      "SYMBIONT_BUS_URL", symbiont::env_or("NATS_URL", "symbus://127.0.0.1:4233")));
+  try {
+    c.connect(addr.host, addr.port);
+    return true;
+  } catch (const std::exception& e) {
+    symbiont::logline("WARN", SERVICE, std::string("bus connect failed: ") + e.what());
+    return false;
+  }
+}
+
+// shared publish-only client (submit-url / generate-text are single frames)
+std::mutex g_pub_mu;
+symbus::Client g_pub;
+
+bool publish_locked(const std::string& subject, const std::string& data,
+                    const std::map<std::string, std::string>& headers) {
+  std::lock_guard<std::mutex> g(g_pub_mu);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    try {
+      if (!g_pub.connected() && !fresh_bus(g_pub)) continue;
+      g_pub.publish(subject, data, "", headers);
+      return true;
+    } catch (const std::exception&) {
+      g_pub.close();  // stale connection: reconnect once
+    }
+  }
+  return false;
+}
+
+// ------------------------------------------------------------------- routes
+
+std::pair<int, std::string> route_submit_url(const std::string& body) {
+  json::Value j;
+  try {
+    j = json::parse(body);
+  } catch (const std::exception& e) {
+    return {400, msg_json(std::string("invalid JSON: ") + e.what())};
+  }
+  std::string url;
+  if (j.has("url") && !j.at("url").is_null()) url = j.at("url").as_string();
+  // trim
+  size_t b = url.find_first_not_of(" \t\r\n");
+  url = b == std::string::npos ? "" : url.substr(b, url.find_last_not_of(" \t\r\n") - b + 1);
+  if (url.empty()) return {400, msg_json("URL cannot be empty")};  // main.rs:48-53
+  symbiont::PerceiveUrlTask task;
+  task.url = url;
+  if (!publish_locked(symbiont::subjects::TASKS_PERCEIVE_URL,
+                      task.to_json_string(),
+                      symbiont::child_headers({})))
+    return {500, msg_json("bus publish failed")};
+  return {200, msg_json("Task to scrape URL '" + url + "' submitted successfully.")};
+}
+
+std::pair<int, std::string> route_generate_text(const std::string& body) {
+  symbiont::GenerateTextTask task;
+  try {
+    task = symbiont::GenerateTextTask::parse(body);
+  } catch (const std::exception& e) {
+    return {400, msg_json(std::string("invalid JSON: ") + e.what())};
+  }
+  std::string id = task.task_id;
+  size_t b = id.find_first_not_of(" \t\r\n");
+  if (b == std::string::npos)
+    return {400, msg_json("task_id cannot be empty")};  // main.rs:125-131
+  if (task.max_length == 0 || task.max_length > (uint64_t)g_cfg.max_gen_length) {
+    json::Value o = json::Value::object();  // main.rs:133-142
+    o.set("message", json::Value("max_length must be between 1 and " +
+                                 std::to_string(g_cfg.max_gen_length)));
+    o.set("task_id", json::Value(task.task_id));
+    return {400, o.dump()};
+  }
+  if (!publish_locked(symbiont::subjects::TASKS_GENERATION_TEXT,
+                      task.to_json_string(), symbiont::child_headers({})))
+    return {500, msg_json("bus publish failed")};
+  json::Value o = json::Value::object();
+  o.set("message", json::Value("Text generation task (id: " + task.task_id +
+                               ") submitted successfully."));
+  o.set("task_id", json::Value(task.task_id));
+  return {200, o.dump()};
+}
+
+std::pair<int, std::string> route_semantic_search(const std::string& body) {
+  // 2-hop orchestration, reference status mapping (main.rs:272-512):
+  // hop timeout → 503; service-reported error → 500
+  symbiont::SemanticSearchApiRequest req;
+  try {
+    req = symbiont::SemanticSearchApiRequest::parse(body);
+  } catch (const std::exception& e) {
+    return {400, msg_json(std::string("invalid JSON: ") + e.what())};
+  }
+  std::string request_id = symbiont::uuid4();
+  auto trace = symbiont::child_headers({});
+
+  symbiont::SemanticSearchApiResponse resp;
+  resp.search_request_id = request_id;
+
+  symbus::Client bus;
+  if (!fresh_bus(bus)) {
+    resp.error_message = "bus unavailable";
+    return {503, resp.to_json_string()};
+  }
+
+  symbiont::QueryForEmbeddingTask embed_task;
+  embed_task.request_id = request_id;
+  embed_task.text_to_embed = req.query_text;
+  auto reply = bus.request(symbiont::subjects::TASKS_EMBEDDING_FOR_QUERY,
+                           embed_task.to_json_string(), g_cfg.embed_timeout_ms,
+                           trace);
+  if (!reply) {
+    resp.error_message =
+        "Failed to get embedding from preprocessing service: timeout";
+    return {503, resp.to_json_string()};
+  }
+  symbiont::QueryEmbeddingResult embed_result;
+  try {
+    embed_result = symbiont::QueryEmbeddingResult::parse(reply->data);
+  } catch (const std::exception& e) {
+    resp.error_message = std::string("bad embedding reply: ") + e.what();
+    return {500, resp.to_json_string()};
+  }
+  if (embed_result.error_message || !embed_result.embedding) {
+    resp.error_message = embed_result.error_message
+                             ? *embed_result.error_message
+                             : "embedding service returned no embedding";
+    return {500, resp.to_json_string()};
+  }
+
+  symbiont::SemanticSearchNatsTask search_task;
+  search_task.request_id = request_id;
+  search_task.query_embedding = *embed_result.embedding;
+  search_task.top_k = req.top_k;
+  reply = bus.request(symbiont::subjects::TASKS_SEARCH_SEMANTIC_REQUEST,
+                      search_task.to_json_string(), g_cfg.search_timeout_ms,
+                      trace);
+  if (!reply) {
+    resp.error_message =
+        "Failed to get search results from vector memory service: timeout";
+    return {503, resp.to_json_string()};
+  }
+  symbiont::SemanticSearchNatsResult search_result;
+  try {
+    search_result = symbiont::SemanticSearchNatsResult::parse(reply->data);
+  } catch (const std::exception& e) {
+    resp.error_message = std::string("bad search reply: ") + e.what();
+    return {500, resp.to_json_string()};
+  }
+  if (search_result.error_message) {
+    resp.error_message = *search_result.error_message;
+    return {500, resp.to_json_string()};
+  }
+  resp.results = std::move(search_result.results);
+  return {200, resp.to_json_string()};
+}
+
+// --------------------------------------------------------------------- sse
+
+void serve_sse(int fd, const HttpRequest& req) {
+  std::string head =
+      "HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+      "Cache-Control: no-cache\r\n" +
+      cors_headers(req.headers) + "Connection: keep-alive\r\n\r\n";
+  if (!send_all(fd, head)) return;
+  auto q = g_hub.register_client();
+  g_metrics.inc("api.sse_clients");
+  for (;;) {
+    std::string payload;
+    bool have = false;
+    {
+      std::unique_lock<std::mutex> lk(q->mu);
+      q->cv.wait_for(lk, std::chrono::milliseconds(g_cfg.sse_keepalive_ms),
+                     [&] { return !q->items.empty() || q->closed; });
+      if (q->closed) break;
+      if (!q->items.empty()) {
+        payload = std::move(q->items.front());
+        q->items.pop_front();
+        have = true;
+      }
+    }
+    std::string frame;
+    if (have) {
+      // multi-line payloads become multiple data: lines (SSE framing)
+      size_t start = 0;
+      while (start <= payload.size()) {
+        size_t eol = payload.find('\n', start);
+        std::string line = eol == std::string::npos
+                               ? payload.substr(start)
+                               : payload.substr(start, eol - start);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        frame += "data: " + line + "\n";
+        if (eol == std::string::npos) break;
+        start = eol + 1;
+      }
+      frame += "\n";
+    } else {
+      frame = ": keep-alive\n\n";
+    }
+    if (!send_all(fd, frame)) break;
+  }
+  g_hub.unregister(q);
+}
+
+// bridge thread: owns the events.text.generated subscription
+// (reference: nats_to_sse_listener, main.rs:215-270)
+void sse_bridge() {
+  for (;;) {
+    symbus::Client bus;
+    if (!symbiont::connect_with_retry(bus, SERVICE)) return;
+    bus.subscribe(symbiont::subjects::EVENTS_TEXT_GENERATED);
+    while (bus.connected()) {
+      auto msg = bus.next(1000);
+      if (!msg) continue;
+      g_hub.broadcast(msg->data, g_cfg.sse_capacity);
+      g_metrics.inc("api.sse_broadcast");
+    }
+    symbiont::logline("WARN", SERVICE, "sse bridge lost bus; reconnecting");
+  }
+}
+
+// ------------------------------------------------------------------- server
+
+void handle_connection(int fd) {
+  for (;;) {
+    HttpRequest req;
+    if (!read_http_request(fd, req, 30000)) break;
+    bool keep_alive = true;
+    auto conn = req.headers.find("connection");
+    if (conn != req.headers.end()) {
+      std::string v = conn->second;
+      for (auto& c : v) c = (char)std::tolower((unsigned char)c);
+      keep_alive = v != "close";
+    }
+    if (req.method == "GET" && req.path == "/api/events") {
+      serve_sse(fd, req);  // SSE occupies the connection
+      break;
+    }
+    if (req.method == "GET" && (req.path == "/" || req.path == "/index.html") &&
+        !g_frontend_html.empty()) {
+      std::string head =
+          "HTTP/1.1 200 OK\r\nContent-Type: text/html; charset=utf-8\r\n"
+          "Content-Length: " + std::to_string(g_frontend_html.size()) + "\r\n" +
+          cors_headers(req.headers) +
+          (keep_alive ? "Connection: keep-alive\r\n\r\n"
+                      : "Connection: close\r\n\r\n");
+      if (!send_all(fd, head + g_frontend_html) || !keep_alive) break;
+      continue;
+    }
+    int status = 404;
+    std::string body;
+    if (req.method == "OPTIONS") {
+      status = 200;
+      body = "";
+    } else if (req.method == "POST" && req.path == "/api/submit-url") {
+      g_metrics.inc("api.POST./api/submit-url");
+      std::tie(status, body) = route_submit_url(req.body);
+    } else if (req.method == "POST" && req.path == "/api/generate-text") {
+      g_metrics.inc("api.POST./api/generate-text");
+      std::tie(status, body) = route_generate_text(req.body);
+    } else if (req.method == "POST" && req.path == "/api/search/semantic") {
+      g_metrics.inc("api.POST./api/search/semantic");
+      std::tie(status, body) = route_semantic_search(req.body);
+    } else if (req.method == "GET" && req.path == "/api/metrics") {
+      status = 200;
+      body = g_metrics.snapshot_json();
+    } else if (req.method == "GET" && req.path == "/healthz") {
+      status = 200;
+      body = "{\"status\": \"ok\"}";
+    } else {
+      g_metrics.inc("api.unmatched");
+      body = msg_json("not found");
+    }
+    write_response(fd, status, body, req.headers, keep_alive);
+    if (!keep_alive) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main() {
+  ::signal(SIGPIPE, SIG_IGN);
+  g_cfg.host = symbiont::env_or("SYMBIONT_API_HOST",
+                                symbiont::env_or("API_SERVER_HOST", "127.0.0.1"));
+  g_cfg.port = std::atoi(symbiont::env_or(
+      "SYMBIONT_API_PORT", symbiont::env_or("API_SERVER_PORT", "8080")).c_str());
+  g_cfg.max_gen_length =
+      std::atoi(symbiont::env_or("SYMBIONT_API_MAX_GEN_LENGTH", "1000").c_str());
+  g_cfg.sse_keepalive_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_API_SSE_KEEPALIVE_S", "15").c_str()));
+  g_cfg.sse_capacity = (size_t)std::atoi(
+      symbiont::env_or("SYMBIONT_API_SSE_CHANNEL_CAPACITY", "32").c_str());
+  g_cfg.embed_timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_EMBED_S", "15").c_str()));
+  g_cfg.search_timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_SEARCH_S", "20").c_str()));
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return 1;
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)g_cfg.port);
+  if (::inet_pton(AF_INET, g_cfg.host.c_str(), &addr.sin_addr) != 1)
+    addr.sin_addr.s_addr = INADDR_ANY;
+  if (::bind(lfd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    symbiont::logline("ERROR", SERVICE, "bind failed on port " +
+                                            std::to_string(g_cfg.port));
+    return 1;
+  }
+  if (::listen(lfd, 128) != 0) return 1;
+
+  load_frontend();
+  std::thread(sse_bridge).detach();
+  symbiont::logline("INFO", SERVICE,
+                    "ready: listening on " + g_cfg.host + ":" +
+                        std::to_string(g_cfg.port));
+
+  for (;;) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(handle_connection, cfd).detach();
+  }
+  return 0;
+}

@@ -15,6 +15,9 @@
 //
 // Usage: perception [SYMBIONT_BUS_URL=...]
 
+#include <fcntl.h>
+
+#include <cstring>
 #include <string>
 
 #include "../../generated/cpp/symbiont_schema.hpp"
@@ -31,6 +34,39 @@ struct Url {
   std::string path = "/";
 };
 
+// Host/port/path extraction for either scheme (used for the Host header in
+// proxy mode, where https targets are legal — the proxy terminates TLS).
+bool parse_any_url(const std::string& url, Url& out, std::string& err) {
+  int default_port;
+  std::string rest;
+  if (url.rfind("http://", 0) == 0) {
+    rest = url.substr(7);
+    default_port = 80;
+  } else if (url.rfind("https://", 0) == 0) {
+    rest = url.substr(8);
+    default_port = 443;
+  } else {
+    err = "unsupported scheme";
+    return false;
+  }
+  auto slash = rest.find('/');
+  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
+  auto colon = hostport.rfind(':');
+  if (colon == std::string::npos) {
+    out.host = hostport;
+    out.port = default_port;
+  } else {
+    out.host = hostport.substr(0, colon);
+    out.port = std::atoi(hostport.c_str() + colon + 1);
+  }
+  if (out.host.empty()) {
+    err = "empty host";
+    return false;
+  }
+  return true;
+}
+
 bool parse_http_url(const std::string& url, Url& out, std::string& err) {
   if (url.rfind("https://", 0) == 0) {
     err = "https is not supported by the native fetcher (no TLS runtime); "
@@ -41,23 +77,49 @@ bool parse_http_url(const std::string& url, Url& out, std::string& err) {
     err = "unsupported scheme (need http://)";
     return false;
   }
-  std::string rest = url.substr(7);
-  auto slash = rest.find('/');
-  std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
-  out.path = slash == std::string::npos ? "/" : rest.substr(slash);
-  auto colon = hostport.rfind(':');
-  if (colon == std::string::npos) {
-    out.host = hostport;
-    out.port = 80;
-  } else {
-    out.host = hostport.substr(0, colon);
-    out.port = std::atoi(hostport.c_str() + colon + 1);
+  return parse_any_url(url, out, err);
+}
+
+// Deadline-bounded connect: non-blocking + poll, so an unroutable host costs
+// the scrape budget, not the kernel's multi-minute SYN retry cycle. (DNS via
+// getaddrinfo has no portable timeout and is assumed fast/local.)
+int connect_with_deadline(const std::string& host, int port, int64_t deadline_ms) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
+  if (rc != 0)
+    throw std::runtime_error("resolve " + host + ": " + gai_strerror(rc));
+  int fd = -1;
+  std::string last_err = "no address";
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype | SOCK_NONBLOCK, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;  // immediate
+    if (errno == EINPROGRESS) {
+      int wait = (int)(deadline_ms - (int64_t)symbiont::now_ms());
+      struct pollfd p {fd, POLLOUT, 0};
+      int prc = wait > 0 ? ::poll(&p, 1, wait) : 0;
+      int soerr = 0;
+      socklen_t len = sizeof(soerr);
+      if (prc > 0 &&
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len) == 0 &&
+          soerr == 0)
+        break;  // connected
+      last_err = prc == 0 ? "connect timeout" : std::strerror(soerr ? soerr : errno);
+    } else {
+      last_err = std::strerror(errno);
+    }
+    ::close(fd);
+    fd = -1;
   }
-  if (out.host.empty()) {
-    err = "empty host";
-    return false;
-  }
-  return true;
+  ::freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect " + host + " failed: " + last_err);
+  // back to blocking; subsequent reads are poll()-bounded anyway
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+  return fd;
 }
 
 // Minimal HTTP/1.1 GET with Content-Length / close-delimited bodies and
@@ -77,24 +139,7 @@ std::string http_get(const std::string& url, const std::string& user_agent,
     throw std::runtime_error(err);
   }
 
-  struct addrinfo hints {};
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  int rc = ::getaddrinfo(u.host.c_str(), std::to_string(u.port).c_str(),
-                         &hints, &res);
-  if (rc != 0)
-    throw std::runtime_error("resolve " + u.host + ": " + gai_strerror(rc));
-  int fd = -1;
-  for (auto* ai = res; ai; ai = ai->ai_next) {
-    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
-    ::close(fd);
-    fd = -1;
-  }
-  ::freeaddrinfo(res);
-  if (fd < 0) throw std::runtime_error("connect failed: " + u.host);
+  int fd = connect_with_deadline(u.host, u.port, deadline_ms);
 
   auto remaining = [&]() -> int {
     int64_t left = deadline_ms - (int64_t)symbiont::now_ms();
@@ -107,7 +152,8 @@ std::string http_get(const std::string& url, const std::string& user_agent,
 
   std::string path_or_url = proxy.empty() ? u.path : target_url;
   Url host_of;
-  if (!proxy.empty()) parse_http_url(target_url, host_of, err);
+  if (!proxy.empty() && !parse_any_url(target_url, host_of, err))
+    throw std::runtime_error("bad target url: " + err);
   const Url& hu = proxy.empty() ? u : host_of;
   std::string req = "GET " + path_or_url + " HTTP/1.1\r\nHost: " + hu.host +
                     "\r\nUser-Agent: " + user_agent +
@@ -165,7 +211,7 @@ std::string http_get(const std::string& url, const std::string& user_agent,
     if (loc.empty()) throw std::runtime_error("redirect without Location");
     if (loc.rfind("http", 0) != 0) {  // relative redirect
       loc = "http://" + hu.host +
-            (hu.port != 80 ? ":" + std::to_string(hu.port) : "") +
+            (hu.port != 80 && hu.port != 443 ? ":" + std::to_string(hu.port) : "") +
             (loc[0] == '/' ? loc : "/" + loc);
     }
     return http_get(loc, user_agent, deadline_ms, redirects_left - 1);
@@ -192,7 +238,7 @@ std::string http_get(const std::string& url, const std::string& user_agent,
 
 }  // namespace
 
-int main() {
+int main() try {
   int timeout_ms = (int)(1000 * std::atof(
       symbiont::env_or("SYMBIONT_PERCEPTION_SCRAPE_TIMEOUT_S", "15").c_str()));
   std::string user_agent = symbiont::env_or(
@@ -245,4 +291,9 @@ int main() {
   }
   symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
   return 0;
+} catch (const std::exception& e) {
+  // bus drop mid-handler etc.: exit cleanly for the supervisor to
+  // restart instead of std::terminate aborting with no log
+  symbiont::logline("ERROR", SERVICE, std::string("fatal: ") + e.what());
+  return 1;
 }

@@ -85,7 +85,7 @@ class MarkovModel {
 
 }  // namespace
 
-int main() {
+int main() try {
   bool lm_backend = symbiont::env_or("SYMBIONT_TEXTGEN_BACKEND", "markov") == "lm";
   int engine_timeout_ms =
       std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
@@ -175,4 +175,9 @@ int main() {
   }
   symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
   return 0;
+} catch (const std::exception& e) {
+  // bus drop mid-handler etc.: exit cleanly for the supervisor to
+  // restart instead of std::terminate aborting with no log
+  symbiont::logline("ERROR", SERVICE, std::string("fatal: ") + e.what());
+  return 1;
 }

@@ -37,7 +37,7 @@ json::Value engine_call(symbus::Client& bus, const char* subject,
 
 }  // namespace
 
-int main() {
+int main() try {
   int engine_timeout_ms =
       std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
 
@@ -141,4 +141,9 @@ int main() {
   }
   symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
   return 0;
+} catch (const std::exception& e) {
+  // bus drop mid-handler etc.: exit cleanly for the supervisor to
+  // restart instead of std::terminate aborting with no log
+  symbiont::logline("ERROR", SERVICE, std::string("fatal: ") + e.what());
+  return 1;
 }

@@ -153,7 +153,12 @@ class Client {
       int wait = (int)(deadline - now_ms());
       if (wait <= 0 || !pump(wait)) break;
     }
-    unsubscribe(sid);
+    try {
+      unsubscribe(sid);
+    } catch (const std::exception&) {
+      // connection dropped mid-request: the timeout/nullopt result already
+      // reports the failure; throwing here would escape into caller threads
+    }
     return out;
   }
 

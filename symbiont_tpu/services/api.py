@@ -151,6 +151,16 @@ class ApiService:
                 if path == "/api/events" and method == "GET":
                     await self._serve_sse(writer, headers)
                     return  # SSE occupies the connection
+                if path in ("/", "/index.html") and method == "GET":
+                    html = _frontend_html()
+                    if html is not None:
+                        await self._write_response(
+                            writer, 200, html, origin=headers.get("origin"),
+                            content_type="text/html; charset=utf-8",
+                            keep_alive=keep_alive)
+                        if not keep_alive:
+                            break
+                        continue
                 status, payload = await self._route(method, path, headers, body)
                 await self._write_response(writer, status, payload,
                                            origin=headers.get("origin"),
@@ -362,3 +372,34 @@ def to_json_bytes_url(url: str) -> bytes:
     from symbiont_tpu.schema import PerceiveUrlTask
 
     return to_json_bytes(PerceiveUrlTask(url=url))
+
+
+_FRONTEND_CACHE: list = []  # [Optional[str]] — loaded once, like the C++ twin
+
+
+def _frontend_html() -> Optional[str]:
+    """The bundled single-page UI (frontend/index.html), if present.
+
+    SYMBIONT_FRONTEND_PATH overrides; falling back to the repo-layout location
+    next to the package. Loaded once at first use (blocking disk I/O must not
+    ride the event loop per request). Returns None when not found — the
+    gateway then 404s; it never fails to start (the API is fully usable
+    without the UI, same as the reference where the frontend is a separate
+    container, docker-compose.yml:131-145)."""
+    if _FRONTEND_CACHE:
+        return _FRONTEND_CACHE[0]
+    import os
+    from pathlib import Path
+
+    override = os.environ.get("SYMBIONT_FRONTEND_PATH")
+    candidates = ([Path(override)] if override else []) + [
+        Path(__file__).resolve().parents[2] / "frontend" / "index.html"]
+    html = None
+    for p in candidates:
+        try:
+            html = p.read_text(encoding="utf-8")
+            break
+        except OSError:
+            continue
+    _FRONTEND_CACHE.append(html)
+    return html

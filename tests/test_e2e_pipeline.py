@@ -193,6 +193,16 @@ def test_api_validation_parity(stack_config):
             assert status == 200 and "counters" in body
             status, body = await http("GET", port, "/healthz")
             assert status == 200 and body["status"] == "ok"
+            # bundled UI at GET / (executor: urlopen must not block the loop
+            # the server runs on)
+            def fetch_root():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=10) as r:
+                    return r.status, r.headers["Content-Type"], r.read().decode()
+
+            status, ctype, page = await loop.run_in_executor(None, fetch_root)
+            assert status == 200 and ctype.startswith("text/html")
+            assert "symbiont-tpu" in page
         finally:
             await stack.stop()
 

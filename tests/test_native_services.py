@@ -342,6 +342,153 @@ def test_native_perception_scrape(broker):
     asyncio.run(scenario())
 
 
+def test_native_api_gateway_full_stack(broker):
+    """The complete reference surface (SURVEY.md §1-L4) served by the C++
+    gateway, with C++ preprocessing/vector_memory/text_generator behind it and
+    the Python process reduced to the engine plane: HTTP validation parity,
+    2-hop search with status mapping, SSE push, CORS, metrics."""
+    import http.client as http_client
+    import tempfile
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.services.engine_service import EngineService
+
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], dtype="float32"))
+        api_port = _free_port()
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, engine=eng, vector_store=store)
+            await svc.start()
+            workers = [spawn_worker("preprocessing", broker),
+                       spawn_worker("vector_memory", broker),
+                       spawn_worker("text_generator", broker),
+                       spawn_worker("api_gateway", broker,
+                                    {"SYMBIONT_API_PORT": str(api_port),
+                                     "SYMBIONT_FRONTEND_PATH":
+                                         str(REPO / "frontend" / "index.html")})]
+            try:
+                for w in workers:
+                    await _wait_ready(w)
+
+                def http(method, path, payload=None, headers=None):
+                    conn = http_client.HTTPConnection("127.0.0.1", api_port,
+                                                      timeout=60)
+                    body = json.dumps(payload) if payload is not None else None
+                    conn.request(method, path, body=body, headers=headers or {})
+                    r = conn.getresponse()
+                    data = r.read().decode()
+                    hdrs = dict(r.getheaders())
+                    conn.close()
+                    return r.status, (json.loads(data) if data else None), hdrs
+
+                loop = asyncio.get_running_loop()
+                hx = lambda *a, **kw: loop.run_in_executor(None, lambda: http(*a, **kw))
+
+                # healthz + validation parity
+                status, body, _ = await hx("GET", "/healthz")
+                assert (status, body) == (200, {"status": "ok"})
+
+                # bundled UI at GET /
+                c = http_client.HTTPConnection("127.0.0.1", api_port, timeout=30)
+                c.request("GET", "/")
+                r = c.getresponse()
+                page = r.read().decode()
+                assert r.status == 200
+                assert r.getheader("Content-Type").startswith("text/html")
+                assert "symbiont-tpu" in page and "/api/search/semantic" in page
+                c.close()
+                status, body, _ = await hx("POST", "/api/submit-url", {"url": "  "})
+                assert status == 400 and body["message"] == "URL cannot be empty"
+                status, body, _ = await hx("POST", "/api/generate-text",
+                                           {"task_id": " ", "prompt": None,
+                                            "max_length": 5})
+                assert status == 400 and "task_id" in body["message"]
+                status, body, _ = await hx("POST", "/api/generate-text",
+                                           {"task_id": "t", "prompt": None,
+                                            "max_length": 5000})
+                assert status == 400 and "between 1 and 1000" in body["message"]
+                status, body, _ = await hx("GET", "/nope")
+                assert status == 404
+
+                # CORS: exact-host origins only
+                _, _, hdrs = await hx("GET", "/healthz",
+                                      headers={"Origin": "http://localhost:3000"})
+                assert hdrs.get("Access-Control-Allow-Origin") == "http://localhost:3000"
+                _, _, hdrs = await hx("GET", "/healthz",
+                                      headers={"Origin": "http://localhost.evil.com"})
+                assert "Access-Control-Allow-Origin" not in hdrs
+
+                # SSE client (raw socket to keep it simple)
+                sse_reader, sse_writer = await asyncio.open_connection(
+                    "127.0.0.1", api_port)
+                sse_writer.write(b"GET /api/events HTTP/1.1\r\n"
+                                 b"Host: x\r\nAccept: text/event-stream\r\n\r\n")
+                await sse_writer.drain()
+                head = await asyncio.wait_for(
+                    sse_reader.readuntil(b"\r\n\r\n"), 10)
+                assert b"text/event-stream" in head
+                await asyncio.sleep(0.3)  # let the hub register us
+
+                # ingest directly (perception is covered separately)
+                raw = RawTextMessage(
+                    id=generate_uuid(), source_url="http://doc",
+                    raw_text="Exact cosine topk runs on the MXU. "
+                             "Collectives ride the ICI!",
+                    timestamp_ms=current_timestamp_ms())
+                bus = await _tcp_bus(broker)
+                await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                  to_json_bytes(raw))
+                for _ in range(200):
+                    if store.count() >= 2:
+                        break
+                    await asyncio.sleep(0.1)
+                assert store.count() == 2
+
+                # 2-hop search through C++ gateway + C++ shells + TPU engine
+                status, body, _ = await hx("POST", "/api/search/semantic",
+                                           {"query_text": "Collectives ride the ICI!",
+                                            "top_k": 1})
+                assert status == 200, body
+                assert body["error_message"] is None
+                assert body["results"][0]["payload"]["sentence_text"] == \
+                    "Collectives ride the ICI!"
+                assert set(body["results"][0]["payload"]) == {
+                    "original_document_id", "source_url", "sentence_text",
+                    "sentence_order", "model_name", "processed_at_ms"}
+
+                # generation → SSE push
+                status, body, _ = await hx("POST", "/api/generate-text",
+                                           {"task_id": "sse-1", "prompt": None,
+                                            "max_length": 6})
+                assert status == 200 and body["task_id"] == "sse-1"
+                frame = await asyncio.wait_for(sse_reader.readuntil(b"\n\n"), 15)
+                data_lines = [ln[6:] for ln in frame.decode().splitlines()
+                              if ln.startswith("data: ")]
+                event = json.loads("\n".join(data_lines))
+                assert event["original_task_id"] == "sse-1"
+                assert event["generated_text"]
+                sse_writer.close()
+
+                # metrics counted the calls
+                status, body, _ = await hx("GET", "/api/metrics")
+                assert status == 200
+                assert body["counters"]["api.POST./api/search/semantic"] == 1
+                assert body["counters"]["api.sse_broadcast"] >= 1
+                await bus.close()
+            finally:
+                for w in workers:
+                    stop_worker(w)
+                await svc.stop()
+                await engine_bus.close()
+
+    asyncio.run(scenario())
+
+
 def test_text_generator_lm_backend(broker):
     """LM mode: the C++ worker forwards prompts to engine.generate — served
     here by the Python EngineService over the same broker (the real
