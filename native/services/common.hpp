@@ -139,4 +139,21 @@ inline bool connect_with_retry(symbus::Client& c, const std::string& service,
   return false;
 }
 
+// Durable pipeline opt-in (SYMBIONT_BUS_DURABLE=1): ensure the shared
+// "pipeline" stream exists (idempotent; mirrors the Python runner's setup).
+// Returns true when durable mode is on.
+inline bool maybe_setup_pipeline_stream(symbus::Client& bus) {
+  if (env_or("SYMBIONT_BUS_DURABLE", "") != "1") return false;
+  int64_t ack_wait_ms = std::atoll(
+      env_or("SYMBIONT_BUS_DURABLE_ACK_WAIT_MS", "60000").c_str());
+  uint32_t max_deliver = (uint32_t)std::atoi(
+      env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
+  bus.add_stream("pipeline",
+                 {subjects::DATA_RAW_TEXT_DISCOVERED,
+                  subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                  subjects::DATA_PROCESSED_TEXT_TOKENIZED},
+                 ack_wait_ms, max_deliver);
+  return true;
+}
+
 }  // namespace symbiont

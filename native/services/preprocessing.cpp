@@ -62,11 +62,16 @@ int main() try {
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
 
-  uint32_t sid_raw = bus.subscribe(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED,
-                                   symbiont::subjects::Q_PREPROCESSING);
+  // durable mode: at-least-once consumption, ack only after both downstream
+  // publishes succeed (SURVEY.md §5.3). Query request-reply stays core.
+  bool durable = symbiont::maybe_setup_pipeline_stream(bus);
+  uint32_t sid_raw =
+      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_PREPROCESSING)
+              : bus.subscribe(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED,
+                              symbiont::subjects::Q_PREPROCESSING);
   uint32_t sid_query = bus.subscribe(symbiont::subjects::TASKS_EMBEDDING_FOR_QUERY,
                                      symbiont::subjects::Q_PREPROCESSING);
-  symbiont::logline("INFO", SERVICE, "ready");
+  symbiont::logline("INFO", SERVICE, durable ? "ready (durable)" : "ready");
 
   while (bus.connected()) {
     auto msg = bus.next(1000);
@@ -81,6 +86,7 @@ int main() try {
         symbiont::logline("WARN", SERVICE,
                           std::string("bad raw-text message: ") + e.what(),
                           msg->headers);
+        bus.ack(*msg);  // permanent failure: redelivery cannot help
         continue;
       }
       std::string cleaned = symbiont::clean_text(raw.raw_text);
@@ -88,6 +94,7 @@ int main() try {
         // empty cleaned text is an error at this stage (main.rs:33-39)
         symbiont::logline("WARN", SERVICE, "cleaned text empty for id " + raw.id,
                           msg->headers);
+        bus.ack(*msg);  // permanent: the document has no content
         continue;
       }
       auto sentences = symbiont::split_sentences(cleaned);
@@ -109,6 +116,8 @@ int main() try {
         bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
                     out.to_json_string(), "", headers);
       } catch (const std::exception& e) {
+        // transient (engine down / timeout): leave unacked so the durable
+        // stream redelivers after ack_wait
         symbiont::logline("WARN", SERVICE,
                           std::string("embed failed: ") + e.what(), headers);
         continue;
@@ -122,6 +131,7 @@ int main() try {
       tok.timestamp_ms = symbiont::now_ms();
       bus.publish(symbiont::subjects::DATA_PROCESSED_TEXT_TOKENIZED,
                   tok.to_json_string(), "", headers);
+      bus.ack(*msg);  // both downstream publishes are on the broker
       continue;
     }
 

@@ -44,11 +44,17 @@ int main() try {
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
 
-  uint32_t sid_store = bus.subscribe(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
-                                     symbiont::subjects::Q_VECTOR_MEMORY);
+  // durable mode: ack only after the engine confirms the upsert — the
+  // ack-after-durable design SURVEY.md §7 hard part #6 calls for (an engine
+  // restart between delivery and write redelivers instead of losing data)
+  bool durable = symbiont::maybe_setup_pipeline_stream(bus);
+  uint32_t sid_store =
+      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_VECTOR_MEMORY)
+              : bus.subscribe(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                              symbiont::subjects::Q_VECTOR_MEMORY);
   uint32_t sid_search = bus.subscribe(symbiont::subjects::TASKS_SEARCH_SEMANTIC_REQUEST,
                                       symbiont::subjects::Q_VECTOR_MEMORY);
-  symbiont::logline("INFO", SERVICE, "ready");
+  symbiont::logline("INFO", SERVICE, durable ? "ready (durable)" : "ready");
 
   while (bus.connected()) {
     auto msg = bus.next(1000);

@@ -30,6 +30,7 @@
 #include <vector>
 
 #include "protocol.hpp"
+#include "streams.hpp"
 
 namespace symbus {
 
@@ -70,6 +71,11 @@ struct Broker {
   std::map<std::string, uint64_t> rr;  // (pattern|queue) -> round robin counter
   std::atomic<uint64_t> published{0}, delivered{0};
 
+  // durable streams (lock order: stream_mu BEFORE mu — capture/pump take
+  // stream_mu then call route which takes mu; never the reverse)
+  std::mutex stream_mu;
+  StreamEngine streams;
+
   void add_sub(Conn* c, uint32_t sid, const std::string& pattern,
                const std::string& queue) {
     std::lock_guard<std::mutex> lk(mu);
@@ -96,9 +102,9 @@ struct Broker {
     }
   }
 
-  void route(const std::string& subject, const std::string& reply,
-             const std::vector<std::pair<std::string, std::string>>& headers,
-             const std::string& data) {
+  int route(const std::string& subject, const std::string& reply,
+            const std::vector<std::pair<std::string, std::string>>& headers,
+            const std::string& data) {
     published++;
     // snapshot matching subs under the lock; send outside it
     struct Target {
@@ -124,7 +130,7 @@ struct Broker {
         targets.push_back({s.conn, s.sid});
       }
     }
-    if (targets.empty()) return;
+    if (targets.empty()) return 0;
     for (auto& t : targets) {
       Writer w;
       w.u8(OP_MSG);
@@ -143,6 +149,42 @@ struct Broker {
         t.conn->open = false;  // reader thread will clean up
       }
     }
+    return (int)targets.size();
+  }
+
+  // control-plane publishes (reserved subjects); returns true when consumed
+  bool handle_control(const std::string& subject, const std::string& reply,
+                      const std::string& data) {
+    std::string out;
+    if (subject == "_SYMBUS.stream.create") {
+      std::lock_guard<std::mutex> lk(stream_mu);
+      try {
+        out = streams.handle_stream_create(data);
+      } catch (const std::exception& e) {
+        out = std::string("{\"ok\": false, \"error\": \"") + e.what() + "\"}";
+      }
+    } else if (subject == "_SYMBUS.consumer.create") {
+      std::lock_guard<std::mutex> lk(stream_mu);
+      try {
+        out = streams.handle_consumer_create(data);
+      } catch (const std::exception& e) {
+        out = std::string("{\"ok\": false, \"error\": \"") + e.what() + "\"}";
+      }
+    } else if (subject == "_SYMBUS.ack") {
+      std::lock_guard<std::mutex> lk(stream_mu);
+      try {
+        out = streams.handle_ack(data);
+      } catch (const std::exception& e) {
+        out = std::string("{\"ok\": false, \"error\": \"") + e.what() + "\"}";
+      }
+    } else if (subject == "_SYMBUS.stats") {
+      std::lock_guard<std::mutex> lk(stream_mu);
+      out = streams.stats_json();
+    } else {
+      return false;
+    }
+    if (!reply.empty()) route(reply, "", {}, out);
+    return true;
   }
 };
 
@@ -195,6 +237,14 @@ static void serve_conn(std::shared_ptr<Conn> conn) {
             headers.emplace_back(std::move(k), std::move(v));
           }
           std::string data = r.data();
+          if (broker->handle_control(subject, reply, data)) break;
+          // durable capture BEFORE fan-out (at-least-once: persisted even if
+          // no live subscriber); reserved + inbox subjects never match stream
+          // subject sets by convention, and capture() checks patterns anyway
+          if (subject.rfind("_SYMBUS.", 0) != 0 && subject.rfind("_INBOX.", 0) != 0) {
+            std::lock_guard<std::mutex> lk(broker->stream_mu);
+            broker->streams.capture(subject, headers, data);
+          }
           broker->route(subject, reply, headers, data);
           break;
         }
@@ -230,9 +280,11 @@ int main(int argc, char** argv) {
   using namespace symbus;
   int port = 4233;
   std::string host = "0.0.0.0";
+  std::string data_dir;  // empty: streams live in memory only
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
     if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+    if (!strcmp(argv[i], "--data-dir")) data_dir = argv[i + 1];
   }
   signal(SIGPIPE, SIG_IGN);
 
@@ -255,6 +307,24 @@ int main(int argc, char** argv) {
   fflush(stderr);
 
   Broker broker;
+  broker.streams.configure(
+      data_dir,
+      [&broker](const std::string& subject, const HeaderList& headers,
+                const std::string& data) {
+        return broker.route(subject, "", headers, data);
+      });
+  // delivery/redelivery pump for durable consumer groups
+  std::thread([&broker] {
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> lk(broker.stream_mu);
+        broker.streams.pump();
+      }
+      struct timespec ts {0, 100 * 1000000};
+      nanosleep(&ts, nullptr);
+    }
+  }).detach();
+
   for (;;) {
     int cfd = ::accept(lfd, nullptr, nullptr);
     if (cfd < 0) continue;

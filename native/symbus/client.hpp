@@ -168,6 +168,57 @@ class Client {
     send_frame(w);
   }
 
+  // ---- durable streams (broker: streams.hpp; control rides reserved
+  // request-reply subjects, so no extra opcodes) ----------------------------
+
+  // Create/refresh a stream capturing `subjects`. Throws on broker error.
+  void add_stream(const std::string& name,
+                  const std::vector<std::string>& subjects,
+                  int64_t ack_wait_ms = 30000, uint32_t max_deliver = 5,
+                  int timeout_ms = 10000) {
+    std::string req = "{\"stream\": \"" + name + "\", \"subjects\": [";
+    for (size_t i = 0; i < subjects.size(); ++i) {
+      if (i) req += ", ";
+      req += "\"" + subjects[i] + "\"";
+    }
+    req += "], \"ack_wait_ms\": " + std::to_string(ack_wait_ms) +
+           ", \"max_deliver\": " + std::to_string(max_deliver) + "}";
+    auto r = request("_SYMBUS.stream.create", req, timeout_ms);
+    if (!r || r->data.find("\"ok\": true") == std::string::npos)
+      throw std::runtime_error("stream create failed: " +
+                               (r ? r->data : "timeout"));
+  }
+
+  // Join durable consumer group `group` on `stream`; deliveries arrive via
+  // next() on the returned sid with X-Symbus-* headers. Ack with ack(msg)
+  // after the side effect is durable, else the message redelivers.
+  uint32_t durable_subscribe(const std::string& stream, const std::string& group,
+                             const std::string& filter_subject = "",
+                             int timeout_ms = 10000) {
+    uint32_t sid = subscribe("_SYMBUS.deliver." + stream + "." + group, group);
+    std::string req =
+        "{\"stream\": \"" + stream + "\", \"group\": \"" + group + "\"" +
+        (filter_subject.empty()
+             ? std::string()
+             : ", \"filter_subject\": \"" + filter_subject + "\"") +
+        "}";
+    auto r = request("_SYMBUS.consumer.create", req, timeout_ms);
+    if (!r || r->data.find("\"ok\": true") == std::string::npos)
+      throw std::runtime_error("consumer create failed: " +
+                               (r ? r->data : "timeout"));
+    return sid;
+  }
+
+  void ack(const BusMsg& m) {
+    auto s = m.headers.find("X-Symbus-Stream");
+    auto g = m.headers.find("X-Symbus-Group");
+    auto q = m.headers.find("X-Symbus-Seq");
+    if (s == m.headers.end() || g == m.headers.end() || q == m.headers.end())
+      return;  // not a durable delivery
+    publish("_SYMBUS.ack", "{\"stream\": \"" + s->second + "\", \"group\": \"" +
+                               g->second + "\", \"seq\": " + q->second + "}");
+  }
+
   static std::string random_token() {
     static thread_local std::mt19937_64 rng{std::random_device{}()};
     static const char* hex = "0123456789abcdef";

@@ -49,6 +49,9 @@ struct Writer {
   void u32(uint32_t v) {
     for (int i = 0; i < 4; ++i) buf.push_back((char)((v >> (8 * i)) & 0xff));
   }
+  void u64(uint64_t v) {
+    for (int i = 0; i < 8; ++i) buf.push_back((char)((v >> (8 * i)) & 0xff));
+  }
   void str(const std::string& s) {
     if (s.size() > 0xffff) throw std::runtime_error("string too long");
     u16((uint16_t)s.size());
@@ -91,6 +94,13 @@ struct Reader {
     uint32_t v = 0;
     for (int i = 0; i < 4; ++i) v |= ((uint32_t)(uint8_t)p[off + i]) << (8 * i);
     off += 4;
+    return v;
+  }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= ((uint64_t)(uint8_t)p[off + i]) << (8 * i);
+    off += 8;
     return v;
   }
   std::string str() {

@@ -1,0 +1,430 @@
+// symbus durable streams — the JetStream-equivalent layer SURVEY.md §5.3
+// calls for. The reference runs core NATS: at-most-once, a crashed consumer
+// silently loses in-flight work (SURVEY.md §1-L3 notes). Here:
+//
+// - a STREAM captures every publish matching its subject set into an
+//   append-only log (optionally persisted to --data-dir, replayed on boot);
+// - a durable CONSUMER GROUP gets deliveries pushed to
+//   `_SYMBUS.deliver.<stream>.<group>` — clients subscribe that subject under
+//   queue group <group>, so replicas share the work exactly like plain
+//   queue-group subscribers;
+// - messages carry X-Symbus-Stream/-Seq/-Subject/-Deliveries headers; the
+//   client acks by publishing to `_SYMBUS.ack`; unacked messages redeliver
+//   after ack_wait up to max_deliver attempts (then count as dead-lettered);
+// - everything rides the existing wire protocol: the control surface is three
+//   reserved request-reply subjects (`_SYMBUS.stream.create`,
+//   `_SYMBUS.consumer.create`, `_SYMBUS.ack`), so clients in any language
+//   get durability with zero new opcodes.
+//
+// The engine-restart story this enables (SURVEY.md §7 hard part #6):
+// vector_memory acks only after the engine confirms the upsert, so an engine
+// or worker crash between delivery and durable write redelivers the document
+// instead of losing it.
+#pragma once
+
+#include <dirent.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <ctime>
+#include <functional>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../json.hpp"
+#include "protocol.hpp"
+
+namespace symbus {
+
+inline int64_t steady_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+using HeaderList = std::vector<std::pair<std::string, std::string>>;
+
+struct StreamMsg {
+  uint64_t seq;
+  std::string subject;
+  HeaderList headers;
+  std::string data;
+};
+
+struct InFlight {
+  int64_t deadline_ms;
+  uint32_t deliveries;
+};
+
+struct ConsumerGroup {
+  std::string name;
+  std::string filter;  // subject pattern; empty = whole stream
+  uint64_t ack_floor = 0;            // all seq <= floor are acked
+  std::set<uint64_t> acked;          // acked above the floor
+  std::map<uint64_t, InFlight> inflight;
+  std::map<uint64_t, uint32_t> redeliveries;  // seq -> past delivery count
+  uint64_t next_seq = 1;             // next never-delivered seq
+  uint64_t dead_lettered = 0;
+
+  bool is_acked(uint64_t seq) const {
+    return seq <= ack_floor || acked.count(seq);
+  }
+
+  void ack(uint64_t seq) {
+    inflight.erase(seq);
+    redeliveries.erase(seq);
+    if (seq <= ack_floor) return;
+    acked.insert(seq);
+    while (acked.count(ack_floor + 1)) {
+      acked.erase(ack_floor + 1);
+      ack_floor++;
+    }
+  }
+};
+
+struct Stream {
+  std::string name;
+  std::vector<std::string> subjects;
+  int64_t ack_wait_ms = 30000;
+  uint32_t max_deliver = 5;
+  uint64_t last_seq = 0;
+  std::map<uint64_t, StreamMsg> msgs;
+  std::map<std::string, ConsumerGroup> groups;
+  FILE* log = nullptr;
+
+  bool captures(const std::string& subject) const {
+    for (const auto& pat : subjects)
+      if (subject_matches(pat, subject)) return true;
+    return false;
+  }
+};
+
+// log record types (length-prefixed frames, same framing as the wire)
+enum StreamRec : uint8_t {
+  REC_META = 0,  // json meta (subjects, ack_wait_ms, max_deliver)
+  REC_MSG = 1,   // u64 seq | str subject | u16 nh | (str,str)* | data
+  REC_ACK = 2,   // str group | u64 seq
+};
+
+class StreamEngine {
+ public:
+  // deliver(subject, headers, data): routes one frame through the broker
+  using DeliverFn =
+      std::function<int(const std::string&, const HeaderList&, const std::string&)>;
+
+  void configure(const std::string& data_dir, DeliverFn deliver) {
+    data_dir_ = data_dir;
+    deliver_ = std::move(deliver);
+    if (!data_dir_.empty()) replay_all();
+  }
+
+  // ---- control handlers (return reply JSON) -------------------------------
+
+  std::string handle_stream_create(const std::string& body) {
+    json::Value j = json::parse(body);
+    std::string name = j.at("stream").as_string();
+    if (name.empty() || name.find('/') != std::string::npos ||
+        name.find("..") != std::string::npos)
+      return err_json("bad stream name");
+    Stream& s = streams_[name];
+    bool fresh = s.name.empty();
+    s.name = name;
+    s.subjects.clear();
+    for (const auto& v : j.at("subjects").as_array())
+      s.subjects.push_back(v.as_string());
+    if (j.has("ack_wait_ms")) s.ack_wait_ms = (int64_t)j.at("ack_wait_ms").as_number();
+    if (j.has("max_deliver")) s.max_deliver = (uint32_t)j.at("max_deliver").as_number();
+    if (fresh && !data_dir_.empty()) open_log(s, /*truncate=*/false);
+    if (s.log) append_meta(s);
+    json::Value r = json::Value::object();
+    r.set("ok", json::Value(true));
+    r.set("last_seq", json::Value((double)s.last_seq));
+    return r.dump();
+  }
+
+  std::string handle_consumer_create(const std::string& body) {
+    json::Value j = json::parse(body);
+    std::string sname = j.at("stream").as_string();
+    std::string gname = j.at("group").as_string();
+    auto it = streams_.find(sname);
+    if (it == streams_.end()) return err_json("unknown stream " + sname);
+    ConsumerGroup& g = it->second.groups[gname];
+    if (g.name.empty()) g.name = gname;
+    if (j.has("filter_subject") && !j.at("filter_subject").is_null())
+      g.filter = j.at("filter_subject").as_string();
+    json::Value r = json::Value::object();
+    r.set("ok", json::Value(true));
+    r.set("ack_floor", json::Value((double)g.ack_floor));
+    return r.dump();
+  }
+
+  std::string handle_ack(const std::string& body) {
+    json::Value j = json::parse(body);
+    std::string sname = j.at("stream").as_string();
+    std::string gname = j.at("group").as_string();
+    uint64_t seq = (uint64_t)j.at("seq").as_number();
+    auto it = streams_.find(sname);
+    if (it == streams_.end()) return err_json("unknown stream " + sname);
+    auto git = it->second.groups.find(gname);
+    if (git == it->second.groups.end()) return err_json("unknown group " + gname);
+    git->second.ack(seq);
+    if (it->second.log) append_ack(it->second, gname, seq);
+    maybe_gc(it->second);
+    return "{\"ok\": true}";
+  }
+
+  // ---- capture on publish -------------------------------------------------
+
+  void capture(const std::string& subject, const HeaderList& headers,
+               const std::string& data) {
+    for (auto& [name, s] : streams_) {
+      if (!s.captures(subject)) continue;
+      uint64_t seq = ++s.last_seq;
+      s.msgs[seq] = StreamMsg{seq, subject, headers, data};
+      if (s.log) append_msg(s, s.msgs[seq]);
+    }
+  }
+
+  // ---- delivery pump (called periodically from the broker's timer) --------
+
+  void pump() {
+    int64_t now = steady_ms();
+    for (auto& [name, s] : streams_) {
+      for (auto& [gname, g] : s.groups) {
+        // redeliver expired in-flight
+        for (auto it = g.inflight.begin(); it != g.inflight.end();) {
+          if (it->second.deadline_ms > now) {
+            ++it;
+            continue;
+          }
+          uint64_t seq = it->first;
+          uint32_t deliveries = it->second.deliveries;
+          it = g.inflight.erase(it);
+          if (deliveries >= s.max_deliver) {
+            g.dead_lettered++;
+            g.ack(seq);  // drop: counted, no longer retried
+            continue;
+          }
+          g.redeliveries[seq] = deliveries;
+        }
+        // (re)deliver up to the in-flight window
+        while (g.inflight.size() < kMaxInFlight) {
+          uint64_t seq = 0;
+          uint32_t past = 0;
+          if (!g.redeliveries.empty()) {
+            seq = g.redeliveries.begin()->first;
+            past = g.redeliveries.begin()->second;
+            g.redeliveries.erase(g.redeliveries.begin());
+          } else {
+            // advance past acked seqs AND seqs outside the group's subject
+            // filter (auto-acked so the floor keeps moving and gc works)
+            for (;;) {
+              while (g.next_seq <= s.last_seq && g.is_acked(g.next_seq))
+                g.next_seq++;
+              if (g.next_seq > s.last_seq) break;
+              if (!g.filter.empty()) {
+                auto fit = s.msgs.find(g.next_seq);
+                if (fit != s.msgs.end() &&
+                    !subject_matches(g.filter, fit->second.subject)) {
+                  g.ack(g.next_seq);
+                  continue;
+                }
+              }
+              break;
+            }
+            if (g.next_seq > s.last_seq) break;
+            seq = g.next_seq++;
+          }
+          auto mit = s.msgs.find(seq);
+          if (mit == s.msgs.end()) continue;  // gc'd (already acked)
+          HeaderList h = mit->second.headers;
+          h.emplace_back("X-Symbus-Stream", s.name);
+          h.emplace_back("X-Symbus-Group", gname);
+          h.emplace_back("X-Symbus-Seq", std::to_string(seq));
+          h.emplace_back("X-Symbus-Subject", mit->second.subject);
+          h.emplace_back("X-Symbus-Deliveries", std::to_string(past + 1));
+          int targets = deliver_("_SYMBUS.deliver." + s.name + "." + gname, h,
+                                 mit->second.data);
+          if (targets == 0) {
+            // nobody listening: put it back and stop pushing this group
+            g.redeliveries[seq] = past;
+            break;
+          }
+          g.inflight[seq] = InFlight{now + s.ack_wait_ms, past + 1};
+        }
+      }
+    }
+  }
+
+  std::string stats_json() {
+    json::Value o = json::Value::object();
+    for (auto& [name, s] : streams_) {
+      json::Value sv = json::Value::object();
+      sv.set("last_seq", json::Value((double)s.last_seq));
+      sv.set("stored", json::Value((double)s.msgs.size()));
+      json::Value gv = json::Value::object();
+      for (auto& [gname, g] : s.groups) {
+        json::Value one = json::Value::object();
+        one.set("ack_floor", json::Value((double)g.ack_floor));
+        one.set("inflight", json::Value((double)g.inflight.size()));
+        one.set("dead_lettered", json::Value((double)g.dead_lettered));
+        gv.set(gname, std::move(one));
+      }
+      sv.set("groups", std::move(gv));
+      o.set(name, std::move(sv));
+    }
+    return o.dump();
+  }
+
+ private:
+  static constexpr size_t kMaxInFlight = 64;
+
+  static std::string err_json(const std::string& m) {
+    json::Value o = json::Value::object();
+    o.set("ok", json::Value(false));
+    o.set("error", json::Value(m));
+    return o.dump();
+  }
+
+  // gc: drop messages acked by EVERY group (bounded memory/log growth is the
+  // log's job via restart compaction; in-memory map trims eagerly)
+  void maybe_gc(Stream& s) {
+    if (s.groups.empty()) return;
+    uint64_t floor = UINT64_MAX;
+    for (auto& [n, g] : s.groups) floor = std::min(floor, g.ack_floor);
+    while (!s.msgs.empty() && s.msgs.begin()->first <= floor)
+      s.msgs.erase(s.msgs.begin());
+  }
+
+  // ---- persistence --------------------------------------------------------
+
+  std::string log_path(const std::string& name) const {
+    return data_dir_ + "/" + name + ".symlog";
+  }
+
+  void open_log(Stream& s, bool truncate) {
+    s.log = std::fopen(log_path(s.name).c_str(), truncate ? "wb" : "ab");
+  }
+
+  void write_frame(Stream& s, const Writer& w) {
+    std::string f = w.frame();
+    std::fwrite(f.data(), 1, f.size(), s.log);
+    std::fflush(s.log);
+  }
+
+  void append_meta(Stream& s) {
+    json::Value m = json::Value::object();
+    json::Value subj = json::Value::array();
+    for (const auto& p : s.subjects) subj.push_back(json::Value(p));
+    m.set("subjects", std::move(subj));
+    m.set("ack_wait_ms", json::Value((double)s.ack_wait_ms));
+    m.set("max_deliver", json::Value((double)s.max_deliver));
+    Writer w;
+    w.u8(REC_META);
+    w.data(m.dump());
+    write_frame(s, w);
+  }
+
+  void append_msg(Stream& s, const StreamMsg& m) {
+    Writer w;
+    w.u8(REC_MSG);
+    w.u64(m.seq);
+    w.str(m.subject);
+    w.u16((uint16_t)m.headers.size());
+    for (const auto& [k, v] : m.headers) {
+      w.str(k);
+      w.str(v);
+    }
+    w.data(m.data);
+    write_frame(s, w);
+  }
+
+  void append_ack(Stream& s, const std::string& group, uint64_t seq) {
+    Writer w;
+    w.u8(REC_ACK);
+    w.str(group);
+    w.u64(seq);
+    write_frame(s, w);
+  }
+
+  void replay_all() {
+    // scan data_dir for *.symlog
+    std::string cmd_dir = data_dir_;
+    DIR* d = ::opendir(cmd_dir.c_str());
+    if (!d) return;
+    struct dirent* e;
+    while ((e = ::readdir(d)) != nullptr) {
+      std::string fn = e->d_name;
+      const std::string suffix = ".symlog";
+      if (fn.size() <= suffix.size() ||
+          fn.compare(fn.size() - suffix.size(), suffix.size(), suffix) != 0)
+        continue;
+      replay_one(fn.substr(0, fn.size() - suffix.size()));
+    }
+    ::closedir(d);
+  }
+
+  void replay_one(const std::string& name) {
+    FILE* f = std::fopen(log_path(name).c_str(), "rb");
+    if (!f) return;
+    Stream& s = streams_[name];
+    s.name = name;
+    std::string buf;
+    char chunk[65536];
+    size_t n;
+    while ((n = std::fread(chunk, 1, sizeof(chunk), f)) > 0) buf.append(chunk, n);
+    std::fclose(f);
+    size_t off = 0;
+    while (off + 4 <= buf.size()) {
+      uint32_t len = 0;
+      for (int i = 0; i < 4; ++i)
+        len |= ((uint32_t)(uint8_t)buf[off + i]) << (8 * i);
+      if (len == 0 || off + 4 + len > buf.size()) break;  // torn tail: stop
+      try {
+        Reader r(buf.data() + off + 4, len);
+        uint8_t rec = r.u8();
+        if (rec == REC_META) {
+          json::Value m = json::parse(r.data());
+          s.subjects.clear();
+          for (const auto& v : m.at("subjects").as_array())
+            s.subjects.push_back(v.as_string());
+          s.ack_wait_ms = (int64_t)m.at("ack_wait_ms").as_number();
+          s.max_deliver = (uint32_t)m.at("max_deliver").as_number();
+        } else if (rec == REC_MSG) {
+          StreamMsg msg;
+          msg.seq = r.u64();
+          msg.subject = r.str();
+          uint16_t nh = r.u16();
+          for (uint16_t i = 0; i < nh; ++i) {
+            std::string k = r.str();
+            msg.headers.emplace_back(k, r.str());
+          }
+          msg.data = r.data();
+          s.last_seq = std::max(s.last_seq, msg.seq);
+          s.msgs[msg.seq] = std::move(msg);
+        } else if (rec == REC_ACK) {
+          std::string group = r.str();
+          uint64_t seq = r.u64();
+          ConsumerGroup& g = s.groups[group];
+          if (g.name.empty()) g.name = group;
+          g.ack(seq);
+        }
+      } catch (const std::exception&) {
+        break;  // corrupt record: stop replay at last good frame
+      }
+      off += 4 + len;
+    }
+    // consumers resume after the acked prefix
+    for (auto& [gname, g] : s.groups) g.next_seq = g.ack_floor + 1;
+    maybe_gc(s);
+    open_log(s, /*truncate=*/false);
+  }
+
+  std::string data_dir_;
+  DeliverFn deliver_;
+  std::map<std::string, Stream> streams_;
+};
+
+}  // namespace symbus

@@ -8,6 +8,7 @@ frames, little-endian).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import struct
 from typing import Dict, Optional
@@ -181,6 +182,63 @@ class TcpBus:
             return msg
         finally:
             sub.close()
+
+    # -------------------------------------------------- durable streams
+    # The broker-side JetStream equivalent (native/symbus/streams.hpp): the
+    # control surface is three reserved request-reply subjects, so no new
+    # opcodes. See SURVEY.md §5.3 for why the reference's core-NATS
+    # at-most-once stance loses in-flight work.
+
+    async def add_stream(self, name: str, subjects: list,
+                         ack_wait_s: float = 30.0, max_deliver: int = 5,
+                         timeout: float = 10.0) -> dict:
+        """Create/refresh a durable stream capturing `subjects` patterns."""
+        req = {"stream": name, "subjects": list(subjects),
+               "ack_wait_ms": int(ack_wait_s * 1000),
+               "max_deliver": int(max_deliver)}
+        msg = await self.request("_SYMBUS.stream.create",
+                                 json.dumps(req).encode(), timeout)
+        out = json.loads(msg.data)
+        if not out.get("ok"):
+            raise RuntimeError(f"stream create failed: {out.get('error')}")
+        return out
+
+    async def durable_subscribe(self, stream: str, group: str,
+                                filter_subject: Optional[str] = None,
+                                maxsize: int = 1024,
+                                timeout: float = 10.0) -> Subscription:
+        """Join durable consumer group `group` on `stream`.
+
+        Returns a Subscription of redeliverable messages (headers carry
+        X-Symbus-Seq etc.); the consumer must call `bus.ack(msg)` after the
+        side effect is durable, or the message redelivers after ack_wait.
+        Replicas calling this with the same group share the stream
+        (queue-group delivery). `filter_subject` narrows the group to one
+        subject pattern of a multi-subject stream (non-matching messages are
+        auto-acked for this group)."""
+        sub = await self.subscribe(f"_SYMBUS.deliver.{stream}.{group}",
+                                   queue=group, maxsize=maxsize)
+        msg = await self.request(
+            "_SYMBUS.consumer.create",
+            json.dumps({"stream": stream, "group": group,
+                        "filter_subject": filter_subject}).encode(), timeout)
+        out = json.loads(msg.data)
+        if not out.get("ok"):
+            sub.close()
+            raise RuntimeError(f"consumer create failed: {out.get('error')}")
+        return sub
+
+    async def ack(self, msg: Msg) -> None:
+        """Acknowledge a durable delivery (ack-after-durable, the reference's
+        Qdrant wait=true stance — SURVEY.md §5.4)."""
+        payload = {"stream": msg.headers["X-Symbus-Stream"],
+                   "group": msg.headers["X-Symbus-Group"],
+                   "seq": int(msg.headers["X-Symbus-Seq"])}
+        await self.publish("_SYMBUS.ack", json.dumps(payload).encode())
+
+    async def stream_stats(self, timeout: float = 10.0) -> dict:
+        msg = await self.request("_SYMBUS.stats", b"{}", timeout)
+        return json.loads(msg.data)
 
     async def flush(self) -> None:
         """Round-trip PING — guarantees prior publishes were processed."""

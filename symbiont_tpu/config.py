@@ -32,6 +32,12 @@ class BusConfig:
     url: str = "inproc://"
     request_timeout_embed_s: float = 15.0  # reference: api_service/src/main.rs:310
     request_timeout_search_s: float = 20.0  # reference: api_service/src/main.rs:430
+    # at-least-once pipeline: durable streams on the native broker (SURVEY.md
+    # §5.3 — the reference's core NATS silently loses in-flight work). Only
+    # effective on symbus:// transports; the in-proc bus stays at-most-once.
+    durable: bool = False
+    durable_ack_wait_s: float = 60.0
+    durable_max_deliver: int = 5
 
 
 @dataclass

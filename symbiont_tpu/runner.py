@@ -16,6 +16,7 @@ import logging
 import signal
 from typing import Optional
 
+from symbiont_tpu import subjects
 from symbiont_tpu.bus import connect
 from symbiont_tpu.config import SymbiontConfig, load_config
 from symbiont_tpu.engine.engine import TpuEngine
@@ -67,6 +68,24 @@ class SymbiontStack:
 
         self.services = []
         self.bus = self._bus_override or await connect(cfg.bus.url)
+
+        # at-least-once pipeline (SURVEY.md §5.3): one durable stream captures
+        # the fire-and-forget subjects; each consumer acks after its side
+        # effect lands. Request-reply subjects stay core (their failure mode
+        # is the caller's timeout + retry).
+        pipeline_stream = None
+        if cfg.bus.durable and hasattr(self.bus, "add_stream"):
+            pipeline_stream = "pipeline"
+            await self.bus.add_stream(
+                pipeline_stream,
+                [subjects.DATA_RAW_TEXT_DISCOVERED,
+                 subjects.DATA_TEXT_WITH_EMBEDDINGS,
+                 subjects.DATA_PROCESSED_TEXT_TOKENIZED],
+                ack_wait_s=cfg.bus.durable_ack_wait_s,
+                max_deliver=cfg.bus.durable_max_deliver)
+        elif cfg.bus.durable:
+            log.warning("bus.durable requested but transport %s has no "
+                        "durable streams (use symbus://)", cfg.bus.url)
         if on("preprocessing") or on("engine"):
             self.engine = self._engine_override or TpuEngine(cfg.engine,
                                                              mesh=self._mesh)
@@ -109,11 +128,14 @@ class SymbiontStack:
                 PerceptionService(self.bus, cfg.perception, fetcher=self._fetcher))
         if on("preprocessing"):
             self.services.append(
-                PreprocessingService(self.bus, self.engine, batcher=batcher))
+                PreprocessingService(self.bus, self.engine, batcher=batcher,
+                     durable_stream=pipeline_stream))
         if on("vector_memory"):
-            self.services.append(VectorMemoryService(self.bus, self.vector_store))
+            self.services.append(VectorMemoryService(
+                self.bus, self.vector_store, durable_stream=pipeline_stream))
         if on("knowledge_graph"):
-            self.services.append(KnowledgeGraphService(self.bus, self.graph_store))
+            self.services.append(KnowledgeGraphService(
+                self.bus, self.graph_store, durable_stream=pipeline_stream))
         if on("text_generator"):
             # with the LM backend active, skip Markov ingest training — the
             # chain would grow unboundedly while never being used to generate

@@ -44,25 +44,43 @@ class Service:
         raise NotImplementedError
 
     async def _subscribe_loop(self, subject: str, handler: Handler,
-                              queue: Optional[str] = None) -> None:
-        sub = await self.bus.subscribe(subject, queue=queue)
+                              queue: Optional[str] = None,
+                              durable_stream: Optional[str] = None) -> None:
+        """Dispatch loop. With `durable_stream` (and a bus that supports it),
+        consumption is at-least-once: the delivery is acked only after the
+        handler returns, so a crash mid-handler redelivers (SURVEY.md §5.3 —
+        ack-after-durable, the stance the reference's wait=true upserts take
+        at the storage layer but its bus never did)."""
+        durable = (durable_stream is not None and queue is not None
+                   and hasattr(self.bus, "durable_subscribe"))
+        if durable:
+            sub = await self.bus.durable_subscribe(durable_stream, queue,
+                                                   filter_subject=subject)
+        else:
+            sub = await self.bus.subscribe(subject, queue=queue)
         self._subs.append(sub)
 
         async def loop() -> None:
             async for msg in sub:
                 await self._sem.acquire()
-                task = asyncio.create_task(self._run_handler(subject, handler, msg))
+                task = asyncio.create_task(
+                    self._run_handler(subject, handler, msg, ack=durable))
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
 
         t = asyncio.create_task(loop(), name=f"{self.name}:{subject}")
         self._loops.append(t)
 
-    async def _run_handler(self, subject: str, handler: Handler, msg: Msg) -> None:
+    async def _run_handler(self, subject: str, handler: Handler, msg: Msg,
+                           ack: bool = False) -> None:
         try:
             metrics.inc(f"{self.name}.{subject}.consumed")
             with span(f"{self.name}.handle", msg.headers, subject=subject):
                 await handler(msg)
+            if ack:
+                # ack-after-success: a failed handler leaves the message
+                # unacked for redelivery
+                await self.bus.ack(msg)
         except Exception:
             metrics.inc(f"{self.name}.{subject}.failed")
             log.exception("%s: handler failed for %s", self.name, subject)

@@ -24,15 +24,17 @@ log = logging.getLogger(__name__)
 class KnowledgeGraphService(Service):
     name = "knowledge_graph"
 
-    def __init__(self, bus, store: GraphStore):
+    def __init__(self, bus, store: GraphStore, durable_stream=None):
         super().__init__(bus)
         self.store = store
         self.store.ensure_schema()  # retry-at-startup parity (main.rs:253-284)
+        self.durable_stream = durable_stream
 
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
                                    self._handle_tokenized,
-                                   queue=subjects.QUEUE_KNOWLEDGE_GRAPH)
+                                   queue=subjects.QUEUE_KNOWLEDGE_GRAPH,
+                                   durable_stream=self.durable_stream)
 
     async def _handle_tokenized(self, msg: Msg) -> None:
         m = from_json(TokenizedTextMessage, msg.data)

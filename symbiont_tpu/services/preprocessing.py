@@ -48,12 +48,14 @@ class PreprocessingService(Service):
 
     def __init__(self, bus, engine: TpuEngine,
                  batcher: Optional[MicroBatcher] = None,
-                 publish_tokenized: bool = True):
+                 publish_tokenized: bool = True,
+                 durable_stream: Optional[str] = None):
         super().__init__(bus)
         self.engine = engine
         self.batcher = batcher or MicroBatcher(engine)
         self.publish_tokenized = publish_tokenized
         self.model_name = engine.config.model_name
+        self.durable_stream = durable_stream
 
     async def start(self) -> None:
         await self.batcher.start()
@@ -66,7 +68,8 @@ class PreprocessingService(Service):
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.DATA_RAW_TEXT_DISCOVERED,
                                    self._handle_raw_text,
-                                   queue=subjects.QUEUE_PREPROCESSING)
+                                   queue=subjects.QUEUE_PREPROCESSING,
+                                   durable_stream=self.durable_stream)
         await self._subscribe_loop(subjects.TASKS_EMBEDDING_FOR_QUERY,
                                    self._handle_query_embedding,
                                    queue=subjects.QUEUE_PREPROCESSING)

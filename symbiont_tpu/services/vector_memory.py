@@ -36,15 +36,17 @@ log = logging.getLogger(__name__)
 class VectorMemoryService(Service):
     name = "vector_memory"
 
-    def __init__(self, bus, store: VectorStore):
+    def __init__(self, bus, store: VectorStore, durable_stream=None):
         super().__init__(bus)
         self.store = store
         self.store.ensure_collection()
+        self.durable_stream = durable_stream
 
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.DATA_TEXT_WITH_EMBEDDINGS,
                                    self._handle_upsert,
-                                   queue=subjects.QUEUE_VECTOR_MEMORY)
+                                   queue=subjects.QUEUE_VECTOR_MEMORY,
+                                   durable_stream=self.durable_stream)
         await self._subscribe_loop(subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
                                    self._handle_search,
                                    queue=subjects.QUEUE_VECTOR_MEMORY)

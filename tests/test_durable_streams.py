@@ -1,0 +1,229 @@
+"""Durable streams: the JetStream-equivalent layer over the native broker.
+
+The reference runs core NATS — at-most-once, a crashed consumer silently
+loses in-flight work (SURVEY.md §1-L3 notes, §5.3). These tests prove the
+four durability properties the design claims:
+
+1. capture + push delivery with seq headers, ack advances the floor;
+2. an unacked delivery redelivers after ack_wait (consumer crash story);
+3. replicas in one group share the stream; a message delivered to a dead
+   replica fails over to the live one;
+4. messages and acks survive a broker restart (--data-dir log replay).
+"""
+
+import asyncio
+import json
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_broker(port: int, data_dir=None):
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
+                   capture_output=True)
+    args = [str(REPO / "native" / "build" / "symbus_broker"),
+            "--port", str(port), "--host", "127.0.0.1"]
+    if data_dir:
+        args += ["--data-dir", str(data_dir)]
+    proc = subprocess.Popen(args, stderr=subprocess.PIPE)
+    for _ in range(100):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return proc
+        except OSError:
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("broker did not start")
+
+
+def _stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+async def _bus(port):
+    from symbiont_tpu.bus.tcp import TcpBus
+
+    bus = TcpBus("127.0.0.1", port)
+    await bus.connect()
+    return bus
+
+
+def test_capture_deliver_ack_and_redelivery():
+    port = _free_port()
+    proc = _start_broker(port)
+    try:
+        async def scenario():
+            bus = await _bus(port)
+            await bus.add_stream("ingest", ["data.raw_text.>"],
+                                 ack_wait_s=1.0, max_deliver=3)
+
+            # capture happens with NO subscriber connected (at-least-once)
+            await bus.publish("data.raw_text.discovered", b'{"n": 1}')
+            await bus.publish("data.raw_text.discovered", b'{"n": 2}')
+            await bus.publish("data.other", b"not captured")
+
+            sub = await bus.durable_subscribe("ingest", "workers")
+            m1 = await sub.next(5.0)
+            m2 = await sub.next(5.0)
+            assert m1 is not None and m2 is not None
+            assert {json.loads(m1.data)["n"], json.loads(m2.data)["n"]} == {1, 2}
+            assert m1.headers["X-Symbus-Stream"] == "ingest"
+            assert m1.headers["X-Symbus-Subject"] == "data.raw_text.discovered"
+            assert m1.headers["X-Symbus-Deliveries"] == "1"
+            seqs = {int(m1.headers["X-Symbus-Seq"]),
+                    int(m2.headers["X-Symbus-Seq"])}
+            assert seqs == {1, 2}
+
+            # ack only the first; the second must redeliver after ack_wait=1s
+            await bus.ack(m1)
+            first_unacked = m1 if False else m2  # m2 stays unacked
+            r = await sub.next(5.0)
+            assert r is not None, "no redelivery of unacked message"
+            assert int(r.headers["X-Symbus-Seq"]) == int(
+                first_unacked.headers["X-Symbus-Seq"])
+            assert int(r.headers["X-Symbus-Deliveries"]) == 2
+            await bus.ack(r)
+
+            stats = await bus.stream_stats()
+            g = stats["ingest"]["groups"]["workers"]
+            assert g["ack_floor"] == 2 and g["inflight"] == 0
+            await bus.close()
+
+        asyncio.run(scenario())
+    finally:
+        _stop(proc)
+
+
+def test_max_deliver_dead_letters():
+    port = _free_port()
+    proc = _start_broker(port)
+    try:
+        async def scenario():
+            bus = await _bus(port)
+            await bus.add_stream("dl", ["dl.subject"], ack_wait_s=0.3,
+                                 max_deliver=2)
+            await bus.publish("dl.subject", b"poison")
+            sub = await bus.durable_subscribe("dl", "g")
+            # never ack: 2 deliveries then dead-letter
+            d1 = await sub.next(5.0)
+            d2 = await sub.next(5.0)
+            assert d1 is not None and d2 is not None
+            assert int(d2.headers["X-Symbus-Deliveries"]) == 2
+            assert await sub.next(1.0) is None, "delivered past max_deliver"
+            stats = await bus.stream_stats()
+            assert stats["dl"]["groups"]["g"]["dead_lettered"] == 1
+            await bus.close()
+
+        asyncio.run(scenario())
+    finally:
+        _stop(proc)
+
+
+def test_replica_failover():
+    port = _free_port()
+    proc = _start_broker(port)
+    try:
+        async def scenario():
+            bus_pub = await _bus(port)
+            await bus_pub.add_stream("fo", ["fo.docs"], ack_wait_s=0.5,
+                                     max_deliver=5)
+            # two replicas join the same group on separate connections
+            replica_a = await _bus(port)
+            replica_b = await _bus(port)
+            sub_a = await replica_a.durable_subscribe("fo", "g")
+            sub_b = await replica_b.durable_subscribe("fo", "g")
+
+            for i in range(6):
+                await bus_pub.publish("fo.docs", json.dumps({"i": i}).encode())
+
+            got_a, got_b = [], []
+            for _ in range(40):
+                ma = await sub_a.next(0.1)
+                if ma is not None:
+                    got_a.append(ma)
+                    await replica_a.ack(ma)
+                mb = await sub_b.next(0.1)
+                if mb is not None:
+                    got_b.append(mb)
+                    await replica_b.ack(mb)
+                if len(got_a) + len(got_b) >= 6:
+                    break
+            assert len(got_a) + len(got_b) == 6
+            # round-robin: both replicas participated
+            assert got_a and got_b
+
+            # replica A dies holding an unacked delivery → B gets it
+            await bus_pub.publish("fo.docs", b'{"i": 99}')
+            await asyncio.sleep(0.15)  # let the pump deliver somewhere
+            await replica_a.close()    # A crashes without acking
+            m = await sub_b.next(5.0)
+            assert m is not None and json.loads(m.data)["i"] == 99
+            await replica_b.ack(m)
+            await replica_b.close()
+            await bus_pub.close()
+
+        asyncio.run(scenario())
+    finally:
+        _stop(proc)
+
+
+def test_persistence_across_broker_restart(tmp_path):
+    port = _free_port()
+    data_dir = tmp_path / "streams"
+    data_dir.mkdir()
+    proc = _start_broker(port, data_dir)
+    try:
+        async def phase1():
+            bus = await _bus(port)
+            await bus.add_stream("p", ["p.docs"], ack_wait_s=5.0)
+            for i in range(3):
+                await bus.publish("p.docs", json.dumps({"i": i}).encode())
+            sub = await bus.durable_subscribe("p", "g")
+            m = await sub.next(5.0)
+            assert json.loads(m.data)["i"] == 0
+            await bus.ack(m)
+            await asyncio.sleep(0.2)  # let the ack land in the log
+            await bus.close()
+
+        asyncio.run(phase1())
+    finally:
+        _stop(proc)
+
+    assert (data_dir / "p.symlog").stat().st_size > 0
+    proc = _start_broker(port, data_dir)
+    try:
+        async def phase2():
+            bus = await _bus(port)
+            # no add_stream: the stream was replayed from the log
+            sub = await bus.durable_subscribe("p", "g")
+            got = []
+            for _ in range(2):
+                m = await sub.next(5.0)
+                assert m is not None, f"only {got} after restart"
+                got.append(json.loads(m.data)["i"])
+                await bus.ack(m)
+            assert sorted(got) == [1, 2]  # 0 was acked before the restart
+            assert await sub.next(0.5) is None
+            await bus.close()
+
+        asyncio.run(phase2())
+    finally:
+        _stop(proc)
