@@ -52,19 +52,40 @@ inline std::string env_or(const char* key, const std::string& dflt) {
   return (v && *v) ? std::string(v) : dflt;
 }
 
-// uuid4 (same shape as the Python side's generate_uuid)
-inline std::string uuid4() {
-  static thread_local std::mt19937_64 rng{std::random_device{}()};
-  uint64_t a = rng(), b = rng();
-  a = (a & 0xffffffffffff0fffULL) | 0x0000000000004000ULL;  // version 4
-  b = (b & 0x3fffffffffffffffULL) | 0x8000000000000000ULL;  // variant 10
+// RFC-4122 text form from a 128-bit value, with the version nibble and
+// variant bits forced (shared by random uuid4 and deterministic point ids).
+inline std::string format_uuid(uint64_t hi, uint64_t lo, unsigned version) {
+  hi = (hi & 0xffffffffffff0fffULL) | ((uint64_t)version << 12);
+  lo = (lo & 0x3fffffffffffffffULL) | 0x8000000000000000ULL;  // variant 10
   char out[37];
   std::snprintf(out, sizeof(out),
                 "%08x-%04x-%04x-%04x-%04x%08x",
-                (uint32_t)(a >> 32), (uint32_t)((a >> 16) & 0xffff),
-                (uint32_t)(a & 0xffff), (uint32_t)(b >> 48),
-                (uint32_t)((b >> 32) & 0xffff), (uint32_t)(b & 0xffffffff));
+                (uint32_t)(hi >> 32), (uint32_t)((hi >> 16) & 0xffff),
+                (uint32_t)(hi & 0xffff), (uint32_t)(lo >> 48),
+                (uint32_t)((lo >> 32) & 0xffff), (uint32_t)(lo & 0xffffffff));
   return std::string(out);
+}
+
+// uuid4 (same shape as the Python side's generate_uuid)
+inline std::string uuid4() {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  return format_uuid(rng(), rng(), 4);
+}
+
+// Deterministic UUID-shaped id for a (document, sentence_order) pair —
+// byte-for-byte identical to Python's utils.ids.deterministic_point_id, so a
+// durable redelivery (or a mixed Python/C++ queue group) overwrites the same
+// vector point instead of duplicating it.
+inline uint64_t fnv1a64(const std::string& data) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (unsigned char b : data) h = (h ^ b) * 0x100000001B3ULL;
+  return h;
+}
+
+inline std::string deterministic_point_id(const std::string& doc_id,
+                                          uint64_t order) {
+  std::string key = doc_id + '\0' + std::to_string(order);
+  return format_uuid(fnv1a64(key), fnv1a64(key + '\1'), 5);
 }
 
 inline uint64_t now_ms() {
@@ -137,6 +158,21 @@ inline bool connect_with_retry(symbus::Client& c, const std::string& service,
     }
   }
   return false;
+}
+
+// Engine request-reply unwrap shared by the worker shells: request, throw on
+// timeout, parse, throw on a non-null error_message (the engine plane's typed
+// error convention, symbiont_tpu/services/engine_service.py).
+inline json::Value engine_call(symbus::Client& bus, const char* subject,
+                               const json::Value& req, int timeout_ms,
+                               const std::map<std::string, std::string>& headers) {
+  auto reply = bus.request(subject, req.dump(), timeout_ms, headers);
+  if (!reply) throw std::runtime_error(std::string(subject) + " timed out");
+  json::Value r = json::parse(reply->data);
+  if (!r.at("error_message").is_null())
+    throw std::runtime_error("engine error: " +
+                             r.at("error_message").as_string());
+  return r;
 }
 
 // Durable pipeline opt-in (SYMBIONT_BUS_DURABLE=1): ensure the shared

@@ -1,0 +1,91 @@
+// knowledge_graph worker — C++ shell of the reference's knowledge_graph_service
+// (SURVEY.md §2 checklist item 6; reference:
+// services/knowledge_graph_service/src/main.rs). The store itself is the
+// embedded sqlite property graph owned by the engine process (MERGE-semantics
+// parity, symbiont_tpu/graph/store.py) reached over engine.graph.save
+// request-reply — replacing the reference's Neo4j Bolt hop, same two-plane
+// split as the native vector_memory worker.
+//
+// Role, same as the reference's handler (main.rs:142-156): consume
+// data.processed_text.tokenized → persist the whole document in one
+// transaction (main.rs:23-140). In the reference this consumer is orphaned —
+// nothing publishes the subject in v0.3.0 (SURVEY.md fact #3); here the
+// preprocessing workers publish it, so this shell is live.
+//
+// Durable mode (SYMBIONT_BUS_DURABLE=1): ack only after the engine confirms
+// the transaction committed — a crash between delivery and commit redelivers
+// instead of silently losing the document (SURVEY.md §5.3's gap).
+//
+// Usage: knowledge_graph [SYMBIONT_BUS_URL=...] [SYMBIONT_ENGINE_TIMEOUT_MS=...]
+
+#include <string>
+
+#include "../../generated/cpp/symbiont_schema.hpp"
+#include "common.hpp"
+
+namespace {
+
+const char* SERVICE = "knowledge_graph";
+
+}  // namespace
+
+int main() try {
+  int engine_timeout_ms =
+      std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
+
+  symbus::Client bus;
+  if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
+
+  bool durable = symbiont::maybe_setup_pipeline_stream(bus);
+  if (durable)
+    bus.durable_subscribe("pipeline", symbiont::subjects::Q_KNOWLEDGE_GRAPH,
+                          symbiont::subjects::DATA_PROCESSED_TEXT_TOKENIZED);
+  else
+    bus.subscribe(symbiont::subjects::DATA_PROCESSED_TEXT_TOKENIZED,
+                  symbiont::subjects::Q_KNOWLEDGE_GRAPH);
+  symbiont::logline("INFO", SERVICE, durable ? "ready (durable)" : "ready");
+
+  while (bus.connected()) {
+    auto msg = bus.next(1000);
+    if (!msg) continue;
+
+    symbiont::TokenizedTextMessage m;
+    try {
+      m = symbiont::TokenizedTextMessage::parse(msg->data);
+    } catch (const std::exception& e) {
+      // reference logs-and-continues on bad payloads (main.rs:296-301)
+      symbiont::logline("WARN", SERVICE,
+                        std::string("bad tokenized message: ") + e.what(),
+                        msg->headers);
+      bus.ack(*msg);  // permanent failure: redelivery cannot help
+      continue;
+    }
+    auto headers = symbiont::child_headers(msg->headers);
+    json::Value req = json::Value::object();
+    req.set("message", m.to_json());
+    try {
+      // request-reply == ack-after-commit (reference: explicit tx.commit,
+      // main.rs:132-134)
+      json::Value r = symbiont::engine_call(bus, "engine.graph.save", req,
+                                            engine_timeout_ms, headers);
+      symbiont::logline(
+          "INFO", SERVICE,
+          "saved doc " + m.original_id + " (db id " +
+              std::to_string((int64_t)r.at("document_db_id").as_number()) +
+              ", " + std::to_string(m.sentences.size()) + " sentences, " +
+              std::to_string(m.tokens.size()) + " tokens)",
+          headers);
+      bus.ack(*msg);  // the transaction committed; safe to drop from stream
+    } catch (const std::exception& e) {
+      // transient (engine down / timeout): leave unacked so the durable
+      // stream redelivers after ack_wait
+      symbiont::logline("WARN", SERVICE,
+                        std::string("graph save failed: ") + e.what(), headers);
+    }
+  }
+  symbiont::logline("INFO", SERVICE, "bus connection closed; exiting");
+  return 0;
+} catch (const std::exception& e) {
+  symbiont::logline("ERROR", SERVICE, std::string("fatal: ") + e.what());
+  return 1;
+}

@@ -66,7 +66,8 @@ int main() try {
   // publishes succeed (SURVEY.md §5.3). Query request-reply stays core.
   bool durable = symbiont::maybe_setup_pipeline_stream(bus);
   uint32_t sid_raw =
-      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_PREPROCESSING)
+      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_PREPROCESSING,
+                                      symbiont::subjects::DATA_RAW_TEXT_DISCOVERED)
               : bus.subscribe(symbiont::subjects::DATA_RAW_TEXT_DISCOVERED,
                               symbiont::subjects::Q_PREPROCESSING);
   uint32_t sid_query = bus.subscribe(symbiont::subjects::TASKS_EMBEDDING_FOR_QUERY,

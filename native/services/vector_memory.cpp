@@ -23,17 +23,7 @@ namespace {
 
 const char* SERVICE = "vector_memory";
 
-json::Value engine_call(symbus::Client& bus, const char* subject,
-                        const json::Value& req, int timeout_ms,
-                        const std::map<std::string, std::string>& headers) {
-  auto reply = bus.request(subject, req.dump(), timeout_ms, headers);
-  if (!reply) throw std::runtime_error(std::string(subject) + " timed out");
-  json::Value r = json::parse(reply->data);
-  if (!r.at("error_message").is_null())
-    throw std::runtime_error("engine error: " +
-                             r.at("error_message").as_string());
-  return r;
-}
+using symbiont::engine_call;
 
 }  // namespace
 
@@ -49,7 +39,8 @@ int main() try {
   // restart between delivery and write redelivers instead of losing data)
   bool durable = symbiont::maybe_setup_pipeline_stream(bus);
   uint32_t sid_store =
-      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_VECTOR_MEMORY)
+      durable ? bus.durable_subscribe("pipeline", symbiont::subjects::Q_VECTOR_MEMORY,
+                                      symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS)
               : bus.subscribe(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
                               symbiont::subjects::Q_VECTOR_MEMORY);
   uint32_t sid_search = bus.subscribe(symbiont::subjects::TASKS_SEARCH_SEMANTIC_REQUEST,
@@ -69,6 +60,7 @@ int main() try {
         symbiont::logline("WARN", SERVICE,
                           std::string("bad embeddings message: ") + e.what(),
                           msg->headers);
+        bus.ack(*msg);  // permanent failure: redelivery cannot help
         continue;
       }
       auto headers = symbiont::child_headers(msg->headers);
@@ -84,7 +76,8 @@ int main() try {
         payload.model_name = m.model_name;
         payload.processed_at_ms = now;
         json::Value p = json::Value::object();
-        p.set("id", json::Value(symbiont::uuid4()));
+        p.set("id", json::Value(
+                        symbiont::deterministic_point_id(m.original_id, order)));
         p.set("vector", json::to_array(se.embedding, [](const float& x) {
           return json::Value(x);
         }));
@@ -102,7 +95,10 @@ int main() try {
                               std::to_string((uint64_t)r.at("upserted").as_number()) +
                               " points for doc " + m.original_id,
                           headers);
+        bus.ack(*msg);  // upsert is durable; safe to drop from stream
       } catch (const std::exception& e) {
+        // transient (engine down / timeout): leave unacked so the durable
+        // stream redelivers after ack_wait
         symbiont::logline("WARN", SERVICE,
                           std::string("upsert failed: ") + e.what(), headers);
       }

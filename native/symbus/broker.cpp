@@ -5,10 +5,12 @@
 // pub/sub with NATS-style wildcards, queue groups (round-robin), reply
 // passthrough for inbox request-reply, and header forwarding.
 //
-// Concurrency model: one reader thread per connection; shared subscription
-// table under one mutex; per-connection write mutex so MSG frames never
-// interleave. Slow consumers are disconnected when their socket send queue
-// stalls past the write timeout (core-NATS-style slow-consumer policy).
+// Concurrency model: one reader thread + one writer thread per connection;
+// shared subscription table under one mutex. Outbound frames go through a
+// bounded per-connection queue drained by the writer thread, so routing (and
+// the durable-stream pump) never blocks on a socket; a consumer that lets
+// kMaxOutqBytes of backlog pile up is disconnected (core-NATS-style
+// slow-consumer policy).
 //
 // Usage: symbus_broker [--port 4233] [--host 0.0.0.0]
 
@@ -20,8 +22,10 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -40,7 +44,10 @@ struct Subscription {
   uint32_t sid;
   std::string pattern;
   std::string queue;
-  Conn* conn;
+  // shared ownership: route()/pump snapshot targets and send after releasing
+  // the broker mutex; holding the Conn alive through the send closes the
+  // use-after-free window against a concurrent disconnect
+  std::shared_ptr<Conn> conn;
 };
 
 struct Broker;
@@ -49,19 +56,77 @@ struct Conn {
   int fd;
   Broker* broker;
   std::mutex write_mu;
+  std::condition_variable write_cv;
+  std::deque<std::string> outq;
+  size_t outq_bytes = 0;
   std::atomic<bool> open{true};
+  std::thread writer;
 
-  explicit Conn(int fd_, Broker* b) : fd(fd_), broker(b) {}
+  // Slow-consumer bound: a client that lets this much backlog pile up is
+  // disconnected (the NATS slow-consumer policy) instead of blocking the
+  // broker — routing/pump threads only ever touch the queue, never the
+  // socket, so one stuck reader can't stall other connections.
+  static constexpr size_t kMaxOutqBytes = 64u * 1024 * 1024;
 
-  bool send_all(const std::string& bytes) {
-    std::lock_guard<std::mutex> lk(write_mu);
-    size_t off = 0;
-    while (off < bytes.size()) {
-      ssize_t k = ::send(fd, bytes.data() + off, bytes.size() - off, MSG_NOSIGNAL);
-      if (k <= 0) return false;
-      off += (size_t)k;
+  explicit Conn(int fd_, Broker* b) : fd(fd_), broker(b) {
+    writer = std::thread([this] { writer_loop(); });
+  }
+
+  ~Conn() {
+    if (writer.joinable()) {
+      poison();
+      writer.join();
     }
-    return true;
+  }
+
+  // Enqueue a frame for the writer thread; never blocks on the socket.
+  bool send_all(const std::string& bytes) {
+    {
+      std::lock_guard<std::mutex> lk(write_mu);
+      if (!open) return false;
+      if (outq_bytes + bytes.size() > kMaxOutqBytes) {
+        // fallthrough to poison below, outside the lock
+      } else {
+        outq_bytes += bytes.size();
+        outq.push_back(bytes);
+        write_cv.notify_one();
+        return true;
+      }
+    }
+    poison();  // slow consumer: cut it loose rather than stall the broker
+    return false;
+  }
+
+  // Idempotent kill switch: wakes the writer, unblocks the reader and any
+  // in-flight send. close(fd) happens once, in serve_conn, after join.
+  void poison() {
+    open = false;
+    write_cv.notify_all();
+    ::shutdown(fd, SHUT_RDWR);
+  }
+
+  void writer_loop() {
+    for (;;) {
+      std::string frame;
+      {
+        std::unique_lock<std::mutex> lk(write_mu);
+        write_cv.wait(lk, [this] { return !outq.empty() || !open; });
+        if (!open) break;  // poisoned: pending frames are dropped
+        frame = std::move(outq.front());
+        outq.pop_front();
+        outq_bytes -= frame.size();
+      }
+      size_t off = 0;
+      while (off < frame.size()) {
+        ssize_t k = ::send(fd, frame.data() + off, frame.size() - off,
+                           MSG_NOSIGNAL);
+        if (k <= 0) {
+          poison();
+          return;
+        }
+        off += (size_t)k;
+      }
+    }
   }
 };
 
@@ -76,26 +141,26 @@ struct Broker {
   std::mutex stream_mu;
   StreamEngine streams;
 
-  void add_sub(Conn* c, uint32_t sid, const std::string& pattern,
-               const std::string& queue) {
+  void add_sub(std::shared_ptr<Conn> c, uint32_t sid,
+               const std::string& pattern, const std::string& queue) {
     std::lock_guard<std::mutex> lk(mu);
-    subs.push_back(Subscription{sid, pattern, queue, c});
+    subs.push_back(Subscription{sid, pattern, queue, std::move(c)});
   }
 
-  void remove_sub(Conn* c, uint32_t sid) {
+  void remove_sub(const Conn* c, uint32_t sid) {
     std::lock_guard<std::mutex> lk(mu);
     for (size_t i = 0; i < subs.size();) {
-      if (subs[i].conn == c && subs[i].sid == sid)
+      if (subs[i].conn.get() == c && subs[i].sid == sid)
         subs.erase(subs.begin() + (long)i);
       else
         ++i;
     }
   }
 
-  void drop_conn(Conn* c) {
+  void drop_conn(const Conn* c) {
     std::lock_guard<std::mutex> lk(mu);
     for (size_t i = 0; i < subs.size();) {
-      if (subs[i].conn == c)
+      if (subs[i].conn.get() == c)
         subs.erase(subs.begin() + (long)i);
       else
         ++i;
@@ -106,9 +171,10 @@ struct Broker {
             const std::vector<std::pair<std::string, std::string>>& headers,
             const std::string& data) {
     published++;
-    // snapshot matching subs under the lock; send outside it
+    // snapshot matching subs under the lock; send outside it (shared_ptr
+    // keeps each Conn alive until the enqueue returns)
     struct Target {
-      Conn* conn;
+      std::shared_ptr<Conn> conn;
       uint32_t sid;
     };
     std::vector<Target> targets;
@@ -217,7 +283,7 @@ static void serve_conn(std::shared_ptr<Conn> conn) {
           uint32_t sid = r.u32();
           std::string pattern = r.str();
           std::string queue = r.str();
-          broker->add_sub(conn.get(), sid, pattern, queue);
+          broker->add_sub(conn, sid, pattern, queue);
           break;
         }
         case OP_UNSUB: {
@@ -269,7 +335,8 @@ static void serve_conn(std::shared_ptr<Conn> conn) {
       break;
     }
   }
-  conn->open = false;
+  conn->poison();
+  conn->writer.join();
   broker->drop_conn(conn.get());
   ::close(conn->fd);
 }

@@ -171,6 +171,15 @@ class Client {
   // ---- durable streams (broker: streams.hpp; control rides reserved
   // request-reply subjects, so no extra opcodes) ----------------------------
 
+  // Control replies may be compact ({"ok":true}) or spaced ({"ok": true})
+  // depending on which broker path serialized them.
+  static bool reply_ok(const std::string& data) {
+    auto k = data.find("\"ok\"");
+    if (k == std::string::npos) return false;
+    auto p = data.find_first_not_of(": \t", k + 4);
+    return p != std::string::npos && data.compare(p, 4, "true") == 0;
+  }
+
   // Create/refresh a stream capturing `subjects`. Throws on broker error.
   void add_stream(const std::string& name,
                   const std::vector<std::string>& subjects,
@@ -184,7 +193,7 @@ class Client {
     req += "], \"ack_wait_ms\": " + std::to_string(ack_wait_ms) +
            ", \"max_deliver\": " + std::to_string(max_deliver) + "}";
     auto r = request("_SYMBUS.stream.create", req, timeout_ms);
-    if (!r || r->data.find("\"ok\": true") == std::string::npos)
+    if (!r || !reply_ok(r->data))
       throw std::runtime_error("stream create failed: " +
                                (r ? r->data : "timeout"));
   }
@@ -203,7 +212,7 @@ class Client {
              : ", \"filter_subject\": \"" + filter_subject + "\"") +
         "}";
     auto r = request("_SYMBUS.consumer.create", req, timeout_ms);
-    if (!r || r->data.find("\"ok\": true") == std::string::npos)
+    if (!r || !reply_ok(r->data))
       throw std::runtime_error("consumer create failed: " +
                                (r ? r->data : "timeout"));
     return sid;

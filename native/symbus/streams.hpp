@@ -25,7 +25,9 @@
 #include <dirent.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <cstdio>
+#include <cstring>
 #include <ctime>
 #include <functional>
 #include <map>
@@ -103,9 +105,12 @@ struct Stream {
 
 // log record types (length-prefixed frames, same framing as the wire)
 enum StreamRec : uint8_t {
-  REC_META = 0,  // json meta (subjects, ack_wait_ms, max_deliver)
-  REC_MSG = 1,   // u64 seq | str subject | u16 nh | (str,str)* | data
-  REC_ACK = 2,   // str group | u64 seq
+  REC_META = 0,   // json meta (subjects, ack_wait_ms, max_deliver)
+  REC_MSG = 1,    // u64 seq | str subject | u16 nh | (str,str)* | data
+  REC_ACK = 2,    // str group | u64 seq
+  REC_GROUP = 3,  // str group | u64 ack_floor | u32 n | u64*n acked>floor
+                  // (written only by compaction: snapshots group state so a
+                  // compacted log needs no per-ack history)
 };
 
 class StreamEngine {
@@ -136,7 +141,14 @@ class StreamEngine {
       s.subjects.push_back(v.as_string());
     if (j.has("ack_wait_ms")) s.ack_wait_ms = (int64_t)j.at("ack_wait_ms").as_number();
     if (j.has("max_deliver")) s.max_deliver = (uint32_t)j.at("max_deliver").as_number();
-    if (fresh && !data_dir_.empty()) open_log(s, /*truncate=*/false);
+    if (fresh && !data_dir_.empty()) {
+      open_log(s, /*truncate=*/false);
+      if (!s.log) {
+        // refuse to pretend durability we can't provide
+        streams_.erase(name);
+        return err_json("cannot persist stream " + name + " in " + data_dir_);
+      }
+    }
     if (s.log) append_meta(s);
     json::Value r = json::Value::object();
     r.set("ok", json::Value(true));
@@ -205,6 +217,10 @@ class StreamEngine {
           if (deliveries >= s.max_deliver) {
             g.dead_lettered++;
             g.ack(seq);  // drop: counted, no longer retried
+            // persist like a client ack, else the poison message comes back
+            // with a fresh delivery budget after every broker restart
+            if (s.log) append_ack(s, gname, seq);
+            maybe_gc(s);
             continue;
           }
           g.redeliveries[seq] = deliveries;
@@ -306,6 +322,9 @@ class StreamEngine {
 
   void open_log(Stream& s, bool truncate) {
     s.log = std::fopen(log_path(s.name).c_str(), truncate ? "wb" : "ab");
+    if (!s.log)
+      std::fprintf(stderr, "symbus: cannot open stream log %s: %s\n",
+                   log_path(s.name).c_str(), std::strerror(errno));
   }
 
   void write_frame(Stream& s, const Writer& w) {
@@ -321,6 +340,10 @@ class StreamEngine {
     m.set("subjects", std::move(subj));
     m.set("ack_wait_ms", json::Value((double)s.ack_wait_ms));
     m.set("max_deliver", json::Value((double)s.max_deliver));
+    // last_seq must survive a snapshot with zero live messages, else a
+    // fully-acked stream restarts numbering below the group floors and new
+    // publishes get swallowed as already-acked
+    m.set("last_seq", json::Value((double)s.last_seq));
     Writer w;
     w.u8(REC_META);
     w.data(m.dump());
@@ -347,6 +370,48 @@ class StreamEngine {
     w.str(group);
     w.u64(seq);
     write_frame(s, w);
+  }
+
+  void append_group(Stream& s, const ConsumerGroup& g) {
+    Writer w;
+    w.u8(REC_GROUP);
+    w.str(g.name);
+    w.u64(g.ack_floor);
+    w.u32((uint32_t)g.acked.size());
+    for (uint64_t seq : g.acked) w.u64(seq);
+    write_frame(s, w);
+  }
+
+  // Rewrite the log as a snapshot of live state (meta + group floors + the
+  // still-unacked messages), dropping the full append history. Called after
+  // replay, so each restart bounds the log to what is actually outstanding.
+  // Snapshot goes to a temp file first and renames over the old log, so a
+  // crash mid-compaction leaves the previous log intact (never truncate the
+  // only durable copy in place).
+  void compact(Stream& s) {
+    std::string tmp = log_path(s.name) + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) {
+      std::fprintf(stderr, "symbus: cannot write %s: %s\n", tmp.c_str(),
+                   std::strerror(errno));
+      open_log(s, /*truncate=*/false);  // keep appending to the old log
+      return;
+    }
+    FILE* prev = s.log;
+    s.log = f;
+    append_meta(s);
+    for (auto& [gname, g] : s.groups) append_group(s, g);
+    for (auto& [seq, m] : s.msgs) append_msg(s, m);
+    std::fclose(f);
+    s.log = prev;
+    if (std::rename(tmp.c_str(), log_path(s.name).c_str()) != 0) {
+      std::fprintf(stderr, "symbus: rename %s failed: %s\n", tmp.c_str(),
+                   std::strerror(errno));
+      std::remove(tmp.c_str());
+      open_log(s, /*truncate=*/false);
+      return;
+    }
+    open_log(s, /*truncate=*/false);  // append future records to the snapshot
   }
 
   void replay_all() {
@@ -392,6 +457,9 @@ class StreamEngine {
             s.subjects.push_back(v.as_string());
           s.ack_wait_ms = (int64_t)m.at("ack_wait_ms").as_number();
           s.max_deliver = (uint32_t)m.at("max_deliver").as_number();
+          if (m.has("last_seq"))
+            s.last_seq = std::max(s.last_seq,
+                                  (uint64_t)m.at("last_seq").as_number());
         } else if (rec == REC_MSG) {
           StreamMsg msg;
           msg.seq = r.u64();
@@ -410,6 +478,13 @@ class StreamEngine {
           ConsumerGroup& g = s.groups[group];
           if (g.name.empty()) g.name = group;
           g.ack(seq);
+        } else if (rec == REC_GROUP) {
+          std::string group = r.str();
+          ConsumerGroup& g = s.groups[group];
+          if (g.name.empty()) g.name = group;
+          g.ack_floor = r.u64();
+          uint32_t n = r.u32();
+          for (uint32_t i = 0; i < n; ++i) g.acked.insert(r.u64());
         }
       } catch (const std::exception&) {
         break;  // corrupt record: stop replay at last good frame
@@ -419,7 +494,7 @@ class StreamEngine {
     // consumers resume after the acked prefix
     for (auto& [gname, g] : s.groups) g.next_seq = g.ack_floor + 1;
     maybe_gc(s);
-    open_log(s, /*truncate=*/false);
+    compact(s);
   }
 
   std::string data_dir_;

@@ -27,7 +27,10 @@ from symbiont_tpu.schema import (
     to_json_bytes,
 )
 from symbiont_tpu.services.base import Service
-from symbiont_tpu.utils.ids import current_timestamp_ms, generate_uuid
+from symbiont_tpu.utils.ids import (
+    current_timestamp_ms,
+    deterministic_point_id,
+)
 from symbiont_tpu.utils.telemetry import child_headers, metrics, span
 
 log = logging.getLogger(__name__)
@@ -64,8 +67,11 @@ class VectorMemoryService(Service):
                 model_name=m.model_name,
                 processed_at_ms=now,
             )
-            points.append((generate_uuid(), se.embedding,
-                           dataclasses.asdict(payload)))
+            # content-derived id: durable redelivery overwrites the same
+            # point instead of duplicating it (reference mints random uuids,
+            # main.rs:142-177 — safe only at-most-once)
+            points.append((deterministic_point_id(m.original_id, order),
+                           se.embedding, dataclasses.asdict(payload)))
         with span("vector_memory.upsert", msg.headers, points=len(points)):
             n = self.store.upsert(points)
         metrics.inc("vector_memory.points_upserted", n)

@@ -94,7 +94,7 @@ def test_capture_deliver_ack_and_redelivery():
 
             # ack only the first; the second must redeliver after ack_wait=1s
             await bus.ack(m1)
-            first_unacked = m1 if False else m2  # m2 stays unacked
+            first_unacked = m2  # stays unacked
             r = await sub.next(5.0)
             assert r is not None, "no redelivery of unacked message"
             assert int(r.headers["X-Symbus-Seq"]) == int(
@@ -225,5 +225,90 @@ def test_persistence_across_broker_restart(tmp_path):
             await bus.close()
 
         asyncio.run(phase2())
+    finally:
+        _stop(proc)
+
+
+def test_dead_letter_persisted_and_log_compacted(tmp_path):
+    """A poison message that exhausted max_deliver must stay dead after a
+    broker restart (its auto-ack is persisted), and restart must compact the
+    log to live state instead of replaying the full append history."""
+    port = _free_port()
+    data_dir = tmp_path / "streams"
+    data_dir.mkdir()
+    proc = _start_broker(port, data_dir)
+    try:
+        async def phase1():
+            bus = await _bus(port)
+            await bus.add_stream("dlp", ["dlp.docs"], ack_wait_s=0.3,
+                                 max_deliver=2)
+            await bus.publish("dlp.docs", b"poison")
+            # bulk of acked traffic: should vanish from the log at restart
+            for i in range(50):
+                await bus.publish("dlp.docs", json.dumps({"i": i}).encode())
+            sub = await bus.durable_subscribe("dlp", "g")
+            poisoned = 0
+            for _ in range(60):
+                m = await sub.next(2.0)
+                if m is None:
+                    break
+                if m.data == b"poison":
+                    poisoned += 1  # never ack the poison
+                else:
+                    await bus.ack(m)
+            assert poisoned == 2  # delivered max_deliver times, then dropped
+            stats = await bus.stream_stats()
+            assert stats["dlp"]["groups"]["g"]["dead_lettered"] == 1
+            await asyncio.sleep(0.5)  # let the dead-letter ack hit the log
+            await bus.close()
+
+        asyncio.run(phase1())
+    finally:
+        _stop(proc)
+
+    size_before = (data_dir / "dlp.symlog").stat().st_size
+    proc = _start_broker(port, data_dir)
+    try:
+        async def phase2():
+            bus = await _bus(port)
+            sub = await bus.durable_subscribe("dlp", "g")
+            # nothing comes back: not the poison (dead-letter ack persisted),
+            # not the acked bulk
+            assert await sub.next(1.5) is None
+            # last_seq survived the all-acked snapshot: a fresh publish must
+            # number ABOVE the group floor and be delivered, not swallowed
+            await bus.publish("dlp.docs", b"fresh")
+            m = await sub.next(5.0)
+            assert m is not None and m.data == b"fresh"
+            assert int(m.headers["X-Symbus-Seq"]) > 50
+            await bus.ack(m)
+            await asyncio.sleep(0.3)  # let the ack land in the log
+            await bus.close()
+
+        asyncio.run(phase2())
+    finally:
+        _stop(proc)
+    # replay rewrote the log as a snapshot: 51 msgs + ~52 acks of history
+    # collapse to meta + group floor records
+    size_after = (data_dir / "dlp.symlog").stat().st_size
+    assert size_after < size_before / 4, (size_before, size_after)
+
+    # second restart: now the snapshot itself is the replay source. With every
+    # message acked it holds no REC_MSG, so last_seq can only come from the
+    # meta record — if it replayed as 0, this publish would be numbered below
+    # the group floor and silently swallowed.
+    proc = _start_broker(port, data_dir)
+    try:
+        async def phase3():
+            bus = await _bus(port)
+            sub = await bus.durable_subscribe("dlp", "g")
+            await bus.publish("dlp.docs", b"after-second-restart")
+            m = await sub.next(5.0)
+            assert m is not None and m.data == b"after-second-restart"
+            assert int(m.headers["X-Symbus-Seq"]) > 51
+            await bus.ack(m)
+            await bus.close()
+
+        asyncio.run(phase3())
     finally:
         _stop(proc)

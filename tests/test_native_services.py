@@ -218,6 +218,19 @@ def test_native_pipeline_preprocessing_vector_memory(broker):
                         break
                     await asyncio.sleep(0.1)
                 assert store.count() == 3
+                # C++ minted the same deterministic point ids as Python would
+                # (idempotent-redelivery contract, utils.ids parity)
+                from symbiont_tpu.utils.ids import deterministic_point_id
+                expected_ids = {deterministic_point_id(raw.id, i)
+                                for i in range(3)}
+                assert set(store._id_to_row) == expected_ids
+
+                # redelivery idempotence: same doc again → same ids, no dupes
+                await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                  to_json_bytes(raw))
+                assert await sub_emb.next(60.0) is not None
+                await asyncio.sleep(1.0)  # let the second upsert land
+                assert store.count() == 3
 
                 # query-embedding request-reply through the C++ shell
                 qtask = QueryForEmbeddingTask(request_id=generate_uuid(),
@@ -485,6 +498,120 @@ def test_native_api_gateway_full_stack(broker):
                     stop_worker(w)
                 await svc.stop()
                 await engine_bus.close()
+
+    asyncio.run(scenario())
+
+
+def test_native_knowledge_graph(broker):
+    """C++ knowledge_graph shell: tokenized stream → engine.graph.save →
+    sqlite MERGE-parity store (the un-orphaned path, SURVEY.md fact #3),
+    including idempotent re-save (MERGE, not duplicate) and log-and-continue
+    on a bad payload."""
+    import tempfile
+
+    async def scenario():
+        from symbiont_tpu.config import GraphStoreConfig
+        from symbiont_tpu.graph.store import GraphStore
+        from symbiont_tpu.schema import TokenizedTextMessage
+        from symbiont_tpu.services.engine_service import EngineService
+
+        with tempfile.TemporaryDirectory() as td:
+            store = GraphStore(GraphStoreConfig(data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, graph_store=store)
+            await svc.start()
+            proc = spawn_worker("knowledge_graph", broker)
+            try:
+                await _wait_ready(proc)
+                bus = await _tcp_bus(broker)
+                msg = TokenizedTextMessage(
+                    original_id="doc-1", source_url="http://kg",
+                    tokens=["The", "MXU", "the", "", "ICI"],
+                    sentences=["The MXU.", "  ", "The ICI."],
+                    timestamp_ms=current_timestamp_ms())
+                await bus.publish(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                  to_json_bytes(msg))
+                for _ in range(100):
+                    if store.counts()["Document"] >= 1:
+                        break
+                    await asyncio.sleep(0.1)
+                # tokens dedupe case-insensitively; empties skipped
+                # (reference: main.rs:71-77,103-109)
+                assert store.counts() == {"Document": 1, "Sentence": 2,
+                                          "Token": 3, "edges": 5}
+                assert store.document_sentences("doc-1") == [
+                    "The MXU.", "The ICI."]
+                assert store.documents_containing_token("mxu") == ["doc-1"]
+
+                # MERGE: same doc again does not duplicate
+                await bus.publish(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                  to_json_bytes(msg))
+                # bad payload: logged, loop survives
+                await bus.publish(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                  b'{"nope": 1}')
+                await asyncio.sleep(0.5)
+                assert store.counts() == {"Document": 1, "Sentence": 2,
+                                          "Token": 3, "edges": 5}
+                await bus.close()
+            finally:
+                err = stop_worker(proc)
+                await svc.stop()
+                await engine_bus.close()
+            assert "saved doc doc-1" in err, err
+            assert "bad tokenized message" in err, err
+
+    asyncio.run(scenario())
+
+
+def test_native_knowledge_graph_durable_ack(broker):
+    """Durable mode: the KG worker filter-subscribes to only its subject and
+    acks after commit — a successful save must NOT redeliver, and foreign
+    pipeline subjects must never reach its parse loop."""
+    import tempfile
+
+    async def scenario():
+        from symbiont_tpu.config import GraphStoreConfig
+        from symbiont_tpu.graph.store import GraphStore
+        from symbiont_tpu.schema import TokenizedTextMessage
+        from symbiont_tpu.services.engine_service import EngineService
+
+        with tempfile.TemporaryDirectory() as td:
+            store = GraphStore(GraphStoreConfig(data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, graph_store=store)
+            await svc.start()
+            proc = spawn_worker(
+                "knowledge_graph", broker,
+                {"SYMBIONT_BUS_DURABLE": "1",
+                 "SYMBIONT_BUS_DURABLE_ACK_WAIT_MS": "600"})
+            try:
+                await _wait_ready(proc, b"ready (durable)")
+                bus = await _tcp_bus(broker)
+                # a foreign pipeline subject must be filtered out by the broker
+                await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                  b'{"id": "x", "source_url": "u", '
+                                  b'"raw_text": "t", "timestamp_ms": 1}')
+                msg = TokenizedTextMessage(
+                    original_id="dur-1", source_url="http://kg",
+                    tokens=["ack"], sentences=["Ack after commit."],
+                    timestamp_ms=current_timestamp_ms())
+                await bus.publish(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
+                                  to_json_bytes(msg))
+                for _ in range(100):
+                    if store.counts()["Document"] >= 1:
+                        break
+                    await asyncio.sleep(0.1)
+                assert store.counts()["Document"] == 1
+                # wait past several ack_wait windows: an un-acked save would
+                # redeliver and log "saved doc" again
+                await asyncio.sleep(2.0)
+                await bus.close()
+            finally:
+                err = stop_worker(proc)
+                await svc.stop()
+                await engine_bus.close()
+            assert err.count("saved doc dur-1") == 1, err
+            assert "bad tokenized message" not in err, err
 
     asyncio.run(scenario())
 

@@ -123,3 +123,23 @@ def test_unicode_round_trip():
 def test_missing_optional_field_defaults_none():
     got = from_json(GenerateTextTask, '{"task_id": "t", "max_length": 3}')
     assert got.prompt is None
+
+
+def test_deterministic_point_id():
+    """Content-derived point ids: stable, uuid-shaped, distinct per
+    (doc, order) — the idempotent-redelivery contract (C++ parity is asserted
+    in test_native_services.py over the real pipeline)."""
+    import re
+
+    from symbiont_tpu.utils.ids import deterministic_point_id
+
+    a = deterministic_point_id("doc-1", 0)
+    assert a == deterministic_point_id("doc-1", 0)
+    assert re.fullmatch(
+        r"[0-9a-f]{8}-[0-9a-f]{4}-5[0-9a-f]{3}-[89ab][0-9a-f]{3}-[0-9a-f]{12}",
+        a)
+    others = {deterministic_point_id("doc-1", 1),
+              deterministic_point_id("doc-2", 0),
+              deterministic_point_id("doc", 10),
+              deterministic_point_id("doc1", 0)}
+    assert a not in others and len(others) == 4
