@@ -13,6 +13,9 @@ sharding   : NamedSharding rules — DP batch sharding for embedding, TP rules
              for decoder LM params (heads / MLP hidden on 'tensor')
 ring_attention : sequence-parallel blockwise attention via shard_map+ppermute
              for long-context (a first-class capability the reference lacks)
+ulysses    : the all-to-all sequence-parallel scheme — trade sequence shards
+             for head shards, run dense attention, trade back (same exactness
+             contract as ring; pick per workload)
 
 XLA inserts the collectives (psum/all-gather/ppermute ride ICI); this package
 only defines meshes and shardings — no hand-written NCCL analog (SURVEY.md §2
@@ -26,6 +29,14 @@ from symbiont_tpu.parallel.sharding import (
     replicate,
     shard_params,
 )
+from symbiont_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+)
+from symbiont_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "build_mesh",
@@ -34,4 +45,8 @@ __all__ = [
     "replicate",
     "gpt_param_sharding",
     "shard_params",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def ring_attention(
@@ -73,12 +73,15 @@ def ring_attention(
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, new_m, l, acc
 
-    # pvary: mark the fresh accumulators as device-varying over the ring axis
-    # so the fori_loop carry type is stable under shard_map's varying-axis
-    # tracking.
-    m0 = jax.lax.pvary(jnp.full((B, NH, S), -jnp.inf, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, NH, S), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, NH, S, D), jnp.float32), axis_name)
+    # pcast to 'varying': mark the fresh accumulators as device-varying over
+    # the ring axis so the fori_loop carry type is stable under shard_map's
+    # varying-axis tracking.
+    def vary(x):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    m0 = vary(jnp.full((B, NH, S), -jnp.inf, jnp.float32))
+    l0 = vary(jnp.zeros((B, NH, S), jnp.float32))
+    acc0 = vary(jnp.zeros((B, NH, S, D), jnp.float32))
     *_, m, l, acc = jax.lax.fori_loop(0, n_dev, step, (k, v, m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, NH, S, D]

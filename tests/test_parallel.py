@@ -111,6 +111,39 @@ def test_ring_attention_matches_full(causal):
 
 
 @requires_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    from symbiont_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    rng = np.random.default_rng(4)
+    B, S, NH, D = 2, 64, 8, 16  # NH = 8 devices × 1 head each
+    q = jnp.asarray(rng.normal(size=(B, S, NH, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, NH, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, NH, D)), jnp.float32)
+    ref = _full_attention(q, k, v, causal=causal)
+    mesh = build_mesh([8, 1])
+    out = ulysses_attention_sharded(q, k, v, mesh, axis_name="data",
+                                    causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5,
+                               rtol=1e-4)
+    # and it agrees with the ring scheme on the same shards
+    ring = ring_attention_sharded(q, k, v, mesh, axis_name="data",
+                                  causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ring), atol=1e-5,
+                               rtol=1e-4)
+
+
+@requires_8
+def test_ulysses_rejects_indivisible_heads():
+    from symbiont_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 16, 6, 8)), jnp.float32)  # 6 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention_sharded(q, q, q, build_mesh([8, 1]))
+
+
+@requires_8
 def test_ring_attention_long_sequence_memory_shape():
     """Sequence 8× a device's local block works (the long-context claim)."""
     rng = np.random.default_rng(3)
