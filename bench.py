@@ -60,24 +60,32 @@ def main() -> None:
             batch_buckets=batch_buckets, max_batch=max_batch,
             dtype="bfloat16", data_parallel=False))
 
-    # --- our policy: buckets {64,128}, batches up to 256 ------------------
-    ours = mk_engine([64, 128], [32, 128, 256], 256)
+    # --- our policy: buckets {64,128}, batches up to 512 ------------------
+    ours = mk_engine([64, 128], [32, 256, 512], 512)
     ours.embed_texts(sentences)  # warmup: compiles every (bucket, batch) the
     #                              real run will hit (same plan, same shapes)
-    t0 = time.time()
-    ours.embed_texts(sentences)
-    dt_ours = time.time() - t0
+    dt_ours = float("inf")  # best-of-3: the tunnel to the chip adds jitter
+    for _ in range(3):
+        t0 = time.time()
+        ours.embed_texts(sentences)
+        dt_ours = min(dt_ours, time.time() - t0)
     eps_ours = len(sentences) / dt_ours
     log(f"bucketed policy: {len(sentences)} sentences in {dt_ours:.2f}s "
         f"→ {eps_ours:.0f} emb/s (compiles={ours.stats['compiles']})")
 
     # --- reference policy: pad-to-512, serial batch 8 ---------------------
+    # The reference materializes every batch before starting the next
+    # (to_vec2 inside the batch loop, embedding_generator.rs:146-216), so
+    # emulate it with one blocking embed_texts call per 8-sentence batch.
     ref = mk_engine([512], [8], 8)
     n_ref = 256  # subset; serial 512-padded batches are slow by design
     ref.embed_texts(sentences[:n_ref])  # warmup, same shapes as timed run
-    t0 = time.time()
-    ref.embed_texts(sentences[:n_ref])
-    dt_ref = time.time() - t0
+    dt_ref = float("inf")  # best-of-3, same treatment as "ours"
+    for _ in range(3):
+        t0 = time.time()
+        for i in range(0, n_ref, 8):
+            ref.embed_texts(sentences[i:i + 8])
+        dt_ref = min(dt_ref, time.time() - t0)
     eps_ref = n_ref / dt_ref
     log(f"reference policy (pad-512, batch 8): {n_ref} sentences in "
         f"{dt_ref:.2f}s → {eps_ref:.0f} emb/s")
