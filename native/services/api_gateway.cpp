@@ -29,6 +29,7 @@
 #include <signal.h>
 #include <sys/socket.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <deque>
@@ -276,6 +277,7 @@ struct Config {
   size_t sse_capacity;
   int embed_timeout_ms;
   int search_timeout_ms;
+  int rerank_timeout_ms;
 };
 
 Config g_cfg;
@@ -434,6 +436,47 @@ std::pair<int, std::string> route_semantic_search(const std::string& body) {
     return {500, resp.to_json_string()};
   }
   resp.results = std::move(search_result.results);
+
+  if (req.rerank && *req.rerank && !resp.results.empty()) {
+    // third hop (our addition, BASELINE.md #4): cross-encoder rerank of the
+    // top-k hits through the engine plane; hit scores become CE logits
+    json::Value rr_req = json::Value::object();
+    rr_req.set("query", json::Value(req.query_text));
+    json::Value passages = json::Value::array();
+    for (const auto& r : resp.results)
+      passages.push_back(json::Value(r.payload.sentence_text));
+    rr_req.set("passages", std::move(passages));
+    reply = bus.request(symbiont::subjects::ENGINE_RERANK, rr_req.dump(),
+                        g_cfg.rerank_timeout_ms, trace);
+    if (!reply) {
+      resp.results.clear();
+      resp.error_message =
+          "Failed to get rerank scores from engine service: timeout";
+      return {503, resp.to_json_string()};
+    }
+    try {
+      json::Value rr = json::parse(reply->data);
+      if (rr.has("error_message") && !rr.at("error_message").is_null()) {
+        resp.results.clear();
+        resp.error_message = rr.at("error_message").as_string();
+        return {500, resp.to_json_string()};
+      }
+      const auto& scores = rr.at("scores").as_array();
+      if (scores.size() != resp.results.size())
+        throw std::runtime_error("score count mismatch");
+      for (size_t i = 0; i < scores.size(); ++i)
+        resp.results[i].score = (float)scores[i].as_number();
+      std::stable_sort(resp.results.begin(), resp.results.end(),
+                       [](const symbiont::SemanticSearchResultItem& a,
+                          const symbiont::SemanticSearchResultItem& b) {
+                         return a.score > b.score;
+                       });
+    } catch (const std::exception& e) {
+      resp.results.clear();
+      resp.error_message = std::string("bad rerank reply: ") + e.what();
+      return {500, resp.to_json_string()};
+    }
+  }
   return {200, resp.to_json_string()};
 }
 
@@ -577,6 +620,8 @@ int main() {
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_EMBED_S", "15").c_str()));
   g_cfg.search_timeout_ms = (int)(1000 * std::atof(
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_SEARCH_S", "20").c_str()));
+  g_cfg.rerank_timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_RERANK_S", "10").c_str()));
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) return 1;

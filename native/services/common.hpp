@@ -33,6 +33,7 @@ inline const char* TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query";
 inline const char* TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request";
 inline const char* ENGINE_EMBED_BATCH = "engine.embed.batch";
 inline const char* ENGINE_EMBED_QUERY = "engine.embed.query";
+inline const char* ENGINE_RERANK = "engine.rerank";
 inline const char* ENGINE_GENERATE = "engine.generate";
 inline const char* ENGINE_VECTOR_UPSERT = "engine.vector.upsert";
 inline const char* ENGINE_VECTOR_SEARCH = "engine.vector.search";

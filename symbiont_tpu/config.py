@@ -32,6 +32,8 @@ class BusConfig:
     url: str = "inproc://"
     request_timeout_embed_s: float = 15.0  # reference: api_service/src/main.rs:310
     request_timeout_search_s: float = 20.0  # reference: api_service/src/main.rs:430
+    # rerank hop (our addition — the reference has no rerank stage)
+    request_timeout_rerank_s: float = 10.0
     # at-least-once pipeline: durable streams on the native broker (SURVEY.md
     # §5.3 — the reference's core NATS silently loses in-flight work). Only
     # effective on symbus:// transports; the in-proc bus stays at-most-once.
@@ -62,6 +64,12 @@ class EngineConfig:
     flush_deadline_ms: float = 5.0
     data_parallel: bool = True  # shard batches across the mesh 'data' axis
     executable_cache_size: int = 64
+    # Cross-encoder rerank (BASELINE.md config #4: ms-marco-MiniLM-L-6 on
+    # top-k hits). cross_model_dir points at a converted checkpoint;
+    # rerank_enabled without a dir runs a synthetic cross-encoder (random
+    # weights, embedder geometry) so the full rerank path works asset-free.
+    cross_model_dir: Optional[str] = None
+    rerank_enabled: bool = False
 
 
 @dataclass

@@ -87,6 +87,24 @@ class TpuEngine:
                     dtype=self.config.dtype)
                 params = bert_mod.init_params(jax.random.key(0), model_cfg)
                 log.warning("engine running with RANDOM weights (no model_dir)")
+        if cross_params is None and (self.config.cross_model_dir
+                                     or self.config.rerank_enabled):
+            if self.config.cross_model_dir:
+                from symbiont_tpu.models.convert import load_bert_model
+
+                cross_params, cross_cfg = load_bert_model(
+                    self.config.cross_model_dir, with_pooler=True)
+                log.info("loaded cross-encoder from %s",
+                         self.config.cross_model_dir)
+            else:
+                # synthetic cross-encoder: embedder geometry + pooler head —
+                # the rerank path runs end-to-end with zero model assets
+                cross_cfg = model_cfg
+                cross_params = bert_mod.init_params(
+                    jax.random.key(1), cross_cfg, with_pooler=True)
+                log.warning(
+                    "cross-encoder running with RANDOM weights (rerank_enabled "
+                    "without cross_model_dir)")
         import dataclasses
 
         if model_cfg.dtype != self.config.dtype:
@@ -99,6 +117,8 @@ class TpuEngine:
             attn_impl = "flash" if jax.default_backend() == "tpu" else "xla"
         if model_cfg.attn_impl != attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
+        if cross_cfg is not None and cross_cfg.dtype != self.config.dtype:
+            cross_cfg = dataclasses.replace(cross_cfg, dtype=self.config.dtype)
         if cross_cfg is not None and cross_cfg.attn_impl != attn_impl:
             cross_cfg = dataclasses.replace(cross_cfg, attn_impl=attn_impl)
         self.model_cfg = model_cfg
@@ -265,7 +285,12 @@ class TpuEngine:
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                batches: Optional[Sequence[int]] = None) -> None:
         """Pre-compile the hot (bucket, batch) executables so first queries
-        don't pay the 20-40s TPU compile."""
+        don't pay the 20-40s TPU compile. Covers the rerank executables too
+        when a cross-encoder is loaded — the rerank hop has the tightest
+        caller timeout (request_timeout_rerank_s), so it can least afford a
+        first-request compile."""
+        import jax.numpy as jnp
+
         for L in buckets or self.config.length_buckets[:2]:
             for B in batches or self.config.batch_buckets[:2]:
                 bb = self._batch_bucket(B)
@@ -274,3 +299,8 @@ class TpuEngine:
                 fn = self._get_executable("embed", L, bb)
                 ids_d, mask_d = self._device_batch(ids, mask)
                 np.asarray(fn(self.params, ids_d, mask_d))
+                if self.cross_params is not None:
+                    fn = self._get_executable("rerank", L, bb)
+                    ids_d, mask_d = self._device_batch(ids, mask)
+                    types = jnp.zeros((bb, L), jnp.int32)
+                    np.asarray(fn(self.cross_params, ids_d, mask_d, types))

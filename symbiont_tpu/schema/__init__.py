@@ -77,6 +77,10 @@ def _decode(tp: Any, value: Any) -> Any:
         if not 0 <= value < _U64_SAFE_MAX:
             raise ValueError(f"integer {value} outside u64-safe range [0, 2^53)")
         return value
+    if tp is bool:
+        if not isinstance(value, bool):
+            raise ValueError(f"expected boolean, got {type(value).__name__}")
+        return value
     if tp is str and not isinstance(value, str):
         raise ValueError(f"expected string, got {type(value).__name__}")
     return value
@@ -192,10 +196,17 @@ class TextWithEmbeddingsMessage:
 
 @wire
 class SemanticSearchApiRequest:
-    """reference: libs/shared_models/src/lib.rs:55-58"""
+    """reference: libs/shared_models/src/lib.rs:55-58
+
+    `rerank` is this framework's addition (BASELINE.md config #4): when true,
+    the gateway reranks the top-k hits with the cross-encoder and replaces
+    each hit's score with the cross-encoder relevance score. Optional, so
+    reference-era clients (which omit it) remain wire-compatible.
+    """
 
     query_text: str
     top_k: int
+    rerank: Optional[bool] = None
 
 
 @wire

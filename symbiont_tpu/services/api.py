@@ -333,7 +333,32 @@ class ApiService:
             search_result = from_json(SemanticSearchNatsResult, reply.data)
             if search_result.error_message:
                 return 500, resp([], search_result.error_message)
-            return 200, resp(search_result.results)
+            results = search_result.results
+            if req.rerank and results:
+                # third hop (our addition, BASELINE.md #4): cross-encoder
+                # rerank of the top-k hits; scores become CE relevance logits
+                rerank_req = {"query": req.query_text,
+                              "passages": [r.payload.sentence_text for r in results]}
+                try:
+                    reply = await self.bus.request(
+                        subjects.ENGINE_RERANK,
+                        json.dumps(rerank_req).encode(),
+                        timeout=self.bus_config.request_timeout_rerank_s,
+                        headers=trace)
+                except TimeoutError as e:
+                    return 503, resp([], f"Failed to get rerank scores from engine service: {e}")
+                rr = json.loads(reply.data)
+                if rr.get("error_message"):
+                    return 500, resp([], rr["error_message"])
+                scores = rr.get("scores")
+                if not isinstance(scores, list) or len(scores) != len(results):
+                    # C++ twin parity (api_gateway.cpp): a short score list
+                    # must not silently mix cosine and CE scales
+                    return 500, resp([], "bad rerank reply: score count mismatch")
+                for r, s in zip(results, scores):
+                    r.score = float(s)
+                results = sorted(results, key=lambda r: r.score, reverse=True)
+            return 200, resp(results)
 
     # ------------------------------------------------------------------ SSE
 

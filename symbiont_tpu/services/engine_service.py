@@ -76,8 +76,10 @@ class EngineService(Service):
         if self.engine is not None:
             await sub(subjects.ENGINE_EMBED_BATCH, self._embed_batch, queue=q)
             await sub(subjects.ENGINE_EMBED_QUERY, self._embed_query, queue=q)
-            if self.engine.cross_params is not None:
-                await sub(subjects.ENGINE_RERANK, self._rerank, queue=q)
+            # subscribed even without a cross-encoder: a rerank request against
+            # a rerank-disabled stack must get a fast typed error reply
+            # ("no cross-encoder model loaded"), not a 10s caller timeout
+            await sub(subjects.ENGINE_RERANK, self._rerank, queue=q)
         if self.lm is not None:
             await sub(subjects.ENGINE_GENERATE, self._generate, queue=q)
         if self.vector_store is not None:

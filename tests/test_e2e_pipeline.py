@@ -49,7 +49,8 @@ def stack_config(tmp_path):
     return SymbiontConfig(
         engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
                             batch_buckets=[2, 8], max_batch=8, dtype="float32",
-                            data_parallel=False, flush_deadline_ms=2.0),
+                            data_parallel=False, flush_deadline_ms=2.0,
+                            rerank_enabled=True),
         vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
                                        shard_capacity=64),
         graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
@@ -117,6 +118,16 @@ def test_ingest_search_generate_roundtrip(stack_config):
             assert set(hit["payload"]) == {
                 "original_document_id", "source_url", "sentence_text",
                 "sentence_order", "model_name", "processed_at_ms"}
+
+            # --- 3.2b search + cross-encoder rerank (BASELINE #4) --------
+            status, body = await http("POST", port, "/api/search/semantic",
+                                      {"query_text": "matrix multiplication",
+                                       "top_k": 3, "rerank": True})
+            assert status == 200, body
+            assert body["error_message"] is None
+            scores = [r["score"] for r in body["results"]]
+            assert len(scores) == 3
+            assert scores == sorted(scores, reverse=True)
 
             # --- 3.5 knowledge graph (un-orphaned) -----------------------
             ok = await _wait_until(
@@ -231,6 +242,67 @@ def test_search_timeout_maps_to_503(stack_config):
             assert "Failed to get embedding" in body["error_message"]
         finally:
             await api.stop()
+
+    asyncio.run(scenario())
+
+
+def test_rerank_timeout_maps_to_503(stack_config):
+    """Embed + search hops answered, rerank hop unanswered → 503 (same status
+    scheme as the reference's hop timeouts, main.rs:317-349)."""
+
+    async def scenario():
+        from symbiont_tpu import subjects
+        from symbiont_tpu.config import BusConfig
+        from symbiont_tpu.schema import (
+            QueryEmbeddingResult,
+            QueryForEmbeddingTask,
+            SemanticSearchNatsResult,
+            SemanticSearchResultItem,
+            QdrantPointPayload,
+            from_json,
+            to_json_bytes,
+        )
+        from symbiont_tpu.services.api import ApiService
+
+        bus = InprocBus()
+
+        async def embed_responder():
+            sub = await bus.subscribe(subjects.TASKS_EMBEDDING_FOR_QUERY)
+            async for msg in sub:
+                task = from_json(QueryForEmbeddingTask, msg.data)
+                await bus.publish(msg.reply, to_json_bytes(QueryEmbeddingResult(
+                    request_id=task.request_id, embedding=[0.1, 0.2],
+                    model_name="m", error_message=None)))
+
+        async def search_responder():
+            sub = await bus.subscribe(subjects.TASKS_SEARCH_SEMANTIC_REQUEST)
+            payload = QdrantPointPayload(
+                original_document_id="d", source_url="u", sentence_text="s",
+                sentence_order=0, model_name="m", processed_at_ms=1)
+            async for msg in sub:
+                await bus.publish(msg.reply, to_json_bytes(SemanticSearchNatsResult(
+                    request_id="r", results=[SemanticSearchResultItem(
+                        qdrant_point_id="p", score=0.5, payload=payload)],
+                    error_message=None)))
+
+        tasks = [asyncio.create_task(embed_responder()),
+                 asyncio.create_task(search_responder())]
+        await asyncio.sleep(0)  # let responders subscribe
+        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0),
+                         BusConfig(request_timeout_rerank_s=0.2))
+        await api.start()
+        loop = asyncio.get_running_loop()
+        try:
+            status, body = await loop.run_in_executor(
+                None, lambda: _http("POST", api.port, "/api/search/semantic",
+                                    {"query_text": "q", "top_k": 1,
+                                     "rerank": True}))
+            assert status == 503
+            assert "Failed to get rerank scores" in body["error_message"]
+        finally:
+            await api.stop()
+            for t in tasks:
+                t.cancel()
 
     asyncio.run(scenario())
 

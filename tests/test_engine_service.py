@@ -119,6 +119,11 @@ def test_engine_service_error_replies(tmp_path):
             # times out rather than half-answering
             with pytest.raises(TimeoutError):
                 await bus.request(subjects.ENGINE_GENERATE, b"{}", 0.2)
+            # EXCEPT rerank: always subscribed so a rerank-disabled stack
+            # fails fast with a typed error, not a caller timeout
+            r = await _req(bus, subjects.ENGINE_RERANK,
+                           {"query": "q", "passages": ["p"]}, timeout=5.0)
+            assert "no cross-encoder" in r["error_message"]
         finally:
             await svc.stop()
 

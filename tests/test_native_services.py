@@ -370,7 +370,8 @@ def test_native_api_gateway_full_stack(broker):
         from symbiont_tpu.services.engine_service import EngineService
 
         eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
-                                     batch_buckets=[2, 4], dtype="float32"))
+                                     batch_buckets=[2, 4], dtype="float32",
+                                     rerank_enabled=True))
         api_port = _free_port()
         with tempfile.TemporaryDirectory() as td:
             store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
@@ -474,6 +475,16 @@ def test_native_api_gateway_full_stack(broker):
                     "original_document_id", "source_url", "sentence_text",
                     "sentence_order", "model_name", "processed_at_ms"}
 
+                # 3-hop search + cross-encoder rerank through the C++ gateway
+                status, body, _ = await hx("POST", "/api/search/semantic",
+                                           {"query_text": "cosine topk",
+                                            "top_k": 2, "rerank": True})
+                assert status == 200, body
+                assert body["error_message"] is None
+                rr_scores = [r["score"] for r in body["results"]]
+                assert len(rr_scores) == 2
+                assert rr_scores == sorted(rr_scores, reverse=True)
+
                 # generation → SSE push
                 status, body, _ = await hx("POST", "/api/generate-text",
                                            {"task_id": "sse-1", "prompt": None,
@@ -490,7 +501,7 @@ def test_native_api_gateway_full_stack(broker):
                 # metrics counted the calls
                 status, body, _ = await hx("GET", "/api/metrics")
                 assert status == 200
-                assert body["counters"]["api.POST./api/search/semantic"] == 1
+                assert body["counters"]["api.POST./api/search/semantic"] == 2
                 assert body["counters"]["api.sse_broadcast"] >= 1
                 await bus.close()
             finally:

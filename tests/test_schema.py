@@ -55,6 +55,9 @@ CASES = [
         original_id="doc-1", source_url="http://example.com",
         embeddings_data=[SentenceEmbedding(sentence_text="a", embedding=[1.0, 2.0])],
         model_name="mpnet", timestamp_ms=1718000000000),
+    # rerank=True first: the C++ parity harness samples the first case per
+    # type, so this exercises the generated bool codec end-to-end
+    SemanticSearchApiRequest(query_text="with rerank", top_k=5, rerank=True),
     SemanticSearchApiRequest(query_text="what is symbiont", top_k=5),
     QueryForEmbeddingTask(request_id="r-1", text_to_embed="query text"),
     QueryEmbeddingResult(request_id="r-1", embedding=[0.5, 0.5],
@@ -123,6 +126,17 @@ def test_unicode_round_trip():
 def test_missing_optional_field_defaults_none():
     got = from_json(GenerateTextTask, '{"task_id": "t", "max_length": 3}')
     assert got.prompt is None
+
+
+def test_reference_search_request_still_decodes():
+    """Reference-era clients send only query_text/top_k (reference:
+    libs/shared_models/src/lib.rs:55-58); the added rerank flag must stay
+    optional and strictly boolean when present."""
+    got = from_json(SemanticSearchApiRequest, '{"query_text": "q", "top_k": 2}')
+    assert got.rerank is None
+    with pytest.raises(ValueError, match="expected boolean"):
+        from_json(SemanticSearchApiRequest,
+                  '{"query_text": "q", "top_k": 2, "rerank": 1}')
 
 
 def test_deterministic_point_id():
