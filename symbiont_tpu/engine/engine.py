@@ -301,6 +301,5 @@ class TpuEngine:
                 np.asarray(fn(self.params, ids_d, mask_d))
                 if self.cross_params is not None:
                     fn = self._get_executable("rerank", L, bb)
-                    ids_d, mask_d = self._device_batch(ids, mask)
                     types = jnp.zeros((bb, L), jnp.int32)
                     np.asarray(fn(self.cross_params, ids_d, mask_d, types))

@@ -13,6 +13,10 @@ sharding   : NamedSharding rules — DP batch sharding for embedding, TP rules
              for decoder LM params (heads / MLP hidden on 'tensor')
 ring_attention : sequence-parallel blockwise attention via shard_map+ppermute
              for long-context (a first-class capability the reference lacks)
+context    : sequence-parallel decoder LM *training* — the full train-time
+             forward with activations sharded on the sequence dim and exact
+             causal attention over the ring (gpt_forward_sp / lm_loss_sp /
+             make_lm_train_step_sp)
 ulysses    : the all-to-all sequence-parallel scheme — trade sequence shards
              for head shards, run dense attention, trade back (same exactness
              contract as ring; pick per workload)
@@ -28,6 +32,11 @@ from symbiont_tpu.parallel.sharding import (
     gpt_param_sharding,
     replicate,
     shard_params,
+)
+from symbiont_tpu.parallel.context import (
+    gpt_forward_sp,
+    lm_loss_sp,
+    make_lm_train_step_sp,
 )
 from symbiont_tpu.parallel.ring_attention import (
     ring_attention,
@@ -45,6 +54,9 @@ __all__ = [
     "replicate",
     "gpt_param_sharding",
     "shard_params",
+    "gpt_forward_sp",
+    "lm_loss_sp",
+    "make_lm_train_step_sp",
     "ring_attention",
     "ring_attention_sharded",
     "ulysses_attention",

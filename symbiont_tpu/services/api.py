@@ -347,16 +347,19 @@ class ApiService:
                         headers=trace)
                 except TimeoutError as e:
                     return 503, resp([], f"Failed to get rerank scores from engine service: {e}")
-                rr = json.loads(reply.data)
-                if rr.get("error_message"):
-                    return 500, resp([], rr["error_message"])
-                scores = rr.get("scores")
-                if not isinstance(scores, list) or len(scores) != len(results):
-                    # C++ twin parity (api_gateway.cpp): a short score list
-                    # must not silently mix cosine and CE scales
-                    return 500, resp([], "bad rerank reply: score count mismatch")
-                for r, s in zip(results, scores):
-                    r.score = float(s)
+                try:
+                    rr = json.loads(reply.data)
+                    if rr.get("error_message"):
+                        return 500, resp([], rr["error_message"])
+                    scores = rr.get("scores")
+                    if not isinstance(scores, list) or len(scores) != len(results):
+                        # C++ twin parity (api_gateway.cpp): a short score list
+                        # must not silently mix cosine and CE scales
+                        raise ValueError("score count mismatch")
+                    for r, s in zip(results, scores):
+                        r.score = float(s)
+                except (ValueError, TypeError) as e:
+                    return 500, resp([], f"bad rerank reply: {e}")
                 results = sorted(results, key=lambda r: r.score, reverse=True)
             return 200, resp(results)
 
