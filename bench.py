@@ -11,6 +11,10 @@ framework's way (length-bucketed static shapes, big batches, bf16). The ratio
 is the design win of SURVEY.md §5.7/§7 on identical hardware.
 
 Extra detail lines go to stderr; stdout carries exactly the one JSON line.
+
+`python bench.py --full` additionally measures BASELINE.md configs #4 and #5
+(cross-encoder rerank pairs/s; GPT-2-geometry decode tokens/s + TTFT) — the
+results land on stderr and in docs/PERF.md's table.
 """
 
 from __future__ import annotations
@@ -38,6 +42,71 @@ def make_sentences(n: int, rng) -> list:
         ln = int(np.clip(rng.lognormal(2.6, 0.7), 3, 120))
         out.append(" ".join(rng.choice(words, size=ln)))
     return out
+
+
+def bench_rerank() -> None:
+    """BASELINE.md config #4: ms-marco-MiniLM-L-6 geometry cross-encoder,
+    pairs/sec over a top-k-sized candidate set."""
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+
+    eng = TpuEngine(EngineConfig(
+        embedding_dim=384, length_buckets=[128], batch_buckets=[64, 256],
+        max_batch=256, dtype="bfloat16", data_parallel=False,
+        rerank_enabled=True))
+    rng = np.random.default_rng(1)
+    passages = make_sentences(256, rng)
+    query = "tensor processing unit matrix products"
+    eng.rerank(query, passages)  # warmup: compiles the (128, 256) executable
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        eng.rerank(query, passages)
+        dt = min(dt, time.time() - t0)
+    log(f"rerank (MiniLM-L6 CE geometry, 256 pairs, pad-128, bf16): "
+        f"{256 / dt:.0f} pairs/s (p50 rerank hop {dt * 1000:.1f}ms)")
+
+
+def bench_lm_decode() -> None:
+    """BASELINE.md config #5: GPT-2-small geometry (124M, vocab 50257)
+    autoregressive decode — tokens/sec/chip and time-to-first-token."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbiont_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.GPTConfig(
+        vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+        intermediate_size=3072, max_position_embeddings=1024, arch="gpt2",
+        dtype="bfloat16")
+    params = gpt_mod.init_params(jax.random.key(0), cfg)
+    params = jax.device_put(params)
+    rng = np.random.default_rng(2)
+    B, P, NEW = 8, 64, 128
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+    key = jax.random.key(0)
+
+    def run(max_new):
+        toks, _ = gpt_mod.generate(params, ids, mask, key, cfg,
+                                   max_new_tokens=max_new, temperature=0.8,
+                                   top_k=40)
+        jax.block_until_ready(toks)
+
+    run(1)    # compile (prefill + 1-step scan)
+    run(NEW)  # compile the NEW-step scan
+    ttft = float("inf")
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        run(1)
+        ttft = min(ttft, time.time() - t0)
+        t0 = time.time()
+        run(NEW)
+        dt = min(dt, time.time() - t0)
+    log(f"lm decode (GPT-2 124M geometry, bf16, batch {B}, prompt {P}, "
+        f"{NEW} new): {B * NEW / dt:.0f} tokens/s/chip "
+        f"({NEW / dt:.0f} tok/s/stream), TTFT {ttft * 1000:.0f}ms")
 
 
 def main() -> None:
@@ -89,6 +158,10 @@ def main() -> None:
     eps_ref = n_ref / dt_ref
     log(f"reference policy (pad-512, batch 8): {n_ref} sentences in "
         f"{dt_ref:.2f}s → {eps_ref:.0f} emb/s")
+
+    if "--full" in sys.argv:
+        bench_rerank()
+        bench_lm_decode()
 
     log(f"total bench time {time.time() - t_start:.0f}s")
     print(json.dumps({

@@ -34,6 +34,7 @@ from symbiont_tpu.engine.bucketing import (
 from symbiont_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from symbiont_tpu.models import bert as bert_mod
 from symbiont_tpu.models.bert import BertConfig
+from symbiont_tpu.utils.telemetry import maybe_profile
 
 log = logging.getLogger(__name__)
 
@@ -223,17 +224,19 @@ class TpuEngine:
         # then materialize. Serializing np.asarray per batch would pay the
         # full device round-trip latency once per batch.
         pending = []
-        for bucket, indices in plan_batches(lengths, buckets, self.config.max_batch):
-            seqs = [encoded[i] for i in indices]
-            ids, mask = pad_to_bucket(seqs, bucket, self.tokenizer.pad_id)
-            bb = self._batch_bucket(len(indices))
-            ids, mask, n_real = pad_batch_rows(ids, mask, bb)
-            fn = self._get_executable("embed", bucket, bb)
-            ids_d, mask_d = self._device_batch(ids, mask)
-            pending.append((indices, n_real, fn(self.params, ids_d, mask_d)))
-        _start_host_copies(batch for _, _, batch in pending)
-        for indices, n_real, res_dev in pending:
-            out[indices] = np.asarray(res_dev)[:n_real]
+        with maybe_profile("engine.embed"):
+            for bucket, indices in plan_batches(lengths, buckets,
+                                                self.config.max_batch):
+                seqs = [encoded[i] for i in indices]
+                ids, mask = pad_to_bucket(seqs, bucket, self.tokenizer.pad_id)
+                bb = self._batch_bucket(len(indices))
+                ids, mask, n_real = pad_batch_rows(ids, mask, bb)
+                fn = self._get_executable("embed", bucket, bb)
+                ids_d, mask_d = self._device_batch(ids, mask)
+                pending.append((indices, n_real, fn(self.params, ids_d, mask_d)))
+            _start_host_copies(batch for _, _, batch in pending)
+            for indices, n_real, res_dev in pending:
+                out[indices] = np.asarray(res_dev)[:n_real]
         self.stats["embed_calls"] += 1
         self.stats["sentences_embedded"] += len(texts)
         return out
@@ -260,23 +263,25 @@ class TpuEngine:
         import jax.numpy as jnp
 
         pending = []
-        for bucket, indices in plan_batches(lengths, buckets, self.config.max_batch):
-            ids, mask = pad_to_bucket([pairs[i][0] for i in indices], bucket,
-                                      self.tokenizer.pad_id)
-            types, _ = pad_to_bucket([pairs[i][1] for i in indices], bucket, 0)
-            bb = self._batch_bucket(len(indices))
-            ids, mask, n_real = pad_batch_rows(ids, mask, bb)
-            types = np.concatenate(
-                [types, np.zeros((bb - n_real, bucket), np.int32)], axis=0
-            ) if types.shape[0] < bb else types
-            fn = self._get_executable("rerank", bucket, bb)
-            ids_d, mask_d = self._device_batch(ids, mask)
-            pending.append((indices, n_real,
-                            fn(self.cross_params, ids_d, mask_d,
-                               jnp.asarray(types))))
-        _start_host_copies(batch for _, _, batch in pending)
-        for indices, n_real, res_dev in pending:
-            out[indices] = np.asarray(res_dev)[:n_real]
+        with maybe_profile("engine.rerank"):
+            for bucket, indices in plan_batches(lengths, buckets,
+                                                self.config.max_batch):
+                ids, mask = pad_to_bucket([pairs[i][0] for i in indices], bucket,
+                                          self.tokenizer.pad_id)
+                types, _ = pad_to_bucket([pairs[i][1] for i in indices], bucket, 0)
+                bb = self._batch_bucket(len(indices))
+                ids, mask, n_real = pad_batch_rows(ids, mask, bb)
+                types = np.concatenate(
+                    [types, np.zeros((bb - n_real, bucket), np.int32)], axis=0
+                ) if types.shape[0] < bb else types
+                fn = self._get_executable("rerank", bucket, bb)
+                ids_d, mask_d = self._device_batch(ids, mask)
+                pending.append((indices, n_real,
+                                fn(self.cross_params, ids_d, mask_d,
+                                   jnp.asarray(types))))
+            _start_host_copies(batch for _, _, batch in pending)
+            for indices, n_real, res_dev in pending:
+                out[indices] = np.asarray(res_dev)[:n_real]
         self.stats["rerank_calls"] += 1
         return out
 

@@ -30,6 +30,7 @@ import numpy as np
 from symbiont_tpu.config import LmConfig
 from symbiont_tpu.models import gpt as gpt_mod
 from symbiont_tpu.models.gpt import GPTConfig
+from symbiont_tpu.utils.telemetry import maybe_profile
 
 log = logging.getLogger(__name__)
 
@@ -182,12 +183,14 @@ class LmEngine:
         with self._lock:
             self._key, sub = jax.random.split(self._key)
             t0 = time.perf_counter()
-            tokens, lengths = gpt_mod.generate(
-                self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
-                sub, self.model_cfg, max_new_tokens=new_bucket,
-                temperature=float(temperature), top_k=int(top_k),
-                eos_id=int(eos_id))
-            tokens = np.asarray(tokens)  # materialize → full decode done
+            with maybe_profile("engine.generate"):
+                tokens, lengths = gpt_mod.generate(
+                    self.params, jnp.asarray(prompt_ids),
+                    jnp.asarray(prompt_mask),
+                    sub, self.model_cfg, max_new_tokens=new_bucket,
+                    temperature=float(temperature), top_k=int(top_k),
+                    eos_id=int(eos_id))
+                tokens = np.asarray(tokens)  # materialize → full decode done
             n = int(np.asarray(lengths)[0])
             dt = time.perf_counter() - t0
             self.stats["generate_calls"] += 1

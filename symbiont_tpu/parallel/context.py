@@ -44,10 +44,8 @@ def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str):
         if cfg.arch == "llama":
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-        if nkv != nh:  # GQA: expand KV heads before the ring
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA: K/V stay at nkv heads — the ring rotates the compact blocks
+        # and expands to nh only at the local score computation
         ctx = ring_attention(q, k, v, axis, causal=True).reshape(B, S, H)
         return ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
 

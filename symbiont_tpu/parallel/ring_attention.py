@@ -29,15 +29,28 @@ from jax import shard_map
 
 def ring_attention(
     q: jax.Array,  # [B, S_loc, NH, D] — local query block
-    k: jax.Array,  # [B, S_loc, NH, D] — local key block
-    v: jax.Array,  # [B, S_loc, NH, D]
+    k: jax.Array,  # [B, S_loc, KVH, D] — local key block (KVH divides NH: GQA)
+    v: jax.Array,  # [B, S_loc, KVH, D]
     axis_name: str,
     causal: bool = False,
 ) -> jax.Array:
-    """Exact attention over the full (sharded) sequence; call inside shard_map."""
+    """Exact attention over the full (sharded) sequence; call inside shard_map.
+
+    GQA-aware: K/V may carry fewer heads than Q (KVH | NH). The compact KVH
+    blocks are what rotates over the ring — expanding to NH happens only at
+    the local score computation, so grouped-query models don't pay
+    NH/KVH × the necessary ICI bandwidth."""
     n_dev = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, NH, D = q.shape
+    KVH = k.shape[2]
+    if NH % KVH != 0:
+        raise ValueError(f"query heads {NH} not divisible by KV heads {KVH}")
+    rep = NH // KVH
+
+    def expand(blk):  # [B, S, KVH, D] → [B, S, NH, D] (local, post-rotation)
+        return jnp.repeat(blk, rep, axis=2) if rep > 1 else blk
+
     scale = 1.0 / math.sqrt(D)
 
     q32 = q.astype(jnp.float32)
@@ -51,7 +64,8 @@ def ring_attention(
         src = (idx - s) % n_dev
         kv_pos = src * S + jnp.arange(S)
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            expand(k_blk).astype(jnp.float32)) * scale
         if causal:
             mask = q_pos[None, None, :, None] >= kv_pos[None, None, None, :]
             scores = jnp.where(mask, scores, -jnp.inf)
@@ -67,7 +81,7 @@ def ring_attention(
 
         l = l * correction + probs.sum(axis=-1)
         acc = acc * correction[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", probs, v_blk.astype(jnp.float32))
+            "bhqk,bkhd->bhqd", probs, expand(v_blk).astype(jnp.float32))
 
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)

@@ -349,6 +349,8 @@ class ApiService:
                     return 503, resp([], f"Failed to get rerank scores from engine service: {e}")
                 try:
                     rr = json.loads(reply.data)
+                    if not isinstance(rr, dict):
+                        raise ValueError("reply is not a JSON object")
                     if rr.get("error_message"):
                         return 500, resp([], rr["error_message"])
                     scores = rr.get("scores")

@@ -41,6 +41,41 @@ def child_headers(parent: Optional[Dict[str, str]]) -> Dict[str, str]:
     return {TRACE_HEADER: parent[TRACE_HEADER], SPAN_HEADER: generate_uuid()}
 
 
+_profile_lock = threading.Lock()
+
+
+@contextmanager
+def maybe_profile(name: str):
+    """Device-level profiling hook (SURVEY.md §5.1 plan: "JAX profiler around
+    the embed/decode steps"). When SYMBIONT_PROFILE_DIR is set, the wrapped
+    compute runs under `jax.profiler.trace` and the XPlane trace lands there
+    (view with TensorBoard's profile plugin / xprof). Off (the default) this
+    is a no-op with zero per-call cost beyond one env lookup.
+
+    Intended use: operator sets the env var on the engine process for a short
+    diagnosis window; every embed / rerank / decode call in that window
+    produces a trace annotated with `name`.
+
+    The JAX profiler is process-global and non-reentrant ("Only one profile
+    may be run at a time"); embed / rerank / generate can overlap across
+    threads, so a call that finds a profile already running proceeds
+    unprofiled rather than crashing the live request."""
+    import os
+
+    d = os.environ.get("SYMBIONT_PROFILE_DIR")
+    if not d or not _profile_lock.acquire(blocking=False):
+        yield
+        return
+    try:
+        import jax
+
+        with jax.profiler.trace(d):
+            with jax.profiler.TraceAnnotation(name):
+                yield
+    finally:
+        _profile_lock.release()
+
+
 @contextmanager
 def span(name: str, headers: Optional[Dict[str, str]] = None, **fields):
     """Timed span with structured log line (duration_ms, trace id, extras)."""

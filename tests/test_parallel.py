@@ -229,3 +229,22 @@ def test_sp_train_step_matches_unsharded():
     for a, b in zip(ref_leaves, sp_leaves):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-4,
                                    rtol=1e-3)
+
+
+@requires_8
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gqa_matches_full(causal):
+    """GQA ring: K/V rotate at kv_heads width, expand only locally — result
+    must equal full attention over pre-expanded K/V."""
+    rng = np.random.default_rng(8)
+    B, S, NH, KVH, D = 2, 64, 8, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, S, NH, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    ref = _full_attention(q, jnp.repeat(k, NH // KVH, axis=2),
+                          jnp.repeat(v, NH // KVH, axis=2), causal=causal)
+    mesh = build_mesh([8, 1])
+    out = ring_attention_sharded(q, k, v, mesh, axis_name="data",
+                                 causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5,
+                               rtol=1e-4)
