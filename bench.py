@@ -67,18 +67,88 @@ def bench_rerank() -> None:
         f"{256 / dt:.0f} pairs/s (p50 rerank hop {dt * 1000:.1f}ms)")
 
 
+def bench_search_latency() -> None:
+    """BASELINE.md north-star metric #2: p50 semantic-search latency — query
+    embed (MiniLM-L6 geometry) + exact cosine top-k over a 10k-row
+    device-resident corpus. This is the compute path of the 2-hop
+    request-reply orchestration (SURVEY.md §3.2); bus + HTTP add ~1ms."""
+    import tempfile
+
+    from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    eng = TpuEngine(EngineConfig(
+        embedding_dim=384, length_buckets=[32, 64], batch_buckets=[1, 8, 512],
+        max_batch=512, dtype="bfloat16", data_parallel=False))
+    rng = np.random.default_rng(3)
+    corpus = make_sentences(10_000, rng)
+    with tempfile.TemporaryDirectory() as td:
+        store = VectorStore(VectorStoreConfig(dim=384, data_dir=td,
+                                              shard_capacity=16384))
+        eng.embed_texts(corpus[:600])  # warm every (bucket, batch) executable
+        t0 = time.time()
+        vecs = eng.embed_texts(corpus)
+        t_embed = time.time() - t0
+        t0 = time.time()
+        store.upsert([(f"p{i}", vecs[i], {"sentence_text": corpus[i]})
+                      for i in range(len(corpus))])
+        t_upsert = time.time() - t0
+        log(f"bulk ingest: 10k sentences embedded in {t_embed:.2f}s "
+            f"({10_000 / t_embed:.0f} emb/s), upserted in {t_upsert:.2f}s")
+
+        def measure(fn):
+            fn(make_sentences(4, rng)[0])  # warm
+            lat = []
+            for q in make_sentences(64, rng):
+                t0 = time.time()
+                fn(q)
+                lat.append(time.time() - t0)
+            ms = sorted(1000 * x for x in lat)
+            return ms[len(ms) // 2], ms[int(len(ms) * 0.95)]
+
+        def split(q):
+            assert len(store.search(eng.embed_query(q), 5)) == 5
+
+        def fused(q):
+            assert len(store.search_fused(eng, q, 5)) == 5
+
+        # warm every query-length bucket for both paths
+        for ql in ["a b c", " ".join(["word"] * 40)]:
+            split(ql), fused(ql)
+        p50, p95 = measure(split)
+        log(f"semantic search, split path (10k corpus, top-5): "
+            f"p50 {p50:.1f}ms, p95 {p95:.1f}ms (embed call + top-k call)")
+        p50f, p95f = measure(fused)
+        log(f"semantic search, FUSED path (10k corpus, top-5): "
+            f"p50 {p50f:.1f}ms, p95 {p95f:.1f}ms "
+            f"(one compiled embed+top-k program, one device round-trip)")
+
+
 def bench_lm_decode() -> None:
     """BASELINE.md config #5: GPT-2-small geometry (124M, vocab 50257)
     autoregressive decode — tokens/sec/chip and time-to-first-token."""
+    _bench_decode_geometry("GPT-2 124M", dict(
+        vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+        intermediate_size=3072, max_position_embeddings=1024, arch="gpt2"))
+
+
+def bench_tinyllama_decode() -> None:
+    """BASELINE.md config #5 (second named model): TinyLlama-1.1B geometry —
+    22 layers, GQA 32/4, SwiGLU, RoPE — decode on one chip, bf16."""
+    _bench_decode_geometry("TinyLlama 1.1B", dict(
+        vocab_size=32000, hidden_size=2048, num_layers=22, num_heads=32,
+        num_kv_heads=4, intermediate_size=5632, max_position_embeddings=2048,
+        arch="llama"))
+
+
+def _bench_decode_geometry(label: str, cfg_kw: dict) -> None:
     import jax
     import jax.numpy as jnp
 
     from symbiont_tpu.models import gpt as gpt_mod
 
-    cfg = gpt_mod.GPTConfig(
-        vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
-        intermediate_size=3072, max_position_embeddings=1024, arch="gpt2",
-        dtype="bfloat16")
+    cfg = gpt_mod.GPTConfig(dtype="bfloat16", **cfg_kw)
     params = gpt_mod.init_params(jax.random.key(0), cfg)
     params = jax.device_put(params)
     rng = np.random.default_rng(2)
@@ -104,7 +174,7 @@ def bench_lm_decode() -> None:
         t0 = time.time()
         run(NEW)
         dt = min(dt, time.time() - t0)
-    log(f"lm decode (GPT-2 124M geometry, bf16, batch {B}, prompt {P}, "
+    log(f"lm decode ({label} geometry, bf16, batch {B}, prompt {P}, "
         f"{NEW} new): {B * NEW / dt:.0f} tokens/s/chip "
         f"({NEW / dt:.0f} tok/s/stream), TTFT {ttft * 1000:.0f}ms")
 
@@ -160,8 +230,10 @@ def main() -> None:
         f"{dt_ref:.2f}s → {eps_ref:.0f} emb/s")
 
     if "--full" in sys.argv:
+        bench_search_latency()
         bench_rerank()
         bench_lm_decode()
+        bench_tinyllama_decode()
 
     log(f"total bench time {time.time() - t_start:.0f}s")
     print(json.dumps({

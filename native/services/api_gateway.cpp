@@ -31,6 +31,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <deque>
 #include <mutex>
@@ -278,9 +279,23 @@ struct Config {
   int embed_timeout_ms;
   int search_timeout_ms;
   int rerank_timeout_ms;
+  bool fused_search;
+  int fused_timeout_ms;
+  int fused_down_ms;
 };
 
 Config g_cfg;
+
+// negative cache: after a fused-search timeout (subject unserved), skip the
+// fused probe until this steady-clock deadline so a deployment without a
+// co-located engine+store pays the probe once per window, not per request
+std::atomic<int64_t> g_fused_down_until_ms{0};
+
+int64_t steady_now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 // per-request bus connection (symbus::Client is single-owner)
 bool fresh_bus(symbus::Client& c) {
@@ -366,6 +381,54 @@ std::pair<int, std::string> route_generate_text(const std::string& body) {
   return {200, o.dump()};
 }
 
+// Rerank hop + final 200 — shared tail of the fused and 2-hop search paths.
+std::pair<int, std::string> finish_search(
+    symbus::Client& bus, const symbiont::SemanticSearchApiRequest& req,
+    symbiont::SemanticSearchApiResponse& resp,
+    const std::map<std::string, std::string>& trace) {
+  if (req.rerank && *req.rerank && !resp.results.empty()) {
+    // third hop (our addition, BASELINE.md #4): cross-encoder rerank of the
+    // top-k hits through the engine plane; hit scores become CE logits
+    json::Value rr_req = json::Value::object();
+    rr_req.set("query", json::Value(req.query_text));
+    json::Value passages = json::Value::array();
+    for (const auto& r : resp.results)
+      passages.push_back(json::Value(r.payload.sentence_text));
+    rr_req.set("passages", std::move(passages));
+    auto reply = bus.request(symbiont::subjects::ENGINE_RERANK, rr_req.dump(),
+                             g_cfg.rerank_timeout_ms, trace);
+    if (!reply) {
+      resp.results.clear();
+      resp.error_message =
+          "Failed to get rerank scores from engine service: timeout";
+      return {503, resp.to_json_string()};
+    }
+    try {
+      json::Value rr = json::parse(reply->data);
+      if (rr.has("error_message") && !rr.at("error_message").is_null()) {
+        resp.results.clear();
+        resp.error_message = rr.at("error_message").as_string();
+        return {500, resp.to_json_string()};
+      }
+      const auto& scores = rr.at("scores").as_array();
+      if (scores.size() != resp.results.size())
+        throw std::runtime_error("score count mismatch");
+      for (size_t i = 0; i < scores.size(); ++i)
+        resp.results[i].score = (float)scores[i].as_number();
+      std::stable_sort(resp.results.begin(), resp.results.end(),
+                       [](const symbiont::SemanticSearchResultItem& a,
+                          const symbiont::SemanticSearchResultItem& b) {
+                         return a.score > b.score;
+                       });
+    } catch (const std::exception& e) {
+      resp.results.clear();
+      resp.error_message = std::string("bad rerank reply: ") + e.what();
+      return {500, resp.to_json_string()};
+    }
+  }
+  return {200, resp.to_json_string()};
+}
+
 std::pair<int, std::string> route_semantic_search(const std::string& body) {
   // 2-hop orchestration, reference status mapping (main.rs:272-512):
   // hop timeout → 503; service-reported error → 500
@@ -385,6 +448,44 @@ std::pair<int, std::string> route_semantic_search(const std::string& body) {
   if (!fresh_bus(bus)) {
     resp.error_message = "bus unavailable";
     return {503, resp.to_json_string()};
+  }
+
+  if (g_cfg.fused_search && steady_now_ms() >= g_fused_down_until_ms.load()) {
+    // fused embed+top-k engine hop: one bus hop, one device round-trip;
+    // timeout or malformed reply falls back to the 2-hop orchestration
+    json::Value fq = json::Value::object();
+    fq.set("text", json::Value(req.query_text));
+    fq.set("top_k", json::Value((double)req.top_k));
+    auto reply = bus.request(symbiont::subjects::ENGINE_QUERY_SEARCH,
+                             fq.dump(), g_cfg.fused_timeout_ms, trace);
+    if (reply) {
+      try {
+        json::Value rr = json::parse(reply->data);
+        if (rr.has("error_message") && !rr.at("error_message").is_null()) {
+          resp.error_message = rr.at("error_message").as_string();
+          return {500, resp.to_json_string()};
+        }
+        std::vector<symbiont::SemanticSearchResultItem> items;
+        for (const auto& h : rr.at("hits").as_array()) {
+          symbiont::SemanticSearchResultItem item;
+          item.qdrant_point_id = h.at("id").as_string();
+          item.score = (float)h.at("score").as_number();
+          item.payload = symbiont::QdrantPointPayload::from_json(h.at("payload"));
+          items.push_back(std::move(item));
+        }
+        resp.results = std::move(items);
+        g_metrics.inc("api.fused_search");
+        return finish_search(bus, req, resp, trace);
+      } catch (const std::exception& e) {
+        symbiont::logline("WARN", SERVICE,
+                          std::string("bad fused-search reply (") + e.what() +
+                          "); falling back to 2-hop");
+        g_metrics.inc("api.fused_search_fallback");
+      }
+    } else {
+      g_fused_down_until_ms.store(steady_now_ms() + g_cfg.fused_down_ms);
+      g_metrics.inc("api.fused_search_fallback");
+    }
   }
 
   symbiont::QueryForEmbeddingTask embed_task;
@@ -436,48 +537,7 @@ std::pair<int, std::string> route_semantic_search(const std::string& body) {
     return {500, resp.to_json_string()};
   }
   resp.results = std::move(search_result.results);
-
-  if (req.rerank && *req.rerank && !resp.results.empty()) {
-    // third hop (our addition, BASELINE.md #4): cross-encoder rerank of the
-    // top-k hits through the engine plane; hit scores become CE logits
-    json::Value rr_req = json::Value::object();
-    rr_req.set("query", json::Value(req.query_text));
-    json::Value passages = json::Value::array();
-    for (const auto& r : resp.results)
-      passages.push_back(json::Value(r.payload.sentence_text));
-    rr_req.set("passages", std::move(passages));
-    reply = bus.request(symbiont::subjects::ENGINE_RERANK, rr_req.dump(),
-                        g_cfg.rerank_timeout_ms, trace);
-    if (!reply) {
-      resp.results.clear();
-      resp.error_message =
-          "Failed to get rerank scores from engine service: timeout";
-      return {503, resp.to_json_string()};
-    }
-    try {
-      json::Value rr = json::parse(reply->data);
-      if (rr.has("error_message") && !rr.at("error_message").is_null()) {
-        resp.results.clear();
-        resp.error_message = rr.at("error_message").as_string();
-        return {500, resp.to_json_string()};
-      }
-      const auto& scores = rr.at("scores").as_array();
-      if (scores.size() != resp.results.size())
-        throw std::runtime_error("score count mismatch");
-      for (size_t i = 0; i < scores.size(); ++i)
-        resp.results[i].score = (float)scores[i].as_number();
-      std::stable_sort(resp.results.begin(), resp.results.end(),
-                       [](const symbiont::SemanticSearchResultItem& a,
-                          const symbiont::SemanticSearchResultItem& b) {
-                         return a.score > b.score;
-                       });
-    } catch (const std::exception& e) {
-      resp.results.clear();
-      resp.error_message = std::string("bad rerank reply: ") + e.what();
-      return {500, resp.to_json_string()};
-    }
-  }
-  return {200, resp.to_json_string()};
+  return finish_search(bus, req, resp, trace);
 }
 
 // --------------------------------------------------------------------- sse
@@ -622,6 +682,14 @@ int main() {
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_SEARCH_S", "20").c_str()));
   g_cfg.rerank_timeout_ms = (int)(1000 * std::atof(
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_RERANK_S", "10").c_str()));
+  {
+    std::string fused = symbiont::env_or("SYMBIONT_API_FUSED_SEARCH", "true");
+    g_cfg.fused_search = (fused != "false" && fused != "0" && fused != "no");
+  }
+  g_cfg.fused_timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_TIMEOUT_S", "5").c_str()));
+  g_cfg.fused_down_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_DOWN_S", "60").c_str()));
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) return 1;

@@ -37,6 +37,7 @@ inline const char* ENGINE_RERANK = "engine.rerank";
 inline const char* ENGINE_GENERATE = "engine.generate";
 inline const char* ENGINE_VECTOR_UPSERT = "engine.vector.upsert";
 inline const char* ENGINE_VECTOR_SEARCH = "engine.vector.search";
+inline const char* ENGINE_QUERY_SEARCH = "engine.query.search";
 inline const char* ENGINE_GRAPH_SAVE = "engine.graph.save";
 inline const char* Q_PERCEPTION = "q.perception";
 inline const char* Q_PREPROCESSING = "q.preprocessing";

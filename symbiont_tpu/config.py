@@ -125,6 +125,14 @@ class ApiConfig:
     sse_keepalive_s: float = 15.0  # reference: api_service/src/main.rs:190-213
     sse_channel_capacity: int = 32  # reference: api_service/src/main.rs:537
     max_gen_length: int = 1000  # reference: api_service/src/main.rs:133
+    # try the fused embed+top-k engine hop first (one device round-trip);
+    # fall back to the reference's 2-hop embed→search orchestration when the
+    # fused subject isn't served (engine and store in separate processes)
+    fused_search: bool = True
+    fused_search_timeout_s: float = 5.0
+    # after a fused timeout, skip the fused probe for this long (the subject
+    # is unserved when engine and store are not co-located)
+    fused_search_down_s: float = 60.0
 
 
 @dataclass

@@ -152,7 +152,7 @@ class TpuEngine:
 
         # stats (SURVEY.md §5.5: the reference has none)
         self.stats = {"embed_calls": 0, "sentences_embedded": 0,
-                      "rerank_calls": 0, "compiles": 0}
+                      "rerank_calls": 0, "qsearch_calls": 0, "compiles": 0}
 
     # ------------------------------------------------------------------ jit
 
@@ -171,6 +171,25 @@ class TpuEngine:
             def fn(params, ids, mask):
                 return bert_mod.embed_sentences(params, ids, mask, cfg,
                                                 pooling=pooling, normalize=normalize)
+        elif kind == "qsearch":
+            # fused interactive query: BERT forward + pool + normalize +
+            # cosine scores against the device-resident corpus + top-k, ONE
+            # compiled program — the whole search hop is a single device
+            # round-trip (the split embed→search path pays ≥2; on a
+            # network-attached chip each costs ~100ms).
+            import jax.numpy as jnp
+
+            cfg, pooling = self.model_cfg, self.pooling
+            cap, k = B  # for qsearch the batch slot carries (capacity, top_k)
+
+            def fn(params, ids, mask, corpus, n_valid):
+                emb = bert_mod.embed_sentences(params, ids, mask, cfg,
+                                               pooling=pooling, normalize=True)
+                q = emb[0].astype(jnp.bfloat16)  # [D]
+                scores = (corpus.astype(jnp.bfloat16) @ q).astype(jnp.float32)
+                valid = jnp.arange(cap) < n_valid
+                scores = jnp.where(valid, scores, -jnp.inf)
+                return jax.lax.top_k(scores, k)
         elif kind == "rerank":
             ccfg = self.cross_cfg
 
@@ -244,6 +263,31 @@ class TpuEngine:
     def embed_query(self, text: str) -> np.ndarray:
         """Single query embedding (the tasks.embedding.for_query path)."""
         return self.embed_texts([text])[0]
+
+    def embed_and_search(self, text: str, corpus_dev, n_valid: int,
+                         top_k: int):
+        """Fused interactive query (the latency half of SURVEY.md §7 hard
+        part 4): tokenize on host, then ONE device program does the BERT
+        forward, pooling, normalization, cosine scores against the
+        device-resident corpus, and top-k. Returns (scores[k], idx[k]) as
+        numpy. corpus_dev rows must be L2-normalized ([cap, D] on device)."""
+        import jax.numpy as jnp
+
+        max_len = min(self.config.length_buckets[-1],
+                      self.model_cfg.max_position_embeddings)
+        encoded = self.tokenizer.encode(text, max_len)
+        buckets = [b for b in self.config.length_buckets
+                   if b <= self.model_cfg.max_position_embeddings]
+        bucket = choose_bucket(len(encoded), buckets)
+        ids, mask = pad_to_bucket([encoded], bucket, self.tokenizer.pad_id)
+        cap = corpus_dev.shape[0]
+        with maybe_profile("engine.qsearch"):
+            fn = self._get_executable("qsearch", bucket, (cap, top_k))
+            scores, idx = fn(self.params, jnp.asarray(ids), jnp.asarray(mask),
+                             corpus_dev, n_valid)
+            _start_host_copies((scores, idx))  # both d2h copies in flight
+            self.stats["qsearch_calls"] += 1
+            return np.asarray(scores), np.asarray(idx)
 
     # --------------------------------------------------------------- rerank
 

@@ -175,6 +175,22 @@ class VectorStore:
             self._search_fns[key] = jax.jit(fn)
         return self._search_fns[key]
 
+    def _k_static(self, top_k: int, n: int, cap: int) -> int:
+        """Static k bucket (next power of two ≥ k, ≤ cap) bounds executables."""
+        k = 1
+        while k < min(top_k, n):
+            k *= 2
+        return min(k, cap)
+
+    def _hits_from(self, scores, idx, top_k: int) -> List[SearchHit]:
+        hits = []
+        for s, i in zip(np.asarray(scores)[:top_k], np.asarray(idx)[:top_k]):
+            if not np.isfinite(s):
+                continue
+            hits.append(SearchHit(id=self._ids[i], score=float(s),
+                                  payload=dict(self._payloads[i])))
+        return hits
+
     def search(self, query: Sequence[float], top_k: int) -> List[SearchHit]:
         """Exact cosine top-k (reference search handler: main.rs:230-456)."""
         import jax.numpy as jnp
@@ -190,22 +206,24 @@ class VectorStore:
                 raise ValueError(f"query dim {q.shape} != collection dim {self.dim}")
             qn = float(np.linalg.norm(q))
             q = q / qn if qn > 0 else q
-            # static k bucket (next power of two ≥ k, ≤ cap) bounds executables
-            k_static = 1
-            while k_static < min(top_k, n):
-                k_static *= 2
-            k_static = min(k_static, cap)
-            fn = self._get_search_fn(cap, k_static)
+            fn = self._get_search_fn(cap, self._k_static(top_k, n, cap))
             scores, idx = fn(self._device_corpus, jnp.asarray(q), n)
-            scores = np.asarray(scores)[:top_k]
-            idx = np.asarray(idx)[:top_k]
-            hits = []
-            for s, i in zip(scores, idx):
-                if not np.isfinite(s):
-                    continue
-                hits.append(SearchHit(id=self._ids[i], score=float(s),
-                                      payload=dict(self._payloads[i])))
-            return hits
+            return self._hits_from(scores, idx, top_k)
+
+    def search_fused(self, engine, text: str, top_k: int) -> List[SearchHit]:
+        """Interactive-query fast path: hand the device-resident corpus to the
+        engine's fused embed+top-k executable (one device round-trip instead
+        of embed then search). Same results as search(embed_query(text)) —
+        asserted in tests — with the same static-k bucketing."""
+        with self._lock:
+            n = len(self._ids)
+            if n == 0 or top_k <= 0:
+                return []
+            self._sync_device()
+            cap = self._device_corpus.shape[0]
+            scores, idx = engine.embed_and_search(
+                text, self._device_corpus, n, self._k_static(top_k, n, cap))
+            return self._hits_from(scores, idx, top_k)
 
     # --------------------------------------------------------- persistence
 

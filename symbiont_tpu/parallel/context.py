@@ -27,13 +27,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from symbiont_tpu.models.gpt import GPTConfig, _ln, _rmsnorm, _rope
 from symbiont_tpu.parallel.ring_attention import ring_attention
+from symbiont_tpu.parallel.ulysses import ulysses_attention
 
 Params = Any
 
 
-def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str):
-    """One decoder block with ring attention; x: [B, S_loc, H] (local shard),
-    positions: [B, S_loc] global token positions of the local shard."""
+def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str, attn_impl: str):
+    """One decoder block with sequence-parallel attention; x: [B, S_loc, H]
+    (local shard), positions: [B, S_loc] global token positions."""
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
@@ -44,9 +45,17 @@ def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str):
         if cfg.arch == "llama":
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-        # GQA: K/V stay at nkv heads — the ring rotates the compact blocks
-        # and expands to nh only at the local score computation
-        ctx = ring_attention(q, k, v, axis, causal=True).reshape(B, S, H)
+        if attn_impl == "ulysses":
+            # Ulysses re-shards heads over the axis, so K/V must be at full
+            # head count first (the all-to-all splits the head dim)
+            if nkv != nh:
+                k = jnp.repeat(k, nh // nkv, axis=2)
+                v = jnp.repeat(v, nh // nkv, axis=2)
+            ctx = ulysses_attention(q, k, v, axis, causal=True).reshape(B, S, H)
+        else:
+            # GQA: K/V stay at nkv heads — the ring rotates the compact
+            # blocks and expands to nh only at the local score computation
+            ctx = ring_attention(q, k, v, axis, causal=True).reshape(B, S, H)
         return ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
 
     if cfg.arch == "gpt2":
@@ -70,6 +79,7 @@ def gpt_forward_sp(
     mesh: Mesh,
     cfg: GPTConfig,
     axis: str = "data",
+    attn_impl: str = "ring",
 ) -> jax.Array:
     """Sequence-parallel training forward → logits [B, S, V] (sharded on S).
 
@@ -96,7 +106,7 @@ def gpt_forward_sp(
         if cfg.arch == "gpt2":
             x = x + params["wpe"][positions]
         for layer in params["layers"]:
-            x = _block_sp(layer, x, positions, cfg, axis)
+            x = _block_sp(layer, x, positions, cfg, axis, attn_impl)
         if cfg.arch == "gpt2":
             x = _ln(x, params["ln_f"], cfg.layer_norm_eps)
         else:
@@ -115,21 +125,23 @@ def gpt_forward_sp(
 
 
 def lm_loss_sp(params: Params, batch: dict, cfg: GPTConfig, mesh: Mesh,
-               axis: str = "data") -> jax.Array:
+               axis: str = "data", attn_impl: str = "ring") -> jax.Array:
     """Next-token CE over a sequence-sharded forward. The shifted-target
     gather crosses shard boundaries; XLA inserts the halo exchange."""
     import optax
 
     ids = batch["ids"]
     mask = batch["mask"].astype(jnp.float32)
-    logits = gpt_forward_sp(params, ids, mesh, cfg, axis=axis)
+    logits = gpt_forward_sp(params, ids, mesh, cfg, axis=axis,
+                            attn_impl=attn_impl)
     targets = ids[:, 1:]
     w = mask[:, 1:] * mask[:, :-1]
     ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
     return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
 
 
-def make_lm_train_step_sp(mesh: Mesh, cfg: GPTConfig, tx, axis: str = "data"):
+def make_lm_train_step_sp(mesh: Mesh, cfg: GPTConfig, tx, axis: str = "data",
+                          attn_impl: str = "ring"):
     """Build a jitted sequence-parallel LM train step bound to (mesh, axis).
 
     Complements trainer.lm_train_step: same TrainState/metrics contract, but
@@ -141,7 +153,7 @@ def make_lm_train_step_sp(mesh: Mesh, cfg: GPTConfig, tx, axis: str = "data"):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: dict):
         loss, grads = jax.value_and_grad(lm_loss_sp)(
-            state.params, batch, cfg, mesh, axis)
+            state.params, batch, cfg, mesh, axis, attn_impl)
         import optax
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)

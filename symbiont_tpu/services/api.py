@@ -104,6 +104,10 @@ class ApiService:
         self.config = config or ApiConfig()
         self.bus_config = bus_config or BusConfig()
         self.hub = _SseHub(self.config.sse_channel_capacity)
+        # negative cache for the fused-search subject: after a timeout
+        # (subject unserved — engine and store not co-located), skip the
+        # fused attempt for a window instead of stalling every request
+        self._fused_down_until = 0.0
         self._server: Optional[asyncio.AbstractServer] = None
         self._bridge_task: Optional[asyncio.Task] = None
         self._bridge_sub = None
@@ -303,6 +307,16 @@ class ApiService:
                 error_message=err))
 
         with span("api.search", trace, top_k=req.top_k):
+            if self.config.fused_search:
+                fused = await self._fused_search(req, trace)
+                if fused is not None:
+                    results, err = fused
+                    if err is not None:
+                        return 500, resp([], err)
+                    if req.rerank and results:
+                        return await self._apply_rerank(req, results, resp, trace)
+                    return 200, resp(results)
+                # fused subject unserved / malformed reply → 2-hop fallback
             embed_task = QueryForEmbeddingTask(request_id=request_id,
                                                text_to_embed=req.query_text)
             try:
@@ -335,35 +349,83 @@ class ApiService:
                 return 500, resp([], search_result.error_message)
             results = search_result.results
             if req.rerank and results:
-                # third hop (our addition, BASELINE.md #4): cross-encoder
-                # rerank of the top-k hits; scores become CE relevance logits
-                rerank_req = {"query": req.query_text,
-                              "passages": [r.payload.sentence_text for r in results]}
-                try:
-                    reply = await self.bus.request(
-                        subjects.ENGINE_RERANK,
-                        json.dumps(rerank_req).encode(),
-                        timeout=self.bus_config.request_timeout_rerank_s,
-                        headers=trace)
-                except TimeoutError as e:
-                    return 503, resp([], f"Failed to get rerank scores from engine service: {e}")
-                try:
-                    rr = json.loads(reply.data)
-                    if not isinstance(rr, dict):
-                        raise ValueError("reply is not a JSON object")
-                    if rr.get("error_message"):
-                        return 500, resp([], rr["error_message"])
-                    scores = rr.get("scores")
-                    if not isinstance(scores, list) or len(scores) != len(results):
-                        # C++ twin parity (api_gateway.cpp): a short score list
-                        # must not silently mix cosine and CE scales
-                        raise ValueError("score count mismatch")
-                    for r, s in zip(results, scores):
-                        r.score = float(s)
-                except (ValueError, TypeError) as e:
-                    return 500, resp([], f"bad rerank reply: {e}")
-                results = sorted(results, key=lambda r: r.score, reverse=True)
+                return await self._apply_rerank(req, results, resp, trace)
             return 200, resp(results)
+
+    async def _fused_search(self, req: SemanticSearchApiRequest, trace):
+        """Try the fused embed+top-k engine hop (one device round-trip).
+        Returns (results, error) on a served reply, or None to signal
+        fallback to the 2-hop orchestration (subject unserved within the
+        short timeout, or malformed reply). A timeout negative-caches the
+        subject for fused_search_down_s so a deployment without a co-located
+        engine+store pays the probe once per window, not per request."""
+        import time as _time
+
+        from symbiont_tpu.schema import QdrantPointPayload, SemanticSearchResultItem
+
+        if _time.monotonic() < self._fused_down_until:
+            return None
+        try:
+            reply = await self.bus.request(
+                subjects.ENGINE_QUERY_SEARCH,
+                json.dumps({"text": req.query_text,
+                            "top_k": req.top_k}).encode(),
+                timeout=self.config.fused_search_timeout_s,
+                headers=trace)
+        except TimeoutError:
+            self._fused_down_until = (_time.monotonic()
+                                      + self.config.fused_search_down_s)
+            metrics.inc("api.fused_search_fallback")
+            return None
+        try:
+            rr = json.loads(reply.data)
+            if not isinstance(rr, dict):
+                raise ValueError("reply is not a JSON object")
+            if rr.get("error_message"):
+                return [], rr["error_message"]
+            results = [
+                SemanticSearchResultItem(
+                    qdrant_point_id=h["id"], score=float(h["score"]),
+                    payload=QdrantPointPayload(**h["payload"]))
+                for h in rr["hits"]
+            ]
+            metrics.inc("api.fused_search")
+            return results, None
+        except (ValueError, TypeError, KeyError) as e:
+            log.warning("bad fused-search reply (%s); falling back to 2-hop", e)
+            metrics.inc("api.fused_search_fallback")
+            return None
+
+    async def _apply_rerank(self, req, results, resp, trace) -> Tuple[int, str]:
+        """Third hop (our addition, BASELINE.md #4): cross-encoder rerank of
+        the top-k hits; scores become CE relevance logits."""
+        rerank_req = {"query": req.query_text,
+                      "passages": [r.payload.sentence_text for r in results]}
+        try:
+            reply = await self.bus.request(
+                subjects.ENGINE_RERANK,
+                json.dumps(rerank_req).encode(),
+                timeout=self.bus_config.request_timeout_rerank_s,
+                headers=trace)
+        except TimeoutError as e:
+            return 503, resp([], f"Failed to get rerank scores from engine service: {e}")
+        try:
+            rr = json.loads(reply.data)
+            if not isinstance(rr, dict):
+                raise ValueError("reply is not a JSON object")
+            if rr.get("error_message"):
+                return 500, resp([], rr["error_message"])
+            scores = rr.get("scores")
+            if not isinstance(scores, list) or len(scores) != len(results):
+                # C++ twin parity (api_gateway.cpp): a short score list
+                # must not silently mix cosine and CE scales
+                raise ValueError("score count mismatch")
+            for r, s in zip(results, scores):
+                r.score = float(s)
+        except (ValueError, TypeError) as e:
+            return 500, resp([], f"bad rerank reply: {e}")
+        results = sorted(results, key=lambda r: r.score, reverse=True)
+        return 200, resp(results)
 
     # ------------------------------------------------------------------ SSE
 

@@ -85,6 +85,10 @@ class EngineService(Service):
         if self.vector_store is not None:
             await sub(subjects.ENGINE_VECTOR_UPSERT, self._vec_upsert, queue=q)
             await sub(subjects.ENGINE_VECTOR_SEARCH, self._vec_search, queue=q)
+        if self.engine is not None and self.vector_store is not None:
+            # fused embed+top-k — only meaningful when this process holds
+            # both the model and the corpus
+            await sub(subjects.ENGINE_QUERY_SEARCH, self._query_search, queue=q)
         if self.graph_store is not None:
             await sub(subjects.ENGINE_GRAPH_SAVE, self._graph_save, queue=q)
         await sub(subjects.ENGINE_HEALTH, self._health, queue=q)
@@ -178,6 +182,22 @@ class EngineService(Service):
             return {"hits": [{"id": h.id, "score": float(h.score),
                               "payload": h.payload} for h in hits]}
         await self._handle(msg, "vector.search", op)
+
+    async def _query_search(self, msg: Msg) -> None:
+        """Fused interactive query: text → embed + cosine top-k in one device
+        program (TpuEngine.embed_and_search). The latency path of SURVEY.md
+        §3.2 collapsed to a single bus hop and a single device round-trip."""
+        async def op(req: dict) -> dict:
+            text = req["text"]
+            if not isinstance(text, str):
+                raise ValueError("text must be a string")
+            hits = await self._run_blocking(
+                self.vector_store.search_fused, self.engine, text,
+                int(req["top_k"]))
+            return {"hits": [{"id": h.id, "score": float(h.score),
+                              "payload": h.payload} for h in hits],
+                    "model_name": self.engine.config.model_name}
+        await self._handle(msg, "query.search", op)
 
     async def _graph_save(self, msg: Msg) -> None:
         async def op(req: dict) -> dict:

@@ -50,6 +50,10 @@ ENGINE_RERANK = "engine.rerank"
 ENGINE_GENERATE = "engine.generate"
 ENGINE_VECTOR_UPSERT = "engine.vector.upsert"
 ENGINE_VECTOR_SEARCH = "engine.vector.search"
+# fused interactive query: embed + cosine top-k in ONE device program (served
+# only when the engine process co-hosts the vector store; the api gateway
+# falls back to the 2-hop embed→search orchestration otherwise)
+ENGINE_QUERY_SEARCH = "engine.query.search"
 ENGINE_GRAPH_SAVE = "engine.graph.save"
 ENGINE_HEALTH = "engine.health"
 

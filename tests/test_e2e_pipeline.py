@@ -119,6 +119,12 @@ def test_ingest_search_generate_roundtrip(stack_config):
                 "original_document_id", "source_url", "sentence_text",
                 "sentence_order", "model_name", "processed_at_ms"}
 
+            # the search above was served by the fused embed+top-k path
+            # (engine and store co-located in this stack)
+            status, body = await http("GET", port, "/api/metrics")
+            assert status == 200
+            assert body["counters"].get("api.fused_search", 0) >= 1
+
             # --- 3.2b search + cross-encoder rerank (BASELINE #4) --------
             status, body = await http("POST", port, "/api/search/semantic",
                                       {"query_text": "matrix multiplication",
@@ -230,7 +236,8 @@ def test_search_timeout_maps_to_503(stack_config):
         from symbiont_tpu.services.api import ApiService
 
         bus = InprocBus()
-        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0),
+        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0,
+                                        fused_search=False),
                          BusConfig(request_timeout_embed_s=0.2))
         await api.start()
         loop = asyncio.get_running_loop()
@@ -288,7 +295,8 @@ def test_rerank_timeout_maps_to_503(stack_config):
         tasks = [asyncio.create_task(embed_responder()),
                  asyncio.create_task(search_responder())]
         await asyncio.sleep(0)  # let responders subscribe
-        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0),
+        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0,
+                                        fused_search=False),
                          BusConfig(request_timeout_rerank_s=0.2))
         await api.start()
         loop = asyncio.get_running_loop()

@@ -202,3 +202,34 @@ def test_profile_hook_writes_trace(tmp_path, monkeypatch):
     eng.embed_texts(["profile me"])
     traces = list(tmp_path.rglob("*.xplane.pb"))
     assert traces, f"no xplane trace written under {tmp_path}"
+
+
+def test_fused_query_search_matches_split_path(tmp_path):
+    """embed_and_search (one device program) must rank exactly like the
+    split embed_query → store.search path."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    eng = _small_engine()
+    store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path),
+                                          shard_capacity=64))
+    corpus = [f"sentence number {i} about topic {i % 5}" for i in range(20)]
+    vecs = eng.embed_texts(corpus)
+    store.upsert([(f"p{i}", vecs[i], {"sentence_text": corpus[i], "i": i})
+                  for i in range(len(corpus))])
+
+    split = store.search(eng.embed_query("topic 3"), 5)
+    fused = store.search_fused(eng, "topic 3", 5)
+    assert [h.id for h in fused] == [h.id for h in split]
+    for a, b in zip(fused, split):
+        assert abs(a.score - b.score) < 1e-2  # bf16 matmul rounding
+        assert a.payload == b.payload
+
+
+def test_fused_query_search_empty_store(tmp_path):
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    eng = _small_engine()
+    store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path)))
+    assert store.search_fused(eng, "anything", 5) == []

@@ -248,3 +248,28 @@ def test_ring_attention_gqa_matches_full(causal):
                                  causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5,
                                rtol=1e-4)
+
+
+@requires_8
+def test_sp_forward_ulysses_matches_cache_forward():
+    """The Ulysses (all-to-all) scheme as the SP attention backend must also
+    reproduce the KV-cache forward — both schemes are exact, pick per
+    workload (heads divisible by axis → Ulysses; else ring)."""
+    from symbiont_tpu.parallel.context import gpt_forward_sp
+
+    cfg = gpt_mod.GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=8, intermediate_size=64,
+                            max_position_embeddings=64, arch="gpt2",
+                            dtype="float32")
+    params = gpt_mod.init_params(jax.random.key(4), cfg)
+    B, S = 2, 32
+    ids = np.random.default_rng(9).integers(0, 64, size=(B, S)).astype(np.int32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    cache = gpt_mod.init_cache(cfg, B, S, jnp.float32)
+    ref, _ = gpt_mod.forward(params, jnp.asarray(ids), cache, pos, cfg)
+
+    mesh = build_mesh([8, 1])
+    out = gpt_forward_sp(params, jnp.asarray(ids), mesh, cfg, axis="data",
+                         attn_impl="ulysses")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4,
+                               rtol=1e-3)
