@@ -176,8 +176,15 @@ class VectorStore:
         return self._search_fns[key]
 
     def _k_static(self, top_k: int, n: int, cap: int) -> int:
-        """Static k bucket (next power of two ≥ k, ≤ cap) bounds executables."""
-        k = 1
+        """Static k bucket (next power of two ≥ k, ≤ cap) bounds executables.
+
+        Floored at 8 so every interactive query with top_k ≤ 8 (the common
+        range) shares ONE executable per (capacity, length-bucket) — without
+        the floor, each distinct top_k minted a fresh XLA compile, which on a
+        cold engine blows the fused-search probe timeout per k value. Extra
+        rows cost nothing (top-8 vs top-2 is the same matmul + tiny sort) and
+        surplus entries are trimmed/-inf-filtered by the caller."""
+        k = 8
         while k < min(top_k, n):
             k *= 2
         return min(k, cap)
@@ -192,7 +199,12 @@ class VectorStore:
         return hits
 
     def search(self, query: Sequence[float], top_k: int) -> List[SearchHit]:
-        """Exact cosine top-k (reference search handler: main.rs:230-456)."""
+        """Exact cosine top-k (reference search handler: main.rs:230-456).
+
+        The device call (and any first-shape XLA compile, 20-40s on TPU) runs
+        OUTSIDE the store lock: rows only ever append (upsert overwrites in
+        place), so a snapshot of (corpus, n) taken under the lock stays valid,
+        and concurrent ingest/search callers never stall behind a compile."""
         import jax.numpy as jnp
 
         with self._lock:
@@ -200,14 +212,16 @@ class VectorStore:
             if n == 0 or top_k <= 0:
                 return []
             self._sync_device()
-            cap = self._device_corpus.shape[0]
+            corpus = self._device_corpus
+            cap = corpus.shape[0]
             q = np.asarray(query, np.float32)
             if q.shape != (self.dim,):
                 raise ValueError(f"query dim {q.shape} != collection dim {self.dim}")
-            qn = float(np.linalg.norm(q))
-            q = q / qn if qn > 0 else q
             fn = self._get_search_fn(cap, self._k_static(top_k, n, cap))
-            scores, idx = fn(self._device_corpus, jnp.asarray(q), n)
+        qn = float(np.linalg.norm(q))
+        q = q / qn if qn > 0 else q
+        scores, idx = fn(corpus, jnp.asarray(q), n)
+        with self._lock:
             return self._hits_from(scores, idx, top_k)
 
     def search_fused(self, engine, text: str, top_k: int) -> List[SearchHit]:
@@ -220,10 +234,29 @@ class VectorStore:
             if n == 0 or top_k <= 0:
                 return []
             self._sync_device()
-            cap = self._device_corpus.shape[0]
-            scores, idx = engine.embed_and_search(
-                text, self._device_corpus, n, self._k_static(top_k, n, cap))
+            corpus = self._device_corpus
+            k = self._k_static(top_k, n, corpus.shape[0])
+        # device call (and any first-shape compile) outside the lock — see
+        # search() for why the snapshot stays valid
+        scores, idx = engine.embed_and_search(text, corpus, n, k)
+        with self._lock:
             return self._hits_from(scores, idx, top_k)
+
+    def warm_fused(self, engine, word_counts: Sequence[int] = (3, 40, 150)
+                   ) -> None:
+        """Pre-compile the fused embed+top-k executables for the store's
+        CURRENT capacity across the engine's query length buckets — including
+        an empty store (capacity is the first block, which the first
+        shard_capacity upserts keep). Without this, the first fused query per
+        (length-bucket, capacity) pays the full XLA compile inside the
+        gateway's short probe timeout."""
+        with self._lock:
+            self._sync_device()
+            corpus = self._device_corpus
+            n = len(self._ids)
+            k = self._k_static(8, max(n, 8), corpus.shape[0])
+        for wc in word_counts:
+            engine.embed_and_search("warm " * wc, corpus, n, k)
 
     # --------------------------------------------------------- persistence
 

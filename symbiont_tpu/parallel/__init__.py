@@ -26,7 +26,11 @@ only defines meshes and shardings — no hand-written NCCL analog (SURVEY.md §2
 "Distributed communication backend").
 """
 
-from symbiont_tpu.parallel.mesh import build_mesh, local_device_count
+from symbiont_tpu.parallel.mesh import (
+    build_mesh,
+    init_distributed,
+    local_device_count,
+)
 from symbiont_tpu.parallel.sharding import (
     batch_sharding,
     gpt_param_sharding,
@@ -49,6 +53,7 @@ from symbiont_tpu.parallel.ulysses import (
 
 __all__ = [
     "build_mesh",
+    "init_distributed",
     "local_device_count",
     "batch_sharding",
     "replicate",

@@ -22,6 +22,37 @@ def local_device_count() -> int:
     return len(jax.devices())
 
 
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Multi-host bring-up: one engine process per host in a TPU slice.
+
+    Thin wrapper over `jax.distributed.initialize` — on TPU pods the runtime
+    discovers coordinator/process topology itself, so all arguments are
+    optional (pass them explicitly only for non-TPU backends or tests). After
+    this, `jax.devices()` spans the whole slice and `build_mesh` meshes over
+    it; XLA collectives ride ICI within a host block and DCN between hosts.
+    Env override: SYMBIONT_COORDINATOR / SYMBIONT_NUM_PROCESSES /
+    SYMBIONT_PROCESS_ID. Returns the global device count.
+
+    Safe to call when already initialized (a second call is a no-op)."""
+    import os
+
+    coordinator = coordinator or os.environ.get("SYMBIONT_COORDINATOR")
+    if num_processes is None and "SYMBIONT_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["SYMBIONT_NUM_PROCESSES"])
+    if process_id is None and "SYMBIONT_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["SYMBIONT_PROCESS_ID"])
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:
+        if "already" not in str(e).lower():
+            raise
+    return len(jax.devices())
+
+
 def build_mesh(
     shape: Optional[Sequence[int]] = None,
     axis_names: Sequence[str] = ("data", "tensor"),

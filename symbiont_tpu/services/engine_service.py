@@ -59,13 +59,32 @@ class EngineService(Service):
         self.lm = lm
         self.vector_store = vector_store
         self.graph_store = graph_store
+        self._warm_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         if self.batcher:
             await self.batcher.start()
         await super().start()
+        if self.engine is not None and self.vector_store is not None:
+            # background-compile the fused query executables for the store's
+            # current capacity across the query length buckets (works for an
+            # empty store too — capacity is the first block), so interactive
+            # queries don't eat the 20-40s TPU compile inside the gateway's
+            # probe timeout. Queries arriving mid-warmup fall back to the
+            # 2-hop path; the store lock is never held across a compile.
+            async def warm() -> None:
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.vector_store.warm_fused, self.engine)
+                    log.info("fused query executables warmed")
+                except Exception:
+                    log.exception("fused warmup failed (non-fatal)")
+
+            self._warm_task = asyncio.create_task(warm(), name="fused-warmup")
 
     async def stop(self) -> None:
+        if self._warm_task is not None:
+            self._warm_task.cancel()
         await super().stop()
         if self.batcher:
             await self.batcher.close()

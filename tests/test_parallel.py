@@ -273,3 +273,27 @@ def test_sp_forward_ulysses_matches_cache_forward():
                          attn_impl="ulysses")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4,
                                rtol=1e-3)
+
+
+@requires_8
+def test_sp_forward_ulysses_gqa_matches_cache_forward():
+    """Ulysses SP with GQA (nkv < nh): the pre-all-to-all K/V head expansion
+    must map query heads to the right KV groups."""
+    from symbiont_tpu.parallel.context import gpt_forward_sp
+
+    cfg = gpt_mod.GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
+                            num_heads=8, num_kv_heads=2, intermediate_size=64,
+                            max_position_embeddings=64, arch="llama",
+                            dtype="float32")
+    params = gpt_mod.init_params(jax.random.key(5), cfg)
+    B, S = 2, 32
+    ids = np.random.default_rng(10).integers(0, 64, size=(B, S)).astype(np.int32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    cache = gpt_mod.init_cache(cfg, B, S, jnp.float32)
+    ref, _ = gpt_mod.forward(params, jnp.asarray(ids), cache, pos, cfg)
+
+    mesh = build_mesh([8, 1])
+    out = gpt_forward_sp(params, jnp.asarray(ids), mesh, cfg, axis="data",
+                         attn_impl="ulysses")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4,
+                               rtol=1e-3)
