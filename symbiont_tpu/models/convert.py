@@ -222,3 +222,54 @@ def load_bert_model(model_dir: str | Path, with_pooler: bool = False):
     cfg = BertConfig.from_hf(hf_cfg)
     params = convert_bert(load_state_dict(model_dir), cfg, with_pooler=with_pooler)
     return params, cfg
+
+
+def main(argv=None) -> None:
+    """CLI: convert a local HF checkpoint and cache the JAX pytree.
+
+        python -m symbiont_tpu.models.convert <hf_model_dir> [--out DIR]
+               [--kind auto|bert|gpt] [--pooler]
+
+    With --out, the converted params land in a mmap-friendly checkpoint dir
+    (symbiont_tpu.train.checkpoint format) so engine restarts skip
+    reconversion (SURVEY.md §5.4 plan — the reference re-downloads and
+    re-converts on every boot, embedding_generator.rs:25-58). Without --out,
+    it's a dry run that validates the layout and prints the geometry."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m symbiont_tpu.models.convert", description=main.__doc__)
+    ap.add_argument("model_dir", help="local HF model dir (safetensors/.bin + config.json)")
+    ap.add_argument("--out", help="checkpoint dir to write converted params to")
+    ap.add_argument("--kind", choices=["auto", "bert", "gpt"], default="auto")
+    ap.add_argument("--pooler", action="store_true",
+                    help="include pooler+classifier head (cross-encoders)")
+    args = ap.parse_args(argv)
+
+    hf_cfg = load_hf_config(args.model_dir)
+    kind = args.kind
+    if kind == "auto":
+        kind = "gpt" if hf_cfg.get("model_type") in ("gpt2", "llama", "mistral") else "bert"
+    if kind == "gpt":
+        params, cfg = load_gpt_model(args.model_dir)
+    else:
+        params, cfg = load_bert_model(args.model_dir, with_pooler=args.pooler)
+    import jax
+
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    print(f"{kind}: {type(cfg).__name__} hidden={cfg.hidden_size} "
+          f"layers={cfg.num_layers} heads={cfg.num_heads} — "
+          f"{n_params / 1e6:.1f}M params converted OK")
+    if args.out:
+        import dataclasses
+
+        from symbiont_tpu.train.checkpoint import save_params
+
+        save_params(args.out, params,
+                    meta={"kind": kind, "config": dataclasses.asdict(cfg),
+                          "source": str(args.model_dir)})
+        print(f"saved checkpoint to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

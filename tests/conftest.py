@@ -29,3 +29,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tmp_data_dir(tmp_path):
     return tmp_path
+
+
+# Native build selection shared by the broker/worker test modules.
+# SYMBIONT_NATIVE_BUILD=build-tsan SYMBIONT_NATIVE_MAKE_TARGET=tsan runs them
+# against ThreadSanitizer builds (see native/Makefile).
+from pathlib import Path as _Path  # noqa: E402
+
+_REPO = _Path(__file__).resolve().parent.parent
+NATIVE_MAKE_TARGET = os.environ.get("SYMBIONT_NATIVE_MAKE_TARGET", "all")
+
+
+def native_bin(name: str) -> str:
+    build = os.environ.get("SYMBIONT_NATIVE_BUILD", "build")
+    return str(_REPO / "native" / build / name)

@@ -176,3 +176,27 @@ def test_padding_invariance():
     mask_l[0, :5] = 1
     long = embed_sentences(params, jnp.asarray(ids_l), jnp.asarray(mask_l), cfg)
     np.testing.assert_allclose(np.asarray(short), np.asarray(long), atol=1e-5)
+
+
+def test_convert_cli_roundtrip(tmp_path, torch_bert, capsys):
+    """python -m symbiont_tpu.models.convert: HF dir → cached checkpoint →
+    reload gives the same params the direct loader produces."""
+    model, hf_cfg = torch_bert
+    hf_dir = tmp_path / "hf"
+    model.save_pretrained(hf_dir)
+
+    from symbiont_tpu.models import convert as convert_mod
+    from symbiont_tpu.train.checkpoint import load_params
+
+    out = tmp_path / "ckpt"
+    convert_mod.main([str(hf_dir), "--out", str(out)])
+    assert "converted OK" in capsys.readouterr().out
+
+    cached, meta = load_params(out)
+    assert meta["kind"] == "bert"
+    direct, cfg = convert_mod.load_bert_model(hf_dir)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(direct), jax.tree.leaves(cached)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert meta["config"]["hidden_size"] == cfg.hidden_size

@@ -15,11 +15,14 @@ import asyncio
 import json
 import shutil
 import socket
+import os
 import subprocess
 import time
 from pathlib import Path
 
 import pytest
+
+from tests.conftest import NATIVE_MAKE_TARGET, native_bin
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -33,9 +36,10 @@ def _free_port() -> int:
 
 
 def _start_broker(port: int, data_dir=None):
-    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
+    subprocess.run(["make", "-C", str(REPO / "native"), NATIVE_MAKE_TARGET],
+                   check=True,
                    capture_output=True)
-    args = [str(REPO / "native" / "build" / "symbus_broker"),
+    args = [native_bin("symbus_broker"),
             "--port", str(port), "--host", "127.0.0.1"]
     if data_dir:
         args += ["--data-dir", str(data_dir)]

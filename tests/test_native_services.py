@@ -39,13 +39,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+from tests.conftest import NATIVE_MAKE_TARGET, native_bin
+
+
 @pytest.fixture(scope="module")
 def broker():
-    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
-                   capture_output=True)
+    subprocess.run(["make", "-C", str(REPO / "native"), NATIVE_MAKE_TARGET],
+                   check=True, capture_output=True)
     port = _free_port()
     proc = subprocess.Popen(
-        [str(REPO / "native" / "build" / "symbus_broker"), "--port", str(port),
+        [native_bin("symbus_broker"), "--port", str(port),
          "--host", "127.0.0.1"], stderr=subprocess.PIPE)
     for _ in range(100):
         try:
@@ -65,8 +68,7 @@ def spawn_worker(name: str, port: int, extra_env: dict | None = None):
     env = dict(os.environ,
                SYMBIONT_BUS_URL=f"symbus://127.0.0.1:{port}",
                **(extra_env or {}))
-    proc = subprocess.Popen([str(REPO / "native" / "build" / name)],
-                            env=env, stderr=subprocess.PIPE)
+    proc = subprocess.Popen([native_bin(name)], env=env, stderr=subprocess.PIPE)
     return proc
 
 
@@ -490,9 +492,15 @@ def test_native_api_gateway_full_stack(broker):
                                            {"task_id": "sse-1", "prompt": None,
                                             "max_length": 6})
                 assert status == 200 and body["task_id"] == "sse-1"
-                frame = await asyncio.wait_for(sse_reader.readuntil(b"\n\n"), 15)
-                data_lines = [ln[6:] for ln in frame.decode().splitlines()
-                              if ln.startswith("data: ")]
+                async def next_data_frame():
+                    # skip keep-alive comment frames (": keep-alive")
+                    while True:
+                        frame = await sse_reader.readuntil(b"\n\n")
+                        lines = [ln[6:] for ln in frame.decode().splitlines()
+                                 if ln.startswith("data: ")]
+                        if lines:
+                            return lines
+                data_lines = await asyncio.wait_for(next_data_frame(), 20)
                 event = json.loads("\n".join(data_lines))
                 assert event["original_task_id"] == "sse-1"
                 assert event["generated_text"]

@@ -4,11 +4,14 @@ exercised against the real C++ broker over a real socket."""
 import asyncio
 import shutil
 import socket
+import os
 import subprocess
 import time
 from pathlib import Path
 
 import pytest
+
+from tests.conftest import NATIVE_MAKE_TARGET, native_bin
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -23,11 +26,12 @@ def _free_port() -> int:
 
 @pytest.fixture(scope="module")
 def broker():
-    subprocess.run(["make", "-C", str(REPO / "native")], check=True,
+    subprocess.run(["make", "-C", str(REPO / "native"), NATIVE_MAKE_TARGET],
+                   check=True,
                    capture_output=True)
     port = _free_port()
     proc = subprocess.Popen(
-        [str(REPO / "native" / "build" / "symbus_broker"), "--port", str(port),
+        [native_bin("symbus_broker"), "--port", str(port),
          "--host", "127.0.0.1"],
         stderr=subprocess.PIPE)
     # wait for listen
