@@ -115,6 +115,14 @@ class VectorStoreConfig:
 @dataclass
 class GraphStoreConfig:
     data_dir: str = "data/graph_store"
+    # External Neo4j backend (reference-migration deployments): set uri to
+    # the Neo4j HTTP API endpoint (http://host:7474) and the runner swaps in
+    # the Neo4j adapter; the embedded sqlite store is the default.
+    # Reference env aliases NEO4J_URI/USER/PASSWORD map here.
+    uri: Optional[str] = None
+    user: str = "neo4j"
+    password: str = "password"
+    database: str = "neo4j"
 
 
 @dataclass
@@ -179,6 +187,9 @@ class SymbiontConfig:
 _ENV_ALIASES = {
     "NATS_URL": ("bus", "url"),
     "QDRANT_URI": ("vector_store", "uri"),
+    "NEO4J_URI": ("graph_store", "uri"),
+    "NEO4J_USER": ("graph_store", "user"),
+    "NEO4J_PASSWORD": ("graph_store", "password"),
     "API_SERVER_HOST": ("api", "host"),
     "API_SERVER_PORT": ("api", "port"),
     "FORCE_CPU": ("engine", "force_cpu"),

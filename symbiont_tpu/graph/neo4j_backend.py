@@ -1,0 +1,170 @@
+"""Optional external-Neo4j backend for the knowledge-graph surface.
+
+The framework's default graph store is the embedded sqlite one
+(graph/store.py). Deployments migrating from the reference, which writes to
+a real Neo4j over Bolt (reference: docker-compose.yml:2-14;
+services/knowledge_graph_service/src/main.rs), can keep their graph: set
+`graph_store.uri` (or the reference's NEO4J_URI/USER/PASSWORD env aliases)
+to the Neo4j **HTTP API** endpoint (http://host:7474) and the runner swaps
+this adapter in.
+
+Write parity with the reference's save_to_neo4j (main.rs:23-140), issued as
+ONE transactional HTTP request (`/db/{db}/tx/commit`) to match its
+single-explicit-transaction behavior (main.rs:32-134):
+
+- MERGE (d:Document {original_id}) ON CREATE/ON MATCH SET source_url,
+  processed_at_ms (main.rs:37-63);
+- per non-empty sentence: MERGE (s:Sentence {text}), MERGE
+  (d)-[:HAS_SENTENCE {order}]->(s) (main.rs:70-93);
+- per non-empty token: MERGE (t:Token {text_lc}), SET
+  text_original_case, MERGE (d)-[:CONTAINS_TOKEN]->(t) (main.rs:100-125);
+- ensure_schema creates the unique constraint + text_lc index with the
+  reference's 5×3s retry (main.rs:158-173,253-284).
+
+Speaks stdlib urllib with basic auth — no neo4j driver dependency.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from symbiont_tpu.config import GraphStoreConfig
+from symbiont_tpu.schema import TokenizedTextMessage
+
+log = logging.getLogger(__name__)
+
+
+class Neo4jGraphStore:
+    def __init__(self, config: GraphStoreConfig,
+                 retries: int = 5, retry_delay_s: float = 3.0):
+        if not config.uri:
+            raise ValueError("Neo4jGraphStore requires graph_store.uri")
+        if not config.uri.startswith(("http://", "https://")):
+            # the reference's compose uses bolt://host:7687; this adapter
+            # speaks the HTTP API — fail fast with the fix, not a retry loop
+            raise ValueError(
+                f"graph_store.uri must be the Neo4j HTTP endpoint "
+                f"(http://host:7474), not {config.uri!r} — the bolt:// "
+                f"protocol is not supported")
+        self.config = config
+        self.base = config.uri.rstrip("/")
+        self._auth = base64.b64encode(
+            f"{config.user}:{config.password}".encode()).decode()
+        self._retries = retries
+        self._retry_delay_s = retry_delay_s
+
+    # ------------------------------------------------------------------ http
+
+    def _commit(self, statements: List[Tuple[str, dict]],
+                timeout: float = 30.0) -> List[dict]:
+        body = {"statements": [{"statement": s, "parameters": p}
+                               for s, p in statements]}
+        req = urllib.request.Request(
+            f"{self.base}/db/{self.config.database}/tx/commit",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Basic {self._auth}"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            out = json.loads(r.read())
+        if out.get("errors"):
+            raise RuntimeError(f"neo4j error: {out['errors']}")
+        return out.get("results", [])
+
+    # --------------------------------------------------------------- surface
+
+    def ensure_schema(self) -> None:
+        """Unique Document.original_id + Token.text_lc index, retried
+        (reference: ensure_schema_internal + retry task, main.rs:158-173,
+        253-284)."""
+        stmts = [
+            ("CREATE CONSTRAINT symbiont_doc_id IF NOT EXISTS "
+             "FOR (d:Document) REQUIRE d.original_id IS UNIQUE", {}),
+            ("CREATE INDEX symbiont_token_lc IF NOT EXISTS "
+             "FOR (t:Token) ON (t.text_lc)", {}),
+        ]
+        last: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                for s in stmts:
+                    self._commit([s])
+                log.info("neo4j schema ensured at %s", self.base)
+                return
+            except Exception as e:
+                last = e
+                log.warning("neo4j not ready (attempt %d/%d): %s",
+                            attempt + 1, self._retries, e)
+                time.sleep(self._retry_delay_s)
+        raise ConnectionError(f"neo4j unreachable at {self.base}: {last}")
+
+    def save_tokenized(self, msg: TokenizedTextMessage) -> int:
+        """One transactional commit per document (main.rs:32-134). Returns
+        the Document node's internal id."""
+        stmts: List[Tuple[str, dict]] = [(
+            "MERGE (d:Document {original_id: $original_id}) "
+            "ON CREATE SET d.source_url = $source_url, "
+            "d.processed_at_ms = $ts "
+            "ON MATCH SET d.source_url = $source_url, "
+            "d.processed_at_ms = $ts "
+            "RETURN id(d)",
+            {"original_id": msg.original_id, "source_url": msg.source_url,
+             "ts": msg.timestamp_ms})]
+        for order, sentence in enumerate(msg.sentences):
+            if not sentence.strip():
+                continue  # reference: main.rs:71-77
+            stmts.append((
+                # order inside the MERGE pattern (reference main.rs:82-88):
+                # the same sentence text at two positions keeps two edges
+                "MATCH (d:Document {original_id: $original_id}) "
+                "MERGE (s:Sentence {text: $text}) "
+                "MERGE (d)-[r:HAS_SENTENCE {order: $order}]->(s)",
+                {"original_id": msg.original_id, "text": sentence,
+                 "order": order}))
+        for token in msg.tokens:
+            token = token.strip()
+            if not token:
+                continue  # reference: main.rs:103-109
+            stmts.append((
+                "MATCH (d:Document {original_id: $original_id}) "
+                "MERGE (t:Token {text_lc: $lc}) "
+                "SET t.text_original_case = $orig "
+                "MERGE (d)-[:CONTAINS_TOKEN]->(t)",
+                {"original_id": msg.original_id, "lc": token.lower(),
+                 "orig": token}))
+        results = self._commit(stmts)
+        try:
+            return int(results[0]["data"][0]["row"][0])
+        except (IndexError, KeyError, TypeError, ValueError):
+            return -1
+
+    def counts(self) -> Dict[str, int]:
+        rows = self._commit([
+            ("MATCH (d:Document) RETURN count(d)", {}),
+            ("MATCH (s:Sentence) RETURN count(s)", {}),
+            ("MATCH (t:Token) RETURN count(t)", {}),
+        ])
+
+        def first(i):
+            try:
+                return int(rows[i]["data"][0]["row"][0])
+            except (IndexError, KeyError, TypeError, ValueError):
+                return 0
+
+        return {"Document": first(0), "Sentence": first(1), "Token": first(2)}
+
+    def close(self) -> None:  # HTTP is stateless
+        pass
+
+
+def make_graph_store(config: GraphStoreConfig):
+    """Backend selection: uri set → external Neo4j; else embedded sqlite."""
+    if config.uri:
+        return Neo4jGraphStore(config)
+    from symbiont_tpu.graph.store import GraphStore
+
+    return GraphStore(config)

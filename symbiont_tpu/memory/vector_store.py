@@ -43,6 +43,8 @@ class SearchHit:
 
 
 class VectorStore:
+    supports_fused = True  # corpus is device-resident → fused embed+top-k
+
     def __init__(self, config: Optional[VectorStoreConfig] = None, mesh=None):
         self.config = config or VectorStoreConfig()
         self.mesh = mesh

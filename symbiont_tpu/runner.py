@@ -20,8 +20,6 @@ from symbiont_tpu import subjects
 from symbiont_tpu.bus import connect
 from symbiont_tpu.config import SymbiontConfig, load_config
 from symbiont_tpu.engine.engine import TpuEngine
-from symbiont_tpu.graph.store import GraphStore
-from symbiont_tpu.memory.vector_store import VectorStore
 from symbiont_tpu.services.api import ApiService
 from symbiont_tpu.services.knowledge_graph import KnowledgeGraphService
 from symbiont_tpu.services.perception import PerceptionService
@@ -102,9 +100,22 @@ class SymbiontStack:
             elif self.engine is None:
                 log.warning("vector store dim=%d taken from config "
                             "(no in-process engine to follow)", vs_cfg.dim)
-            self.vector_store = VectorStore(vs_cfg, mesh=self._mesh)
+            # uri set (or reference QDRANT_URI alias) → external Qdrant
+            # backend; else the embedded TPU-native store
+            from symbiont_tpu.memory.qdrant_backend import make_vector_store
+
+            self.vector_store = make_vector_store(vs_cfg, mesh=self._mesh)
+            if not on("vector_memory"):
+                # engine-only deployment: VectorMemoryService isn't there to
+                # run the startup ensure, so do it here (idempotent)
+                self.vector_store.ensure_collection()
         if on("knowledge_graph") or on("engine"):
-            self.graph_store = GraphStore(cfg.graph_store)
+            # uri set (or reference NEO4J_URI alias) → external Neo4j backend
+            from symbiont_tpu.graph.neo4j_backend import make_graph_store
+
+            self.graph_store = make_graph_store(cfg.graph_store)
+            if not on("knowledge_graph"):
+                self.graph_store.ensure_schema()  # engine-only: see above
 
         lm_generate = None
         if cfg.lm.enabled and (on("text_generator") or on("engine")):

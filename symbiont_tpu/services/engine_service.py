@@ -65,7 +65,8 @@ class EngineService(Service):
         if self.batcher:
             await self.batcher.start()
         await super().start()
-        if self.engine is not None and self.vector_store is not None:
+        if (self.engine is not None and self.vector_store is not None
+                and getattr(self.vector_store, "supports_fused", False)):
             # background-compile the fused query executables for the store's
             # current capacity across the query length buckets (works for an
             # empty store too — capacity is the first block), so interactive
@@ -104,9 +105,10 @@ class EngineService(Service):
         if self.vector_store is not None:
             await sub(subjects.ENGINE_VECTOR_UPSERT, self._vec_upsert, queue=q)
             await sub(subjects.ENGINE_VECTOR_SEARCH, self._vec_search, queue=q)
-        if self.engine is not None and self.vector_store is not None:
-            # fused embed+top-k — only meaningful when this process holds
-            # both the model and the corpus
+        if (self.engine is not None and self.vector_store is not None
+                and getattr(self.vector_store, "supports_fused", False)):
+            # fused embed+top-k — only when this process holds both the model
+            # and a device-resident corpus (external Qdrant backends don't)
             await sub(subjects.ENGINE_QUERY_SEARCH, self._query_search, queue=q)
         if self.graph_store is not None:
             await sub(subjects.ENGINE_GRAPH_SAVE, self._graph_save, queue=q)
@@ -242,6 +244,8 @@ class EngineService(Service):
                 out["model_name"] = self.engine.config.model_name
                 out["stats"] = dict(self.engine.stats)
             if self.vector_store is not None:
-                out["vector_count"] = self.vector_store.count()
+                # executor: an external-Qdrant count is a blocking HTTP call
+                out["vector_count"] = await self._run_blocking(
+                    self.vector_store.count)
             return out
         await self._handle(msg, "health", op)

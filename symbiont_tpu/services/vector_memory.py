@@ -10,6 +10,7 @@ Parity with reference: services/vector_memory_service/src/main.rs:
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import logging
 from typing import Optional
@@ -73,7 +74,10 @@ class VectorMemoryService(Service):
             points.append((deterministic_point_id(m.original_id, order),
                            se.embedding, dataclasses.asdict(payload)))
         with span("vector_memory.upsert", msg.headers, points=len(points)):
-            n = self.store.upsert(points)
+            # executor: with an external-Qdrant backend this is a blocking
+            # HTTP call; it must not stall the event loop
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, self.store.upsert, points)
         metrics.inc("vector_memory.points_upserted", n)
 
     async def _handle_search(self, msg: Msg) -> None:
@@ -89,7 +93,8 @@ class VectorMemoryService(Service):
             return
         try:
             with span("vector_memory.search", msg.headers, top_k=task.top_k):
-                hits = self.store.search(task.query_embedding, task.top_k)
+                hits = await asyncio.get_running_loop().run_in_executor(
+                    None, self.store.search, task.query_embedding, task.top_k)
             results = [
                 SemanticSearchResultItem(
                     qdrant_point_id=h.id, score=h.score,

@@ -1,0 +1,156 @@
+"""External-Neo4j backend: HTTP tx/commit adapter against a fake endpoint.
+
+The fake records every Cypher statement + parameters and answers the
+RETURN id(d) row, so the adapter's write parity with the reference's
+save_to_neo4j (single transaction, MERGE semantics, skip-empty rules —
+reference: services/knowledge_graph_service/src/main.rs:23-140) is asserted
+statement-by-statement without a Neo4j server.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from symbiont_tpu.config import GraphStoreConfig
+from symbiont_tpu.graph.neo4j_backend import Neo4jGraphStore, make_graph_store
+from symbiont_tpu.graph.store import GraphStore
+from symbiont_tpu.schema import TokenizedTextMessage
+
+
+class _FakeNeo4j(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        body = json.loads(self.rfile.read(n))
+        state = self.server.state
+        state["auth"].append(self.headers.get("Authorization"))
+        state["paths"].append(self.path)
+        results = []
+        for st in body["statements"]:
+            state["statements"].append((st["statement"], st.get("parameters", {})))
+            if "RETURN id(d)" in st["statement"]:
+                results.append({"columns": ["id(d)"], "data": [{"row": [42]}]})
+            elif "RETURN count" in st["statement"]:
+                results.append({"columns": ["count"], "data": [{"row": [7]}]})
+            else:
+                results.append({"columns": [], "data": []})
+        out = json.dumps({"results": results, "errors": []}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+@pytest.fixture()
+def fake_neo4j():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeNeo4j)
+    srv.state = {"statements": [], "auth": [], "paths": []}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv.state
+    srv.shutdown()
+
+
+def _msg():
+    return TokenizedTextMessage(
+        original_id="doc-1", source_url="http://src",
+        sentences=["First sentence.", "  ", "Second one."],
+        tokens=["Alpha", "beta", " ", "ALPHA"],
+        timestamp_ms=1718000000000)
+
+
+def test_save_tokenized_statement_parity(fake_neo4j):
+    uri, state = fake_neo4j
+    store = Neo4jGraphStore(GraphStoreConfig(uri=uri, user="u", password="p"),
+                            retries=1, retry_delay_s=0.01)
+    store.ensure_schema()
+    doc_id = store.save_tokenized(_msg())
+    assert doc_id == 42
+
+    stmts = state["statements"]
+    # schema: constraint + index (main.rs:158-173)
+    assert "REQUIRE d.original_id IS UNIQUE" in stmts[0][0]
+    assert "ON (t.text_lc)" in stmts[1][0]
+    # document MERGE with upsert of source_url/timestamp (main.rs:37-63)
+    doc_stmt, doc_params = stmts[2]
+    assert doc_stmt.startswith("MERGE (d:Document")
+    assert "ON CREATE SET" in doc_stmt and "ON MATCH SET" in doc_stmt
+    assert doc_params == {"original_id": "doc-1", "source_url": "http://src",
+                          "ts": 1718000000000}
+    # sentences: blank skipped (main.rs:71-77), order carried on the edge
+    sent = [s for s in stmts if "HAS_SENTENCE" in s[0]]
+    assert [p["text"] for _, p in sent] == ["First sentence.", "Second one."]
+    assert [p["order"] for _, p in sent] == [0, 2]
+    # tokens: blank skipped, lowercase merge key + original case stored
+    # (main.rs:100-125); both casings of "alpha" hit the same key
+    tok = [s for s in stmts if "CONTAINS_TOKEN" in s[0]]
+    assert [p["lc"] for _, p in tok] == ["alpha", "beta", "alpha"]
+    assert [p["orig"] for _, p in tok] == ["Alpha", "beta", "ALPHA"]
+    # one transactional commit for the whole document (main.rs:32-134):
+    # schema used two commits, the save exactly one more
+    assert len(state["paths"]) == 3
+    assert state["paths"][-1].endswith("/db/neo4j/tx/commit")
+    # basic auth carried
+    assert state["auth"][-1].startswith("Basic ")
+
+    assert store.counts() == {"Document": 7, "Sentence": 7, "Token": 7}
+    store.close()
+
+
+def test_connect_retry_then_fail():
+    store = Neo4jGraphStore(GraphStoreConfig(uri="http://127.0.0.1:1"),
+                            retries=2, retry_delay_s=0.01)
+    with pytest.raises(ConnectionError, match="unreachable"):
+        store.ensure_schema()
+
+
+def test_backend_selection(tmp_path):
+    embedded = make_graph_store(GraphStoreConfig(data_dir=str(tmp_path)))
+    assert isinstance(embedded, GraphStore)
+    embedded.close()
+    assert isinstance(
+        make_graph_store(GraphStoreConfig(uri="http://127.0.0.1:1")),
+        Neo4jGraphStore)
+
+
+def test_stack_env_aliases(fake_neo4j, tmp_path, monkeypatch):
+    """Reference .env drop-in: NEO4J_URI/USER/PASSWORD select and configure
+    the external backend through config loading (reference: .env.example)."""
+    from symbiont_tpu.config import load_config
+
+    uri, _ = fake_neo4j
+    monkeypatch.setenv("NEO4J_URI", uri)
+    monkeypatch.setenv("NEO4J_USER", "svc")
+    monkeypatch.setenv("NEO4J_PASSWORD", "secret")
+    cfg = load_config()
+    assert cfg.graph_store.uri == uri
+    store = make_graph_store(cfg.graph_store)
+    assert isinstance(store, Neo4jGraphStore)
+    assert store._auth  # credentials from env made it into the adapter
+
+
+def test_bolt_uri_fails_fast():
+    """The reference's .env carries bolt://host:7687; the adapter speaks the
+    HTTP API and must say so immediately, not retry into a timeout."""
+    with pytest.raises(ValueError, match="bolt"):
+        Neo4jGraphStore(GraphStoreConfig(uri="bolt://neo4j:7687"))
+
+
+def test_repeated_sentence_keeps_both_orders(fake_neo4j):
+    uri, state = fake_neo4j
+    store = Neo4jGraphStore(GraphStoreConfig(uri=uri), retries=1,
+                            retry_delay_s=0.01)
+    msg = TokenizedTextMessage(original_id="d", source_url="u",
+                               sentences=["Same.", "Other.", "Same."],
+                               tokens=[], timestamp_ms=1)
+    store.save_tokenized(msg)
+    sent = [s for s in state["statements"] if "HAS_SENTENCE" in s[0]]
+    # order lives INSIDE the MERGE pattern → duplicate text at a new
+    # position creates a second edge instead of overwriting the first
+    assert all("{order: $order}" in s for s, _ in sent)
+    assert [p["order"] for _, p in sent] == [0, 1, 2]

@@ -1,0 +1,205 @@
+"""External-Qdrant backend: REST adapter against a faithful fake server.
+
+The fake implements the four REST endpoints the adapter uses (ensure,
+upsert?wait=true, search, count) with real cosine scoring, so the adapter's
+request/response handling is exercised end-to-end — including through the
+full service stack — without a Qdrant binary (offline test tier, SURVEY.md
+§4 item 3's fake-backend strategy).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from symbiont_tpu.config import VectorStoreConfig
+from symbiont_tpu.memory.qdrant_backend import QdrantStore, make_vector_store
+from symbiont_tpu.memory.vector_store import VectorStore
+
+
+class _FakeQdrant(BaseHTTPRequestHandler):
+    store = None  # set per-instance on the server
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_PUT(self):
+        s = self.server.fake_store
+        path = self.path.split("?")[0]
+        parts = path.strip("/").split("/")
+        if len(parts) == 2 and parts[0] == "collections":
+            if parts[1] in s["collections"]:
+                self._reply(409, {"status": {"error": "already exists"}})
+                return
+            cfg = self._body()
+            s["collections"][parts[1]] = {
+                "dim": cfg["vectors"]["size"], "points": {}}
+            self._reply(200, {"result": True, "status": "ok"})
+            return
+        if len(parts) == 3 and parts[2] == "points":
+            col = s["collections"][parts[1]]
+            for p in self._body()["points"]:
+                vec = np.asarray(p["vector"], np.float32)
+                assert vec.shape == (col["dim"],)
+                col["points"][str(p["id"])] = (vec, p.get("payload") or {})
+            self._reply(200, {"result": {"status": "completed"}})
+            return
+        self._reply(404, {"status": {"error": "not found"}})
+
+    def do_GET(self):
+        s = self.server.fake_store
+        parts = self.path.strip("/").split("/")
+        col = s["collections"].get(parts[1]) if len(parts) == 2 else None
+        if col is None:
+            self._reply(404, {"status": {"error": "no collection"}})
+            return
+        self._reply(200, {"result": {"config": {"params": {
+            "vectors": {"size": col["dim"], "distance": "Cosine"}}}}})
+
+    def do_POST(self):
+        s = self.server.fake_store
+        parts = self.path.strip("/").split("/")
+        col = s["collections"].get(parts[1])
+        if col is None:
+            self._reply(404, {"status": {"error": "no collection"}})
+            return
+        if parts[-1] == "search":
+            req = self._body()
+            q = np.asarray(req["vector"], np.float32)
+            q = q / max(float(np.linalg.norm(q)), 1e-12)
+            hits = []
+            for pid, (vec, payload) in col["points"].items():
+                v = vec / max(float(np.linalg.norm(vec)), 1e-12)
+                hits.append({"id": pid, "score": float(q @ v),
+                             "payload": payload if req.get("with_payload") else None})
+            hits.sort(key=lambda h: -h["score"])
+            self._reply(200, {"result": hits[: req["limit"]]})
+            return
+        if parts[-1] == "count":
+            self._reply(200, {"result": {"count": len(col["points"])}})
+            return
+        self._reply(404, {"status": {"error": "not found"}})
+
+
+@pytest.fixture()
+def fake_qdrant():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeQdrant)
+    srv.fake_store = {"collections": {}}
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", srv.fake_store
+    srv.shutdown()
+
+
+def _cfg(uri, dim=8):
+    return VectorStoreConfig(uri=uri, dim=dim, collection="symbiont_test")
+
+
+def test_ensure_upsert_search_count(fake_qdrant):
+    uri, state = fake_qdrant
+    store = QdrantStore(_cfg(uri), retries=2, retry_delay_s=0.05)
+    store.ensure_collection()
+    store.ensure_collection()  # idempotent (409 swallowed)
+    assert state["collections"]["symbiont_test"]["dim"] == 8
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(5, 8)).astype(np.float32)
+    n = store.upsert([(f"p{i}", vecs[i], {"sentence_text": f"s{i}", "i": i})
+                      for i in range(5)])
+    assert n == 5 and store.count() == 5
+
+    hits = store.search(vecs[3], 2)
+    assert hits[0].id == "p3"  # self-match wins under cosine
+    assert hits[0].payload["sentence_text"] == "s3"
+    assert len(hits) == 2
+    assert store.search(vecs[0], 0) == []
+
+
+def test_connect_retry_then_fail():
+    store = QdrantStore(_cfg("http://127.0.0.1:1"), retries=2,
+                        retry_delay_s=0.01)
+    with pytest.raises(ConnectionError, match="unreachable"):
+        store.ensure_collection()
+
+
+def test_backend_selection():
+    assert isinstance(make_vector_store(_cfg(None)), VectorStore)
+    assert isinstance(make_vector_store(_cfg("http://127.0.0.1:1")), QdrantStore)
+
+
+def test_full_stack_over_external_qdrant(fake_qdrant, tmp_path):
+    """The complete pipeline (ingest → embed → upsert → 2-hop search) with
+    vector memory backed by the external Qdrant instead of the embedded
+    store — the reference-migration deployment (QDRANT_URI)."""
+    import asyncio
+
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (
+        ApiConfig,
+        EngineConfig,
+        GraphStoreConfig,
+        SymbiontConfig,
+    )
+    from symbiont_tpu.runner import SymbiontStack
+    from tests.test_e2e_pipeline import _fake_fetcher, _http, _wait_until
+
+    uri, _ = fake_qdrant
+    cfg = SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
+                            batch_buckets=[2, 8], max_batch=8, dtype="float32",
+                            data_parallel=False, flush_deadline_ms=2.0),
+        vector_store=_cfg(uri, dim=32),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        # external corpus → no fused subject served; skip the probe
+        api=ApiConfig(host="127.0.0.1", port=0, fused_search=False),
+    )
+
+    async def scenario():
+        stack = SymbiontStack(cfg, bus=InprocBus(), fetcher=_fake_fetcher)
+        await stack.start()
+        try:
+            assert isinstance(stack.vector_store, QdrantStore)
+            loop = asyncio.get_running_loop()
+            status, _ = await loop.run_in_executor(None, lambda: _http(
+                "POST", stack.api.port, "/api/submit-url",
+                {"url": "http://example.com/doc1"}))
+            assert status == 200
+            ok = await _wait_until(lambda: stack.vector_store.count() >= 3)
+            assert ok, f"pipeline stalled; count={stack.vector_store.count()}"
+            status, body = await loop.run_in_executor(None, lambda: _http(
+                "POST", stack.api.port, "/api/search/semantic",
+                {"query_text": "matrix multiplication", "top_k": 2}))
+            assert status == 200, body
+            assert len(body["results"]) == 2
+            assert body["results"][0]["payload"]["sentence_text"]
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
+
+
+def test_dim_mismatch_fails_fast(fake_qdrant):
+    uri, _ = fake_qdrant
+    QdrantStore(_cfg(uri, dim=8), retries=1, retry_delay_s=0.01).ensure_collection()
+    store16 = QdrantStore(_cfg(uri, dim=16), retries=1, retry_delay_s=0.01)
+    with pytest.raises(ValueError, match="dim=8"):
+        store16.ensure_collection()
+
+
+def test_non_http_uri_rejected():
+    with pytest.raises(ValueError, match="REST endpoint"):
+        QdrantStore(_cfg("grpc://host:6334"))
