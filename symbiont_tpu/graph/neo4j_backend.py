@@ -29,12 +29,12 @@ from __future__ import annotations
 import base64
 import json
 import logging
-import time
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from symbiont_tpu.config import GraphStoreConfig
 from symbiont_tpu.schema import TokenizedTextMessage
+from symbiont_tpu.utils.retry import connect_retry
 
 log = logging.getLogger(__name__)
 
@@ -88,19 +88,15 @@ class Neo4jGraphStore:
             ("CREATE INDEX symbiont_token_lc IF NOT EXISTS "
              "FOR (t:Token) ON (t.text_lc)", {}),
         ]
-        last: Optional[Exception] = None
-        for attempt in range(self._retries):
-            try:
-                for s in stmts:
-                    self._commit([s])
-                log.info("neo4j schema ensured at %s", self.base)
-                return
-            except Exception as e:
-                last = e
-                log.warning("neo4j not ready (attempt %d/%d): %s",
-                            attempt + 1, self._retries, e)
-                time.sleep(self._retry_delay_s)
-        raise ConnectionError(f"neo4j unreachable at {self.base}: {last}")
+
+        def attempt() -> None:
+            for s in stmts:
+                self._commit([s])
+            log.info("neo4j schema ensured at %s", self.base)
+
+        connect_retry(attempt, retries=self._retries,
+                      delay_s=self._retry_delay_s,
+                      what=f"neo4j at {self.base}")
 
     def save_tokenized(self, msg: TokenizedTextMessage) -> int:
         """One transactional commit per document (main.rs:32-134). Returns

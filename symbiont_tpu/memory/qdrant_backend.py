@@ -28,13 +28,13 @@ from __future__ import annotations
 
 import json
 import logging
-import time
 import urllib.error
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
 
 from symbiont_tpu.config import VectorStoreConfig
 from symbiont_tpu.memory.vector_store import SearchHit
+from symbiont_tpu.utils.retry import connect_retry
 
 log = logging.getLogger(__name__)
 
@@ -79,35 +79,30 @@ class QdrantStore:
             self.dim = dim
         body = {"vectors": {"size": self.dim, "distance": "Cosine"},
                 "on_disk_payload": True}
-        last: Optional[Exception] = None
-        for attempt in range(self._retries):
+
+        def attempt() -> None:
             try:
-                try:
-                    self._call("PUT", f"/collections/{self.collection}", body)
-                except urllib.error.HTTPError as e:
-                    if e.code != 409:  # 409 = already exists
-                        raise
-                    # existing collection: verify its dim matches instead of
-                    # failing later on every upsert (the embedded store's
-                    # fail-fast stance)
-                    info = self._call("GET", f"/collections/{self.collection}")
-                    have = (info.get("result", {}).get("config", {})
-                            .get("params", {}).get("vectors", {}).get("size"))
-                    if have is not None and int(have) != self.dim:
-                        raise ValueError(
-                            f"collection {self.collection!r} exists with "
-                            f"dim={have}, engine produces dim={self.dim}")
-                log.info("qdrant collection %r ready (dim=%d, cosine)",
-                         self.collection, self.dim)
-                return
-            except ValueError:
-                raise  # dim mismatch is a config error, not a connectivity one
-            except Exception as e:  # connect refused / 5xx — retry
-                last = e
-                log.warning("qdrant not ready (attempt %d/%d): %s",
-                            attempt + 1, self._retries, e)
-                time.sleep(self._retry_delay_s)
-        raise ConnectionError(f"qdrant unreachable at {self.base}: {last}")
+                self._call("PUT", f"/collections/{self.collection}", body)
+            except urllib.error.HTTPError as e:
+                if e.code != 409:  # 409 = already exists
+                    raise
+                # existing collection: verify its dim matches instead of
+                # failing later on every upsert (the embedded store's
+                # fail-fast stance)
+                info = self._call("GET", f"/collections/{self.collection}")
+                have = (info.get("result", {}).get("config", {})
+                        .get("params", {}).get("vectors", {}).get("size"))
+                if have is not None and int(have) != self.dim:
+                    raise ValueError(
+                        f"collection {self.collection!r} exists with "
+                        f"dim={have}, engine produces dim={self.dim}")
+            log.info("qdrant collection %r ready (dim=%d, cosine)",
+                     self.collection, self.dim)
+
+        # dim mismatch is a config error, not a connectivity one — no retry
+        connect_retry(attempt, retries=self._retries,
+                      delay_s=self._retry_delay_s,
+                      what=f"qdrant at {self.base}", fatal=(ValueError,))
 
     def upsert(self, points: Sequence[Tuple[str, Sequence[float], dict]]) -> int:
         if not points:

@@ -107,15 +107,18 @@ class SymbiontStack:
             self.vector_store = make_vector_store(vs_cfg, mesh=self._mesh)
             if not on("vector_memory"):
                 # engine-only deployment: VectorMemoryService isn't there to
-                # run the startup ensure, so do it here (idempotent)
-                self.vector_store.ensure_collection()
+                # run the startup ensure, so do it here (idempotent);
+                # executor because external backends block on HTTP retries
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.vector_store.ensure_collection)
         if on("knowledge_graph") or on("engine"):
             # uri set (or reference NEO4J_URI alias) → external Neo4j backend
             from symbiont_tpu.graph.neo4j_backend import make_graph_store
 
             self.graph_store = make_graph_store(cfg.graph_store)
             if not on("knowledge_graph"):
-                self.graph_store.ensure_schema()  # engine-only: see above
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.graph_store.ensure_schema)  # engine-only: see above
 
         lm_generate = None
         if cfg.lm.enabled and (on("text_generator") or on("engine")):

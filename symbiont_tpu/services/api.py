@@ -202,7 +202,14 @@ class ApiService:
                 k, _, v = h.decode("latin-1").partition(":")
                 headers[k.strip().lower()] = v.strip()
         body = b""
-        n = int(headers.get("content-length", 0) or 0)
+        try:
+            n = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            return None
+        # C++ twin parity (api_gateway.cpp): cap the client-supplied length —
+        # negative wraps and huge values would OOM the process
+        if n < 0 or n > 16 * 1024 * 1024:
+            return None
         if n:
             body = await reader.readexactly(n)
         return method, path.split("?")[0], headers, body

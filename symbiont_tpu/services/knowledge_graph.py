@@ -27,10 +27,13 @@ class KnowledgeGraphService(Service):
     def __init__(self, bus, store: GraphStore, durable_stream=None):
         super().__init__(bus)
         self.store = store
-        self.store.ensure_schema()  # retry-at-startup parity (main.rs:253-284)
         self.durable_stream = durable_stream
 
     async def _setup(self) -> None:
+        # retry-at-startup parity (main.rs:253-284), in an executor: with an
+        # external-Neo4j backend this is a blocking HTTP retry loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.store.ensure_schema)
         await self._subscribe_loop(subjects.DATA_PROCESSED_TEXT_TOKENIZED,
                                    self._handle_tokenized,
                                    queue=subjects.QUEUE_KNOWLEDGE_GRAPH,

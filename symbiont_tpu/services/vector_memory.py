@@ -43,10 +43,14 @@ class VectorMemoryService(Service):
     def __init__(self, bus, store: VectorStore, durable_stream=None):
         super().__init__(bus)
         self.store = store
-        self.store.ensure_collection()
         self.durable_stream = durable_stream
 
     async def _setup(self) -> None:
+        # startup ensure (reference: create/ensure collection, main.rs:24-119)
+        # in an executor: with an external-Qdrant backend this is a blocking
+        # HTTP retry loop that must not freeze the event loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.store.ensure_collection)
         await self._subscribe_loop(subjects.DATA_TEXT_WITH_EMBEDDINGS,
                                    self._handle_upsert,
                                    queue=subjects.QUEUE_VECTOR_MEMORY,

@@ -1,0 +1,37 @@
+"""Startup connect-retry shared by the external store adapters.
+
+Mirrors the reference's connect-at-startup retry loops (Qdrant 5×5s:
+reference vector_memory_service/src/main.rs:505-532; Neo4j 5×3s:
+knowledge_graph_service/src/main.rs:253-284): warn per attempt, sleep only
+BETWEEN attempts, raise ConnectionError with the last cause when exhausted.
+Exceptions listed in `fatal` (config errors like a dim mismatch) propagate
+immediately — retrying can't fix them.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def connect_retry(fn: Callable[[], T], *, retries: int, delay_s: float,
+                  what: str,
+                  fatal: Tuple[Type[BaseException], ...] = ()) -> T:
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except fatal:
+            raise
+        except Exception as e:
+            last = e
+            log.warning("%s not ready (attempt %d/%d): %s",
+                        what, attempt + 1, retries, e)
+            if attempt + 1 < retries:
+                time.sleep(delay_s)
+    raise ConnectionError(f"{what} unreachable: {last}")

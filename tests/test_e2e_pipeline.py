@@ -334,3 +334,28 @@ def test_scrape_failure_drops_silently(stack_config):
             await stack.stop()
 
     asyncio.run(scenario())
+
+
+def test_oversized_body_rejected(stack_config):
+    """C++ twin parity: content-length beyond the 16MB cap closes the
+    connection instead of buffering the body."""
+
+    async def scenario():
+        from symbiont_tpu.config import BusConfig
+        from symbiont_tpu.services.api import ApiService
+
+        api = ApiService(InprocBus(), ApiConfig(host="127.0.0.1", port=0),
+                         BusConfig())
+        await api.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+            writer.write(b"POST /api/submit-url HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Length: 999999999999\r\n\r\n")
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(100), 5)
+            assert got == b""  # connection closed, nothing buffered
+            writer.close()
+        finally:
+            await api.stop()
+
+    asyncio.run(scenario())

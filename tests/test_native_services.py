@@ -90,7 +90,7 @@ async def _tcp_bus(port):
     return bus
 
 
-async def _wait_ready(proc, pattern: bytes = b"ready", timeout: float = 10.0):
+async def _wait_ready(proc, pattern: bytes = b"ready", timeout: float = 30.0):
     """Wait for the worker's structured ready log line on stderr."""
     os.set_blocking(proc.stderr.fileno(), False)
     buf = b""
@@ -459,7 +459,7 @@ def test_native_api_gateway_full_stack(broker):
                 bus = await _tcp_bus(broker)
                 await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
                                   to_json_bytes(raw))
-                for _ in range(200):
+                for _ in range(600):
                     if store.count() >= 2:
                         break
                     await asyncio.sleep(0.1)
