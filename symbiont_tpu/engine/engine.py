@@ -233,7 +233,7 @@ class TpuEngine:
             return np.zeros((0, self.model_cfg.hidden_size), np.float32)
         max_len = min(self.config.length_buckets[-1],
                       self.model_cfg.max_position_embeddings)
-        encoded = [self.tokenizer.encode(t, max_len) for t in texts]
+        encoded = self.tokenizer.encode_batch(texts, max_len)
         lengths = [len(e) for e in encoded]
         buckets = [b for b in self.config.length_buckets
                    if b <= self.model_cfg.max_position_embeddings]

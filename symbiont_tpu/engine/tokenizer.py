@@ -31,6 +31,10 @@ class Tokenizer(Protocol):
         """Token ids incl. special tokens, truncated to max_len."""
         ...
 
+    def encode_batch(self, texts: Sequence[str], max_len: int) -> List[List[int]]:
+        """Batch encode — HF tokenizers parallelizes this in native code."""
+        ...
+
     def encode_pair(self, a: str, b: str, max_len: int) -> Tuple[List[int], List[int]]:
         """(ids, token_type_ids) for cross-encoder input, truncated to max_len."""
         ...
@@ -56,12 +60,21 @@ class HFTokenizer:
         self.sep_id = _tid("[SEP]", "</s>")
         self.pad_id = _tid("[PAD]", "<pad>")
 
-    def encode(self, text: str, max_len: int) -> List[int]:
-        ids = self._tok.encode(text).ids
+    def _truncate(self, ids: List[int], max_len: int) -> List[int]:
         # LongestFirst truncation parity: keep specials, trim the middle
         if len(ids) > max_len:
             ids = ids[: max_len - 1] + [self.sep_id]
         return ids
+
+    def encode(self, text: str, max_len: int) -> List[int]:
+        return self._truncate(self._tok.encode(text).ids, max_len)
+
+    def encode_batch(self, texts: Sequence[str], max_len: int) -> List[List[int]]:
+        """One call into the native tokenizer — it parallelizes across texts
+        (rayon), vs the serial per-text path the reference uses for whole
+        documents (embedding_generator.rs:160-164)."""
+        encs = self._tok.encode_batch(list(texts))
+        return [self._truncate(e.ids, max_len) for e in encs]
 
     def encode_pair(self, a: str, b: str, max_len: int) -> Tuple[List[int], List[int]]:
         enc = self._tok.encode(a, b)
@@ -96,6 +109,9 @@ class HashTokenizer:
         if len(ids) > max_len:
             ids = ids[: max_len - 1] + [self.sep_id]
         return ids
+
+    def encode_batch(self, texts: Sequence[str], max_len: int) -> List[List[int]]:
+        return [self.encode(t, max_len) for t in texts]
 
     def encode_pair(self, a: str, b: str, max_len: int) -> Tuple[List[int], List[int]]:
         a_ids = [self._id(w) for w in _WORD_RE.findall(a)]

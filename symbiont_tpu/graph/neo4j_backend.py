@@ -30,7 +30,7 @@ import base64
 import json
 import logging
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from symbiont_tpu.config import GraphStoreConfig
 from symbiont_tpu.schema import TokenizedTextMessage

@@ -74,3 +74,65 @@ def load_params(path: str | Path) -> tuple[Params, dict]:
 def exists(path: str | Path) -> bool:
     path = Path(path)
     return (path / "params.npz").exists() and (path / "tree.json").exists()
+
+
+def save_train_state(path: str | Path, state, meta: Optional[dict] = None) -> None:
+    """Full training-state checkpoint (params + optimizer state + step) for
+    resume — the §5.4 capability the reference has no training to need.
+    Optax states are arbitrary pytrees (NamedTuples inside), so leaves are
+    saved in jax.tree order and restored into a caller-built template of the
+    same structure (load_train_state)."""
+    import os
+
+    import jax
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    leaves = [np.asarray(l) for l in jax.tree.leaves(state)]
+    # atomic: write-then-replace, so a crash mid-save never destroys the
+    # previous good checkpoint (meta last — its presence implies a whole npz)
+    tmp = path / "train_state.npz.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **{f"leaf_{i}": l for i, l in enumerate(leaves)})
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path / "train_state.npz")
+    tmp_meta = path / "train_meta.json.tmp"
+    tmp_meta.write_text(json.dumps({
+        "n_leaves": len(leaves),
+        "shapes": [list(l.shape) for l in leaves],
+        "dtypes": [str(l.dtype) for l in leaves],
+        "meta": meta or {}}))
+    os.replace(tmp_meta, path / "train_meta.json")
+
+
+def load_train_state(path: str | Path, template):
+    """Restore a train state saved by save_train_state into `template`'s
+    structure (build it with the same make_*_train_state call). Returns
+    (state, meta)."""
+    import jax
+
+    path = Path(path)
+    spec = json.loads((path / "train_meta.json").read_text())
+    with np.load(path / "train_state.npz") as npz:
+        leaves = [npz[f"leaf_{i}"] for i in range(spec["n_leaves"])]
+    structure = jax.tree.structure(template)
+    if structure.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, template has "
+            f"{structure.num_leaves} — model/optimizer config mismatch")
+    # per-leaf shape check: equal leaf counts with different geometry must
+    # fail HERE with a clear error, not later as an XLA shape error
+    for i, (leaf, tmpl) in enumerate(zip(leaves, jax.tree.leaves(template))):
+        t_shape = tuple(np.shape(tmpl))
+        if tuple(leaf.shape) != t_shape:
+            raise ValueError(
+                f"leaf {i}: checkpoint shape {tuple(leaf.shape)} != template "
+                f"shape {t_shape} — model/optimizer config mismatch")
+    return jax.tree.unflatten(structure, leaves), spec.get("meta", {})
+
+
+def train_state_exists(path: str | Path) -> bool:
+    path = Path(path)
+    return ((path / "train_state.npz").exists()
+            and (path / "train_meta.json").exists())

@@ -1,0 +1,91 @@
+"""Host-side tokenization: HF-native and hash tokenizers.
+
+The HF path is exercised against a real tokenizer.json built in-test (no
+network), covering the reference's truncation semantics
+(embedding_generator.rs:93-99) and the batch path the engine's bulk ingest
+uses.
+"""
+
+import pytest
+
+from symbiont_tpu.engine.tokenizer import HashTokenizer, HFTokenizer, load_tokenizer
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer_file(tmp_path_factory):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.processors import TemplateProcessing
+
+    words = ["the", "mxu", "does", "matmuls", "hbm", "is", "bottleneck",
+             "fast", "and", "wide"]
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3}
+    vocab.update({w: i + 4 for i, w in enumerate(words)})
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.post_processor = TemplateProcessing(
+        single="[CLS] $A [SEP]",
+        pair="[CLS] $A [SEP] $B:1 [SEP]:1",
+        special_tokens=[("[CLS]", 2), ("[SEP]", 3)])
+    f = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(f))
+    return f
+
+
+def test_hf_encode_and_specials(hf_tokenizer_file):
+    t = HFTokenizer(hf_tokenizer_file)
+    assert (t.cls_id, t.sep_id, t.pad_id) == (2, 3, 0)
+    ids = t.encode("the mxu does matmuls", 32)
+    assert ids[0] == t.cls_id and ids[-1] == t.sep_id
+    assert len(ids) == 6
+
+
+def test_hf_truncation_keeps_sep(hf_tokenizer_file):
+    t = HFTokenizer(hf_tokenizer_file)
+    ids = t.encode("the mxu does matmuls hbm is bottleneck fast and wide", 6)
+    assert len(ids) == 6
+    assert ids[-1] == t.sep_id  # LongestFirst parity: specials survive
+
+
+def test_hf_encode_batch_matches_single(hf_tokenizer_file):
+    t = HFTokenizer(hf_tokenizer_file)
+    texts = ["the mxu", "hbm is the bottleneck", "",
+             "the mxu does matmuls hbm is bottleneck fast and wide"]
+    batch = t.encode_batch(texts, 6)
+    assert batch == [t.encode(x, 6) for x in texts]
+
+
+def test_hf_encode_pair_types(hf_tokenizer_file):
+    t = HFTokenizer(hf_tokenizer_file)
+    ids, types = t.encode_pair("the mxu", "hbm is fast", 32)
+    assert len(ids) == len(types)
+    assert types[0] == 0 and types[-1] == 1
+
+
+def test_load_tokenizer_selects_backend(hf_tokenizer_file, tmp_path):
+    assert isinstance(load_tokenizer(hf_tokenizer_file.parent, 100), HFTokenizer)
+    assert isinstance(load_tokenizer(str(tmp_path), 100), HashTokenizer)
+    assert isinstance(load_tokenizer(None, 100), HashTokenizer)
+
+
+def test_hash_batch_matches_single():
+    t = HashTokenizer(100)
+    texts = ["a b c", "", "d " * 50]
+    assert t.encode_batch(texts, 16) == [t.encode(x, 16) for x in texts]
+
+
+def test_engine_with_hf_tokenizer(hf_tokenizer_file):
+    """Full embed path over the real (native) tokenizer backend."""
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+
+    eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                 batch_buckets=[2, 4], max_batch=4,
+                                 dtype="float32", data_parallel=False),
+                    tokenizer=HFTokenizer(hf_tokenizer_file))
+    out = eng.embed_texts(["the mxu does matmuls", "hbm is the bottleneck"])
+    assert out.shape == (2, 32)
+    import numpy as np
+
+    assert np.isfinite(out).all()

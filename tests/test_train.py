@@ -111,3 +111,75 @@ def test_checkpoint_round_trip(tmp_path):
     assert meta["model"] == "test"
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
                  params, restored)
+
+
+def test_train_state_checkpoint_resume(tmp_path):
+    """Save after step 1, restore into a fresh template, continue — the
+    resumed run reproduces the uninterrupted run exactly (params + optimizer
+    moments + step all round-trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbiont_tpu.models import gpt as gpt_mod
+    from symbiont_tpu.train import checkpoint as ckpt
+    from symbiont_tpu.train.trainer import lm_train_step, make_lm_train_state
+
+    cfg = gpt_mod.GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                            num_heads=2, intermediate_size=32,
+                            max_position_embeddings=16, dtype="float32")
+    rng = np.random.default_rng(0)
+    batch = {"ids": jnp.asarray(rng.integers(1, 32, (2, 8)), jnp.int32),
+             "mask": jnp.ones((2, 8), jnp.int32)}
+
+    # uninterrupted: two steps
+    s_ref, tx = make_lm_train_state(gpt_mod.init_params(jax.random.key(0), cfg))
+    s_ref, _ = lm_train_step(s_ref, batch, cfg, tx)
+    s_ref, m_ref = lm_train_step(s_ref, batch, cfg, tx)
+
+    # interrupted: one step, save, restore into a fresh template, one step
+    s1, tx1 = make_lm_train_state(gpt_mod.init_params(jax.random.key(0), cfg))
+    s1, _ = lm_train_step(s1, batch, cfg, tx1)
+    assert not ckpt.train_state_exists(tmp_path / "ts")
+    ckpt.save_train_state(tmp_path / "ts", s1, meta={"arch": "gpt2"})
+    assert ckpt.train_state_exists(tmp_path / "ts")
+
+    template, tx2 = make_lm_train_state(gpt_mod.init_params(jax.random.key(7), cfg))
+    restored, meta = ckpt.load_train_state(tmp_path / "ts", template)
+    assert meta == {"arch": "gpt2"}
+    assert int(restored.step) == 1
+    s2, m2 = lm_train_step(restored, batch, cfg, tx2)
+
+    assert int(s2.step) == int(s_ref.step) == 2
+    np.testing.assert_allclose(float(m2["loss"]), float(m_ref["loss"]),
+                               rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree.leaves(s_ref.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_train_state_structure_mismatch_raises(tmp_path):
+    import jax
+
+    from symbiont_tpu.models import gpt as gpt_mod
+    from symbiont_tpu.train import checkpoint as ckpt
+    from symbiont_tpu.train.trainer import make_lm_train_state
+
+    cfg1 = gpt_mod.GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                             num_heads=2, intermediate_size=32,
+                             max_position_embeddings=16, dtype="float32")
+    cfg2 = gpt_mod.GPTConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                             num_heads=2, intermediate_size=32,
+                             max_position_embeddings=16, dtype="float32")
+    s1, _ = make_lm_train_state(gpt_mod.init_params(jax.random.key(0), cfg1))
+    ckpt.save_train_state(tmp_path / "ts", s1)
+    # different layer count → leaf-count mismatch
+    s2, _ = make_lm_train_state(gpt_mod.init_params(jax.random.key(0), cfg2))
+    with pytest.raises(ValueError, match="mismatch"):
+        ckpt.load_train_state(tmp_path / "ts", s2)
+    # same tree structure, different geometry → per-leaf shape mismatch
+    import dataclasses
+
+    cfg3 = dataclasses.replace(cfg1, hidden_size=32, intermediate_size=64)
+    s3, _ = make_lm_train_state(gpt_mod.init_params(jax.random.key(0), cfg3))
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.load_train_state(tmp_path / "ts", s3)
