@@ -95,6 +95,10 @@ class LmConfig:
     temperature: float = 0.8
     top_k: int = 40
     seed: int = 0
+    # generation micro-batching: concurrent generate requests within the
+    # flush window decode as one batched call (engine/batcher.GenBatcher)
+    gen_max_batch: int = 8
+    gen_flush_deadline_ms: float = 10.0
 
 
 @dataclass

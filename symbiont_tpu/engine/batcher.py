@@ -1,14 +1,21 @@
-"""Async micro-batching queue in front of the engine.
+"""Async micro-batching queues in front of the engine.
 
 SURVEY.md §7 hard-part #1/#4: the bus delivers one document/query at a time,
 the TPU wants large uniform batches, and the interactive search path (p50
 latency) must not wait behind bulk ingest. Two policies over one engine:
 
-- `MicroBatcher` — aggregates submissions; flushes when `max_batch` items are
-  queued or the oldest item has waited `flush_deadline_ms`. Queries ride in
-  the next flush (small batch, low latency); bulk ingest fills batches.
-- Ingest callers submit whole documents (many sentences at once) and get all
-  vectors back in one future.
+- `MicroBatcher` (embedding) — aggregates submissions; flushes when
+  `max_batch` items are queued or the oldest item has waited
+  `flush_deadline_ms`. Queries ride in the next flush (small batch, low
+  latency); bulk ingest fills batches.
+- `GenBatcher` (generation) — same loop; concurrent tasks.generation.text
+  requests within the flush window decode as ONE batched gpt.generate call
+  instead of serializing on the engine lock, sharing every weight read of
+  the decode loop. Requests group by new-token bucket.
+
+Both share one flush loop (`_BatcherBase`): wake on submission, wait up to
+the deadline for the batch to fill, then flush AT MOST max_batch items —
+a backlog drains in max_batch-sized chunks, never as one giant device call.
 
 The reference's model — spawn a task per message, all contending on one model
 (reference: services/preprocessing_service/src/main.rs:376,425) — is exactly
@@ -19,7 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -29,29 +36,24 @@ from symbiont_tpu.engine.engine import TpuEngine
 log = logging.getLogger(__name__)
 
 
-@dataclass
-class _Pending:
-    texts: List[str]
-    future: asyncio.Future
+class _BatcherBase:
+    """Queue + wake + deadline-flush loop shared by the embed and generation
+    batchers. Subclasses define `_size(item)` (how much of max_batch an item
+    consumes) and `_flush(batch)` (resolve every item's future)."""
 
-
-class MicroBatcher:
-    def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
-                 flush_deadline_ms: Optional[float] = None):
-        self.engine = engine
-        self.max_batch = max_batch or engine.config.max_batch
-        self.deadline_s = (flush_deadline_ms
-                           if flush_deadline_ms is not None
-                           else engine.config.flush_deadline_ms) / 1000.0
-        self._queue: List[_Pending] = []
-        self._queued_texts = 0
+    def __init__(self, max_batch: int, deadline_s: float):
+        self.max_batch = max_batch
+        self.deadline_s = deadline_s
+        self._queue: List = []
+        self._queued = 0
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._closed = False
 
     async def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.create_task(self._run(), name="micro-batcher")
+            self._task = asyncio.create_task(
+                self._run(), name=type(self).__name__)
 
     async def close(self) -> None:
         self._closed = True
@@ -60,15 +62,24 @@ class MicroBatcher:
             await self._task
             self._task = None
 
-    async def embed(self, texts: Sequence[str]) -> np.ndarray:
-        """Submit texts; resolves with [n, dim] when their batch flushes."""
+    def _submit(self, item) -> None:
         if self._closed:
             raise RuntimeError("batcher closed")
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append(_Pending(list(texts), fut))
-        self._queued_texts += len(texts)
+        self._queue.append(item)
+        self._queued += self._size(item)
         self._wake.set()
-        return await fut
+
+    def _take_chunk(self) -> List:
+        """Pop up to max_batch's worth of items (always at least one)."""
+        taken: List = []
+        size = 0
+        while self._queue and (not taken
+                               or size + self._size(self._queue[0]) <= self.max_batch):
+            item = self._queue.pop(0)
+            size += self._size(item)
+            taken.append(item)
+        self._queued -= size
+        return taken
 
     async def _run(self) -> None:
         while True:
@@ -78,34 +89,122 @@ class MicroBatcher:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
-            if self._queued_texts < self.max_batch and not self._closed:
+            if self._queued < self.max_batch and not self._closed:
                 # deadline flush: give late arrivals a short window to batch up
                 try:
-                    await asyncio.wait_for(self._sleep_until_full(), self.deadline_s)
+                    await asyncio.wait_for(self._sleep_until_full(),
+                                           self.deadline_s)
                 except asyncio.TimeoutError:
                     pass
-            batch, self._queue = self._queue, []
-            self._queued_texts = 0
-            texts: List[str] = []
-            for p in batch:
-                texts.extend(p.texts)
-            try:
-                # off the event loop: the forward is CPU/TPU-bound
-                vecs = await asyncio.get_running_loop().run_in_executor(
-                    None, self.engine.embed_texts, texts)
-                offset = 0
-                for p in batch:
-                    n = len(p.texts)
-                    if not p.future.cancelled():
-                        p.future.set_result(vecs[offset:offset + n])
-                    offset += n
-            except Exception as e:  # propagate to every waiter
-                log.exception("batch embed failed")
-                for p in batch:
-                    if not p.future.cancelled():
-                        p.future.set_exception(e)
+            await self._flush(self._take_chunk())
 
     async def _sleep_until_full(self) -> None:
-        while self._queued_texts < self.max_batch and not self._closed:
+        while self._queued < self.max_batch and not self._closed:
             self._wake.clear()
             await self._wake.wait()
+
+    # subclass interface -----------------------------------------------------
+
+    def _size(self, item) -> int:
+        raise NotImplementedError
+
+    async def _flush(self, batch: List) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class _Pending:
+    texts: List[str]
+    future: asyncio.Future
+
+
+class MicroBatcher(_BatcherBase):
+    def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
+                 flush_deadline_ms: Optional[float] = None):
+        deadline = (flush_deadline_ms if flush_deadline_ms is not None
+                    else engine.config.flush_deadline_ms) / 1000.0
+        super().__init__(max_batch or engine.config.max_batch, deadline)
+        self.engine = engine
+
+    async def embed(self, texts: Sequence[str]) -> np.ndarray:
+        """Submit texts; resolves with [n, dim] when their batch flushes."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._submit(_Pending(list(texts), fut))
+        return await fut
+
+    def _size(self, item: _Pending) -> int:
+        return len(item.texts)
+
+    async def _flush(self, batch: List) -> None:
+        texts: List[str] = []
+        for p in batch:
+            texts.extend(p.texts)
+        try:
+            # off the event loop: the forward is CPU/TPU-bound
+            vecs = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.embed_texts, texts)
+            offset = 0
+            for p in batch:
+                n = len(p.texts)
+                if not p.future.cancelled():
+                    p.future.set_result(vecs[offset:offset + n])
+                offset += n
+        except Exception as e:  # propagate to every waiter
+            log.exception("batch embed failed")
+            for p in batch:
+                if not p.future.cancelled():
+                    p.future.set_exception(e)
+
+
+@dataclass
+class _PendingGen:
+    prompt: str
+    max_new: int
+    future: asyncio.Future
+
+
+class GenBatcher(_BatcherBase):
+    """Micro-batching for autoregressive generation (the LmEngine analog of
+    MicroBatcher). Sampling params stay the engine defaults, which is what
+    the bus surface exposes; requests group by new-token bucket (an
+    executable is specialized on max_new)."""
+
+    def __init__(self, lm, max_batch: Optional[int] = None,
+                 flush_deadline_ms: Optional[float] = None):
+        deadline = (flush_deadline_ms if flush_deadline_ms is not None
+                    else lm.config.gen_flush_deadline_ms) / 1000.0
+        super().__init__(max_batch or lm.config.gen_max_batch, deadline)
+        self.lm = lm
+
+    async def generate(self, prompt: str, max_new_tokens: int) -> str:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._submit(_PendingGen(prompt, int(max_new_tokens), fut))
+        return await fut
+
+    def _size(self, item: _PendingGen) -> int:
+        return 1
+
+    def _bucket(self, max_new: int) -> int:
+        for b in self.lm.config.new_token_buckets:
+            if max_new <= b:
+                return b
+        return self.lm.config.new_token_buckets[-1]
+
+    async def _flush(self, batch: List) -> None:
+        groups: dict = {}
+        for p in batch:
+            groups.setdefault(self._bucket(p.max_new), []).append(p)
+        for group in groups.values():
+            try:
+                texts = await asyncio.get_running_loop().run_in_executor(
+                    None, self.lm.generate_batch,
+                    [p.prompt for p in group],
+                    [p.max_new for p in group])
+                for p, text in zip(group, texts):
+                    if not p.future.cancelled():
+                        p.future.set_result(text)
+            except Exception as e:
+                log.exception("batch generate failed")
+                for p in group:
+                    if not p.future.cancelled():
+                        p.future.set_exception(e)

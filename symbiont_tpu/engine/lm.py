@@ -23,7 +23,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -149,35 +149,65 @@ class LmEngine:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None) -> str:
         """Prompt → generated text (the tasks.generation.text LM backend)."""
+        return self.generate_batch([prompt], [max_new_tokens],
+                                   temperature=temperature, top_k=top_k)[0]
+
+    def generate_batch(self, prompts: Sequence[str],
+                       max_new_tokens: Sequence[int],
+                       temperature: Optional[float] = None,
+                       top_k: Optional[int] = None) -> list:
+        """Batched decode: B prompts through ONE (prompt_bucket, new_bucket)
+        executable — concurrent generation requests share the decode loop's
+        weight reads instead of serializing B single-row decodes. Rows are
+        right-aligned internally by gpt.generate, so each row's output is
+        independent of its batchmates (greedy decode of a batch == greedy
+        decode of each row alone; asserted in tests). Per-request
+        max_new_tokens trim a shared new-token bucket."""
         import jax
         import jax.numpy as jnp
 
         cfg = self.config
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
+        if len(prompts) != len(max_new_tokens):
+            raise ValueError("prompts and max_new_tokens length mismatch")
 
-        new_bucket = _round_up(max_new_tokens, cfg.new_token_buckets)
+        new_bucket = _round_up(max(max_new_tokens), cfg.new_token_buckets)
         # P + new_bucket must fit in max_position_embeddings, so prompt
         # buckets above that cap are unusable for this request.
         cap = self.model_cfg.max_position_embeddings - new_bucket
         if cap < 1:
             raise ValueError(
-                f"max_new_tokens {max_new_tokens} (bucket {new_bucket}) "
+                f"max_new_tokens {max(max_new_tokens)} (bucket {new_bucket}) "
                 f"leaves no room in {self.model_cfg.max_position_embeddings} "
                 "positions")
         avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
         max_prompt = avail[-1]
-        ids = self.tokenizer.encode(prompt or "", 1 << 30)
-        ids = ids[-max_prompt:]  # keep the tail: recent context wins
-        if not ids:
-            ids = [getattr(self.tokenizer, "bos_id", 0)]
-        P = _round_up(len(ids), avail)
+        encoded = []
+        for prompt in prompts:
+            ids = self.tokenizer.encode(prompt or "", 1 << 30)
+            ids = ids[-max_prompt:]  # keep the tail: recent context wins
+            if not ids:
+                ids = [getattr(self.tokenizer, "bos_id", 0)]
+            encoded.append(ids)
+        B = len(encoded)
+        # batch dim rounds to a power of two: gpt.generate retraces per B, so
+        # bucketing keeps the executable count log-bounded (1,2,4,8,...)
+        # instead of one compile per distinct concurrent-request count;
+        # padding rows are masked empty and their outputs dropped
+        bb = 1 << (B - 1).bit_length() if B > 1 else 1
+        P = _round_up(max(len(e) for e in encoded), avail)
 
         pad = getattr(self.tokenizer, "pad_id", 0)
-        prompt_ids = np.full((1, P), pad, np.int32)
-        prompt_ids[0, : len(ids)] = ids
-        prompt_mask = np.zeros((1, P), np.int32)
-        prompt_mask[0, : len(ids)] = 1
+        bos = getattr(self.tokenizer, "bos_id", 0)
+        prompt_ids = np.full((bb, P), pad, np.int32)
+        prompt_mask = np.zeros((bb, P), np.int32)
+        for i, ids in enumerate(encoded):
+            prompt_ids[i, : len(ids)] = ids
+            prompt_mask[i, : len(ids)] = 1
+        for i in range(B, bb):  # padding rows: minimal one-token prompt
+            prompt_ids[i, 0] = bos
+            prompt_mask[i, 0] = 1
 
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         with self._lock:
@@ -191,12 +221,16 @@ class LmEngine:
                     temperature=float(temperature), top_k=int(top_k),
                     eos_id=int(eos_id))
                 tokens = np.asarray(tokens)  # materialize → full decode done
-            n = int(np.asarray(lengths)[0])
+            lengths = np.asarray(lengths)
             dt = time.perf_counter() - t0
             self.stats["generate_calls"] += 1
-            self.stats["tokens_generated"] += min(n, max_new_tokens)
             self.stats["decode_s"] += dt
-        return self.tokenizer.decode(tokens[0, : min(n, max_new_tokens)])
+            out = []
+            for i, want in enumerate(max_new_tokens):  # drops padding rows
+                n = min(int(lengths[i]), int(want))
+                self.stats["tokens_generated"] += n
+                out.append(self.tokenizer.decode(tokens[i, :n]))
+        return out
 
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""

@@ -45,6 +45,7 @@ class SymbiontStack:
         self.bus = None
         self.engine = None
         self.lm = None
+        self._lm_batcher = None
         self.vector_store = None
         self.graph_store = None
         self.api: Optional[ApiService] = None
@@ -120,12 +121,18 @@ class SymbiontStack:
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.graph_store.ensure_schema)  # engine-only: see above
 
-        lm_generate = None
+        lm_batcher = None
         if cfg.lm.enabled and (on("text_generator") or on("engine")):
+            from symbiont_tpu.engine.batcher import GenBatcher
             from symbiont_tpu.engine.lm import LmEngine
 
             self.lm = LmEngine(cfg.lm)
-            lm_generate = self.lm.generate
+            # one generation micro-batcher shared by the bus surface and the
+            # engine plane: concurrent requests decode as one batch. Stored
+            # on self BEFORE anything else can raise, so stop() always
+            # closes its task.
+            lm_batcher = self._lm_batcher = GenBatcher(self.lm)
+            await lm_batcher.start()
 
         # ONE micro-batching queue in front of the device, shared by every
         # in-process caller (preprocessing pipeline + engine.* plane) — two
@@ -154,13 +161,14 @@ class SymbiontStack:
             # with the LM backend active, skip Markov ingest training — the
             # chain would grow unboundedly while never being used to generate
             self.services.append(
-                TextGeneratorService(self.bus, lm_generate=lm_generate,
-                                     train_on_ingest=lm_generate is None))
+                TextGeneratorService(self.bus, lm_batcher=lm_batcher,
+                                     train_on_ingest=lm_batcher is None))
         if on("engine"):
             from symbiont_tpu.services.engine_service import EngineService
 
             self.services.append(EngineService(
                 self.bus, engine=self.engine, batcher=batcher, lm=self.lm,
+                lm_batcher=lm_batcher,
                 vector_store=self.vector_store, graph_store=self.graph_store))
         for s in self.services:
             await s.start()
@@ -176,6 +184,8 @@ class SymbiontStack:
             await self.api.stop()
         for s in self.services:
             await s.stop()
+        if self._lm_batcher is not None:
+            await self._lm_batcher.close()
         if self.graph_store:
             self.graph_store.close()
         if self.bus and self._bus_override is None:

@@ -52,11 +52,12 @@ class EngineService(Service):
 
     def __init__(self, bus, engine: Optional[TpuEngine] = None,
                  batcher: Optional[MicroBatcher] = None, lm=None,
-                 vector_store=None, graph_store=None):
+                 lm_batcher=None, vector_store=None, graph_store=None):
         super().__init__(bus)
         self.engine = engine
         self.batcher = batcher or (MicroBatcher(engine) if engine else None)
         self.lm = lm
+        self.lm_batcher = lm_batcher
         self.vector_store = vector_store
         self.graph_store = graph_store
         self._warm_task: Optional[asyncio.Task] = None
@@ -180,8 +181,13 @@ class EngineService(Service):
         async def op(req: dict) -> dict:
             prompt = req.get("prompt") or ""
             max_new = int(req.get("max_new_tokens", 50))
-            text = await self._run_blocking(
-                self.lm.generate, prompt, max_new)
+            if self.lm_batcher is not None:
+                # shared micro-batcher: concurrent engine.generate callers
+                # decode as one batch with the bus-surface requests
+                text = await self.lm_batcher.generate(prompt, max_new)
+            else:
+                text = await self._run_blocking(
+                    self.lm.generate, prompt, max_new)
             name = self.lm.config.model_dir or f"symbiont-lm/{self.lm.config.arch}"
             return {"text": text, "model_name": name}
         await self._handle(msg, "generate", op)

@@ -43,11 +43,14 @@ SEED_CORPUS = (
 class TextGeneratorService(Service):
     name = "text_generator"
 
-    def __init__(self, bus, lm_generate=None, train_on_ingest: bool = True):
+    def __init__(self, bus, lm_generate=None, lm_batcher=None,
+                 train_on_ingest: bool = True):
         super().__init__(bus)
         self.markov = MarkovModel()
         self.markov.train(SEED_CORPUS)
         self.lm_generate = lm_generate  # Callable[[str, int], str] | None
+        self.lm_batcher = lm_batcher  # GenBatcher | None (preferred: batches
+        #                               concurrent requests into one decode)
         self.train_on_ingest = train_on_ingest
 
     async def _setup(self) -> None:
@@ -69,7 +72,10 @@ class TextGeneratorService(Service):
         task = from_json(GenerateTextTask, msg.data)
         with span("text_generator.generate", msg.headers,
                   max_length=task.max_length):
-            if self.lm_generate is not None:
+            if self.lm_batcher is not None:
+                text = await self.lm_batcher.generate(task.prompt or "",
+                                                      task.max_length)
+            elif self.lm_generate is not None:
                 text = await asyncio.get_running_loop().run_in_executor(
                     None, self.lm_generate, task.prompt or "", task.max_length)
             else:

@@ -98,11 +98,14 @@ def save_train_state(path: str | Path, state, meta: Optional[dict] = None) -> No
         os.fsync(f.fileno())
     os.replace(tmp, path / "train_state.npz")
     tmp_meta = path / "train_meta.json.tmp"
-    tmp_meta.write_text(json.dumps({
-        "n_leaves": len(leaves),
-        "shapes": [list(l.shape) for l in leaves],
-        "dtypes": [str(l.dtype) for l in leaves],
-        "meta": meta or {}}))
+    with open(tmp_meta, "w") as f:
+        f.write(json.dumps({
+            "n_leaves": len(leaves),
+            "shapes": [list(l.shape) for l in leaves],
+            "dtypes": [str(l.dtype) for l in leaves],
+            "meta": meta or {}}))
+        f.flush()
+        os.fsync(f.fileno())  # rename must not outlive the data
     os.replace(tmp_meta, path / "train_meta.json")
 
 

@@ -359,3 +359,61 @@ def test_oversized_body_rejected(stack_config):
             await api.stop()
 
     asyncio.run(scenario())
+
+
+def test_lm_backend_generate_roundtrip(tmp_path):
+    """Full stack with the LM backend enabled: generate-text rides the
+    generation micro-batcher through the runner wiring, prompt actually
+    used (unlike the reference's Markov, main.rs:120-123)."""
+    from symbiont_tpu.config import LmConfig
+
+    cfg = SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
+                            batch_buckets=[2, 8], max_batch=8, dtype="float32",
+                            data_parallel=False, flush_deadline_ms=2.0),
+        lm=LmConfig(enabled=True, hidden_size=32, num_layers=1, num_heads=2,
+                    intermediate_size=64, max_positions=64, dtype="float32",
+                    prompt_buckets=[8], new_token_buckets=[8],
+                    gen_flush_deadline_ms=5.0),
+        vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
+    )
+
+    async def scenario():
+        stack = SymbiontStack(cfg, bus=InprocBus(), fetcher=_fake_fetcher)
+        await stack.start()
+        port = stack.api.port
+        loop = asyncio.get_running_loop()
+        try:
+            assert stack._lm_batcher is not None
+
+            sse_events: list = []
+
+            async def sse_reader(n):
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                writer.write(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
+                await writer.drain()
+                while len(sse_events) < n:
+                    line = await reader.readline()
+                    if line.startswith(b"data: "):
+                        sse_events.append(json.loads(line[6:].strip()))
+                writer.close()
+
+            reader_task = asyncio.create_task(sse_reader(3))
+            await asyncio.sleep(0.2)
+            # three concurrent requests → the batcher coalesces them
+            for i in range(3):
+                status, body = await loop.run_in_executor(None, lambda i=i: _http(
+                    "POST", port, "/api/generate-text",
+                    {"task_id": f"lm-{i}", "prompt": "seed", "max_length": 6}))
+                assert status == 200
+            await asyncio.wait_for(reader_task, timeout=20)
+            assert {e["original_task_id"] for e in sse_events} == {
+                "lm-0", "lm-1", "lm-2"}
+            assert all(isinstance(e["generated_text"], str) for e in sse_events)
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())

@@ -108,3 +108,70 @@ def test_text_generator_service_uses_lm():
         assert isinstance(out.generated_text, str)
 
     asyncio.run(run())
+
+
+def test_generate_batch_greedy_matches_singles():
+    """Greedy batched decode row i == greedy single decode of prompt i:
+    right-alignment + kv_valid isolate rows from their batchmates."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8, 16], new_token_buckets=[8],
+                            temperature=0.0))
+    prompts = ["hello", "a much longer prompt with many words",
+               ""]
+    singles = [eng.generate(p, 8, temperature=0.0) for p in prompts]
+    batched = eng.generate_batch(prompts, [8, 8, 8], temperature=0.0)
+    assert batched == singles
+
+
+def test_generate_batch_per_request_trim():
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8],
+                            temperature=0.0))
+    short, long = eng.generate_batch(["x", "x"], [2, 8], temperature=0.0)
+    # byte tokenizer: one byte per token → lengths map to chars
+    assert len(short.encode()) <= 2
+    assert long.startswith(short)
+
+
+def test_gen_batcher_batches_concurrent_requests():
+    """N concurrent submissions within the flush window → ONE decode call,
+    each future resolving to its own row."""
+    import asyncio
+
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.batcher import GenBatcher
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8],
+                            temperature=0.0, gen_max_batch=4,
+                            gen_flush_deadline_ms=50.0))
+    singles = [eng.generate(p, 6, temperature=0.0)
+               for p in ["aa", "bb", "cc"]]
+    calls_before = eng.stats["generate_calls"]
+
+    async def scenario():
+        b = GenBatcher(eng)
+        await b.start()
+        try:
+            return await asyncio.gather(b.generate("aa", 6),
+                                        b.generate("bb", 6),
+                                        b.generate("cc", 6))
+        finally:
+            await b.close()
+
+    results = asyncio.run(scenario())
+    assert results == singles
+    assert eng.stats["generate_calls"] == calls_before + 1  # one batch
