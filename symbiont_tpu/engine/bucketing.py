@@ -12,7 +12,7 @@ entries (the "recompile storm" guard from SURVEY.md §7 hard-part #2).
 
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 

@@ -24,7 +24,6 @@ see symbiont_tpu.models.convert).
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
 from functools import partial

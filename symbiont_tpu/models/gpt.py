@@ -19,7 +19,6 @@ tokens/sec/chip + time-to-first-token). This module is that LM:
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
 from functools import partial

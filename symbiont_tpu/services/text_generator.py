@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Optional
 
 from symbiont_tpu import subjects
 from symbiont_tpu.bus.core import Msg
