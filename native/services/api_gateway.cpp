@@ -587,13 +587,15 @@ void serve_sse(int fd, const HttpRequest& req) {
   g_hub.unregister(q);
 }
 
-// bridge thread: owns the events.text.generated subscription
-// (reference: nats_to_sse_listener, main.rs:215-270)
+// bridge thread: owns the events.text.generated(.partial) subscriptions
+// (reference: nats_to_sse_listener, main.rs:215-270; streaming deltas are
+// this framework's addition and ride the same SSE channel)
 void sse_bridge() {
   for (;;) {
     symbus::Client bus;
     if (!symbiont::connect_with_retry(bus, SERVICE)) return;
     bus.subscribe(symbiont::subjects::EVENTS_TEXT_GENERATED);
+    bus.subscribe(symbiont::subjects::EVENTS_TEXT_GENERATED_PARTIAL);
     while (bus.connected()) {
       auto msg = bus.next(1000);
       if (!msg) continue;

@@ -29,6 +29,7 @@ inline const char* DATA_TEXT_WITH_EMBEDDINGS = "data.text.with_embeddings";
 inline const char* DATA_PROCESSED_TEXT_TOKENIZED = "data.processed_text.tokenized";
 inline const char* TASKS_GENERATION_TEXT = "tasks.generation.text";
 inline const char* EVENTS_TEXT_GENERATED = "events.text.generated";
+inline const char* EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial";
 inline const char* TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query";
 inline const char* TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request";
 inline const char* ENGINE_EMBED_BATCH = "engine.embed.batch";

@@ -99,6 +99,9 @@ class LmConfig:
     # flush window decode as one batched call (engine/batcher.GenBatcher)
     gen_max_batch: int = 8
     gen_flush_deadline_ms: float = 10.0
+    # token streaming (events.text.generated.partial): decode in chunks of
+    # this many tokens, emitting a text delta per chunk; 0 disables streaming
+    stream_chunk: int = 16
 
 
 @dataclass

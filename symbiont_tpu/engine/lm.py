@@ -85,6 +85,38 @@ def _round_up(n: int, buckets) -> int:
     return buckets[-1]
 
 
+class IncrementalDecoder:
+    """Turn growing token sequences into stable text deltas.
+
+    `tokenizer.decode` of a prefix is NOT always a prefix of the decode of a
+    longer sequence: a multi-byte UTF-8 character straddling a chunk boundary
+    decodes to U+FFFD until its continuation bytes arrive. push() therefore
+    holds back a trailing replacement-char run (the only unstable region of
+    incremental UTF-8 decoding) and only ever emits a confirmed-stable
+    prefix; flush() emits the remainder, replacement chars included if the
+    model genuinely produced invalid bytes. Concatenated deltas == the full
+    decode, always."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._emitted = ""
+
+    def _delta_to(self, text: str) -> str:
+        if text.startswith(self._emitted) and len(text) > len(self._emitted):
+            delta = text[len(self._emitted):]
+            self._emitted = text
+            return delta
+        return ""
+
+    def push(self, all_tokens) -> str:
+        text = self._tok.decode(all_tokens)
+        stable = text.rstrip("�")
+        return self._delta_to(stable)
+
+    def flush(self, all_tokens) -> str:
+        return self._delta_to(self._tok.decode(all_tokens))
+
+
 class LmEngine:
     """Owns LM params + decode executables. Thread-safe, single device owner
     (same stance as TpuEngine — SURVEY.md §5.2's fix for the reference's
@@ -145,6 +177,44 @@ class LmEngine:
 
     # ------------------------------------------------------------------ gen
 
+    def _prepare_prompts(self, prompts: Sequence[str], max_new: int):
+        """Shared decode preamble: pick the new-token bucket, validate it
+        fits, encode prompts (tail-trim to the largest usable prompt bucket,
+        BOS fallback for empty), pad to a power-of-two batch bucket so the
+        executable count stays log-bounded. Returns
+        (prompt_ids [bb, P], prompt_mask [bb, P], new_bucket)."""
+        cfg = self.config
+        new_bucket = _round_up(max_new, cfg.new_token_buckets)
+        # P + new_bucket must fit in max_position_embeddings, so prompt
+        # buckets above that cap are unusable for this request.
+        cap = self.model_cfg.max_position_embeddings - new_bucket
+        if cap < 1:
+            raise ValueError(
+                f"max_new_tokens {max_new} (bucket {new_bucket}) leaves no "
+                f"room in {self.model_cfg.max_position_embeddings} positions")
+        avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
+        encoded = []
+        for prompt in prompts:
+            ids = self.tokenizer.encode(prompt or "", 1 << 30)
+            ids = ids[-avail[-1]:]  # keep the tail: recent context wins
+            if not ids:
+                ids = [getattr(self.tokenizer, "bos_id", 0)]
+            encoded.append(ids)
+        B = len(encoded)
+        bb = 1 << (B - 1).bit_length() if B > 1 else 1
+        P = _round_up(max(len(e) for e in encoded), avail)
+        pad = getattr(self.tokenizer, "pad_id", 0)
+        bos = getattr(self.tokenizer, "bos_id", 0)
+        prompt_ids = np.full((bb, P), pad, np.int32)
+        prompt_mask = np.zeros((bb, P), np.int32)
+        for i, ids in enumerate(encoded):
+            prompt_ids[i, : len(ids)] = ids
+            prompt_mask[i, : len(ids)] = 1
+        for i in range(B, bb):  # padding rows: minimal one-token prompt
+            prompt_ids[i, 0] = bos
+            prompt_mask[i, 0] = 1
+        return prompt_ids, prompt_mask, new_bucket
+
     def generate(self, prompt: str, max_new_tokens: int,
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None) -> str:
@@ -171,44 +241,8 @@ class LmEngine:
         top_k = cfg.top_k if top_k is None else top_k
         if len(prompts) != len(max_new_tokens):
             raise ValueError("prompts and max_new_tokens length mismatch")
-
-        new_bucket = _round_up(max(max_new_tokens), cfg.new_token_buckets)
-        # P + new_bucket must fit in max_position_embeddings, so prompt
-        # buckets above that cap are unusable for this request.
-        cap = self.model_cfg.max_position_embeddings - new_bucket
-        if cap < 1:
-            raise ValueError(
-                f"max_new_tokens {max(max_new_tokens)} (bucket {new_bucket}) "
-                f"leaves no room in {self.model_cfg.max_position_embeddings} "
-                "positions")
-        avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
-        max_prompt = avail[-1]
-        encoded = []
-        for prompt in prompts:
-            ids = self.tokenizer.encode(prompt or "", 1 << 30)
-            ids = ids[-max_prompt:]  # keep the tail: recent context wins
-            if not ids:
-                ids = [getattr(self.tokenizer, "bos_id", 0)]
-            encoded.append(ids)
-        B = len(encoded)
-        # batch dim rounds to a power of two: gpt.generate retraces per B, so
-        # bucketing keeps the executable count log-bounded (1,2,4,8,...)
-        # instead of one compile per distinct concurrent-request count;
-        # padding rows are masked empty and their outputs dropped
-        bb = 1 << (B - 1).bit_length() if B > 1 else 1
-        P = _round_up(max(len(e) for e in encoded), avail)
-
-        pad = getattr(self.tokenizer, "pad_id", 0)
-        bos = getattr(self.tokenizer, "bos_id", 0)
-        prompt_ids = np.full((bb, P), pad, np.int32)
-        prompt_mask = np.zeros((bb, P), np.int32)
-        for i, ids in enumerate(encoded):
-            prompt_ids[i, : len(ids)] = ids
-            prompt_mask[i, : len(ids)] = 1
-        for i in range(B, bb):  # padding rows: minimal one-token prompt
-            prompt_ids[i, 0] = bos
-            prompt_mask[i, 0] = 1
-
+        prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
+            prompts, max(max_new_tokens))
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         with self._lock:
             self._key, sub = jax.random.split(self._key)
@@ -231,6 +265,68 @@ class LmEngine:
                 self.stats["tokens_generated"] += n
                 out.append(self.tokenizer.decode(tokens[i, :n]))
         return out
+
+    def generate_stream(self, prompt: str, max_new_tokens: int,
+                        temperature: Optional[float] = None,
+                        top_k: Optional[int] = None):
+        """Streaming decode: yields text deltas as chunks of tokens finish
+        (SURVEY.md §7 hard part #5: "streaming tokens back out through
+        NATS→SSE"). Prefill + one compiled chunk-scan executable per
+        (prompt_bucket, chunk) pair, re-invoked with carried device state —
+        time-to-first-chunk is prefill + stream_chunk steps instead of the
+        full decode. Greedy streaming concatenates to exactly generate()'s
+        output (asserted in tests)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
+
+        prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
+            [prompt], max_new_tokens)
+        # largest bucket caps the request (same clamp generate() applies via
+        # its scan length) — the cache has exactly new_bucket decode slots
+        max_new_tokens = min(max_new_tokens, new_bucket)
+        eos_id = getattr(self.tokenizer, "eos_id", -1)
+        chunk = min(cfg.stream_chunk, new_bucket)
+
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            t0 = time.perf_counter()
+            cache, logits, kv_valid, prompt_len = gpt_mod.prefill(
+                self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
+                self.model_cfg, new_bucket)
+            done = jnp.zeros((prompt_ids.shape[0],), bool)
+            pos = prompt_len
+            all_tokens: list = []
+            decoder = IncrementalDecoder(self.tokenizer)
+            stop = False
+            while len(all_tokens) < max_new_tokens and not stop:
+                sub, use = jax.random.split(sub)
+                keys = jax.random.split(use, chunk)
+                cache, logits, pos, done, toks, counted = gpt_mod.decode_chunk(
+                    self.params, cache, logits, pos, done, kv_valid, keys,
+                    self.model_cfg, temperature=float(temperature),
+                    top_k=int(top_k), eos_id=int(eos_id))
+                toks = np.asarray(toks)[0]
+                counted = np.asarray(counted)[0]
+                for t, c in zip(toks, counted):
+                    if not c:  # EOS (or a post-EOS slot): stream ends here
+                        stop = True
+                        break
+                    all_tokens.append(int(t))
+                    if len(all_tokens) >= max_new_tokens:
+                        break
+                delta = decoder.push(all_tokens)
+                if delta:
+                    yield delta
+            final_delta = decoder.flush(all_tokens)
+            if final_delta:
+                yield final_delta
+            self.stats["generate_calls"] += 1
+            self.stats["tokens_generated"] += len(all_tokens)
+            self.stats["decode_s"] += time.perf_counter() - t0
 
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""

@@ -277,6 +277,77 @@ def _sample(logits: jax.Array, key: jax.Array, temperature: float, top_k: int) -
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _align_prompt(prompt_ids: jax.Array, prompt_mask: jax.Array,
+                  max_new_tokens: int):
+    """Right-align prefix-aligned prompts (shared by generate and the
+    streaming decoder): returns (ids_r, positions, kv_valid, prompt_len)."""
+    B, P = prompt_ids.shape
+    prompt_len = prompt_mask.astype(jnp.int32).sum(axis=1)  # [B]
+    pad = P - prompt_len  # left-pad width per row after alignment
+
+    j = jnp.arange(P, dtype=jnp.int32)[None, :]
+    src = j - pad[:, None]
+    ids_r = jnp.take_along_axis(prompt_ids, jnp.clip(src, 0, P - 1), axis=1)
+    ids_r = jnp.where(src >= 0, ids_r, 0)
+    positions = jnp.maximum(src, 0)
+
+    kv_valid = jnp.concatenate(
+        [j >= pad[:, None], jnp.ones((B, max_new_tokens), bool)], axis=1)
+    return ids_r, positions, kv_valid, prompt_len
+
+
+def _decode_step(params, cfg: GPTConfig, kv_valid, temperature: float,
+                 top_k: int, eos_id: int):
+    """The one-token decode step shared by the full scan and chunked scans."""
+
+    def step(carry, step_key):
+        cache, cur_logits, cur_pos, done = carry
+        tok = _sample(cur_logits, step_key, temperature, top_k)
+        tok = jnp.where(done, 0, tok)
+        if eos_id >= 0:
+            counted = ~done & (tok != eos_id)
+            new_done = done | (tok == eos_id)
+        else:
+            counted = ~done
+            new_done = done
+        logits, new_cache = forward(params, tok[:, None], cache,
+                                    cur_pos[:, None], cfg, kv_valid)
+        new_cache = new_cache._replace(length=cache.length + 1)
+        return (new_cache, logits[:, 0, :], cur_pos + 1, new_done), (tok, counted)
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def prefill(params, prompt_ids, prompt_mask, cfg: GPTConfig,
+            max_new_tokens: int):
+    """Prompt forward against a fresh cache sized for max_new_tokens more
+    tokens. Returns (cache, next_logits, kv_valid, prompt_len) — the carry a
+    chunked decode loop resumes from."""
+    B, P = prompt_ids.shape
+    cache = init_cache(cfg, B, P + max_new_tokens, jnp.dtype(cfg.dtype))
+    ids_r, positions, kv_valid, prompt_len = _align_prompt(
+        prompt_ids, prompt_mask, max_new_tokens)
+    logits, cache = forward(params, ids_r, cache, positions, cfg, kv_valid)
+    cache = cache._replace(length=jnp.asarray(P, jnp.int32))
+    return cache, logits[:, -1, :], kv_valid, prompt_len
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "temperature", "top_k", "eos_id"))
+def decode_chunk(params, cache, cur_logits, cur_pos, done, kv_valid, keys,
+                 cfg: GPTConfig, temperature: float = 0.8, top_k: int = 40,
+                 eos_id: int = -1):
+    """Scan `len(keys)` decode steps from a carried state; chunk length is
+    static via the keys shape, so a streaming loop reuses ONE executable per
+    (prompt_bucket, chunk) pair. Returns (carry..., tokens [B, C],
+    counted [B, C])."""
+    step = _decode_step(params, cfg, kv_valid, temperature, top_k, eos_id)
+    (cache, logits, pos, done), (tokens, counted) = jax.lax.scan(
+        step, (cache, cur_logits, cur_pos, done), keys)
+    return cache, logits, pos, done, tokens.T, counted.T
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "eos_id"))
 def generate(
@@ -298,45 +369,11 @@ def generate(
     batch, with left-padding slots masked out of attention via kv_valid.
     Rows stop at eos_id (if ≥0); lengths counts tokens generated before eos.
     """
-    B, P = prompt_ids.shape
-    total = P + max_new_tokens
-    cache = init_cache(cfg, B, total, jnp.dtype(cfg.dtype))
+    B = prompt_ids.shape[0]
+    cache, next_logits, kv_valid, prompt_len = prefill(
+        params, prompt_ids, prompt_mask, cfg, max_new_tokens)
 
-    prompt_len = prompt_mask.astype(jnp.int32).sum(axis=1)  # [B]
-    pad = P - prompt_len  # left-pad width per row after alignment
-
-    # right-align: ids_r[b, j] = ids[b, j - pad_b] for j >= pad_b, else 0
-    j = jnp.arange(P, dtype=jnp.int32)[None, :]
-    src = j - pad[:, None]
-    ids_r = jnp.take_along_axis(prompt_ids, jnp.clip(src, 0, P - 1), axis=1)
-    ids_r = jnp.where(src >= 0, ids_r, 0)
-    positions = jnp.maximum(src, 0)  # logical positions; pad slots masked anyway
-
-    # kv_valid over the whole static cache: left-pad slots are never readable,
-    # decode slots become real as they are written (cache-index causality
-    # already hides future slots, so marking them True here is safe).
-    kv_valid = jnp.concatenate(
-        [j >= pad[:, None], jnp.ones((B, max_new_tokens), bool)], axis=1)
-
-    logits, cache = forward(params, ids_r, cache, positions, cfg, kv_valid)
-    cache = cache._replace(length=jnp.asarray(P, jnp.int32))
-    next_logits = logits[:, -1, :]  # last prompt token is at P-1 for every row
-
-    def step(carry, step_key):
-        cache, cur_logits, cur_pos, done = carry
-        tok = _sample(cur_logits, step_key, temperature, top_k)
-        tok = jnp.where(done, 0, tok)
-        if eos_id >= 0:
-            counted = ~done & (tok != eos_id)
-            new_done = done | (tok == eos_id)
-        else:
-            counted = ~done
-            new_done = done
-        logits, new_cache = forward(params, tok[:, None], cache,
-                                    cur_pos[:, None], cfg, kv_valid)
-        new_cache = new_cache._replace(length=cache.length + 1)
-        return (new_cache, logits[:, 0, :], cur_pos + 1, new_done), (tok, counted)
-
+    step = _decode_step(params, cfg, kv_valid, temperature, top_k, eos_id)
     keys = jax.random.split(key, max_new_tokens)
     init = (cache, next_logits, prompt_len, jnp.zeros((B,), bool))
     _, (tokens, counted) = jax.lax.scan(step, init, keys)

@@ -160,8 +160,12 @@ class SymbiontStack:
         if on("text_generator"):
             # with the LM backend active, skip Markov ingest training — the
             # chain would grow unboundedly while never being used to generate
+            lm_stream = (self.lm.generate_stream
+                         if self.lm is not None and cfg.lm.stream_chunk > 0
+                         else None)
             self.services.append(
                 TextGeneratorService(self.bus, lm_batcher=lm_batcher,
+                                     lm_stream=lm_stream,
                                      train_on_ingest=lm_batcher is None))
         if on("engine"):
             from symbiont_tpu.services.engine_service import EngineService

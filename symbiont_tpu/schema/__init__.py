@@ -159,11 +159,18 @@ class TokenizedTextMessage:
 
 @wire
 class GenerateTextTask:
-    """reference: libs/shared_models/src/lib.rs:26-30"""
+    """reference: libs/shared_models/src/lib.rs:26-30
+
+    `stream` is this framework's addition: when true (and an LM backend with
+    streaming is active), token deltas go out on
+    events.text.generated.partial while decoding. Optional, so reference-era
+    clients (which omit it) remain wire-compatible — and unstreamed requests
+    keep riding the generation micro-batcher."""
 
     task_id: str
     prompt: Optional[str]
     max_length: int
+    stream: Optional[bool] = None
 
 
 @wire
@@ -277,6 +284,21 @@ class SemanticSearchApiResponse:
     search_request_id: str
     results: List[SemanticSearchResultItem]
     error_message: Optional[str]
+
+
+@wire
+class GeneratedTextChunk:
+    """This framework's addition (no reference equivalent): a streaming
+    delta on events.text.generated.partial. The final full text still goes
+    out as GeneratedTextMessage on events.text.generated, so reference-era
+    consumers are unaffected; streaming clients append deltas by
+    (original_task_id, seq) and stop at done=true."""
+
+    original_task_id: str
+    text_delta: str
+    seq: int
+    done: bool
+    timestamp_ms: int
 
 
 __all__ = [t.__name__ for t in WIRE_TYPES] + [

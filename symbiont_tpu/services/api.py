@@ -109,20 +109,27 @@ class ApiService:
         # fused attempt for a window instead of stalling every request
         self._fused_down_until = 0.0
         self._server: Optional[asyncio.AbstractServer] = None
-        self._bridge_task: Optional[asyncio.Task] = None
-        self._bridge_sub = None
+        self._bridge_tasks: List[asyncio.Task] = []
+        self._bridge_subs: List = []
 
     # ---------------------------------------------------------------- server
 
     async def start(self) -> None:
-        # NATS→SSE bridge (reference: nats_to_sse_listener, main.rs:215-270)
-        self._bridge_sub = await self.bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+        # NATS→SSE bridge (reference: nats_to_sse_listener, main.rs:215-270);
+        # streaming deltas ride the same SSE channel (clients tell the two
+        # payload shapes apart by their fields)
+        self._bridge_subs = [
+            await self.bus.subscribe(subjects.EVENTS_TEXT_GENERATED),
+            await self.bus.subscribe(subjects.EVENTS_TEXT_GENERATED_PARTIAL),
+        ]
 
-        async def bridge() -> None:
-            async for msg in self._bridge_sub:
+        async def bridge(sub) -> None:
+            async for msg in sub:
                 self.hub.broadcast(msg.data.decode("utf-8", errors="replace"))
 
-        self._bridge_task = asyncio.create_task(bridge(), name="sse-bridge")
+        self._bridge_tasks = [
+            asyncio.create_task(bridge(s), name="sse-bridge")
+            for s in self._bridge_subs]
         self._server = await asyncio.start_server(
             self._handle_conn, self.config.host, self.config.port)
         log.info("api listening on %s:%s", self.config.host, self.config.port)
@@ -136,10 +143,10 @@ class ApiService:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
-        if self._bridge_sub:
-            self._bridge_sub.close()
-        if self._bridge_task:
-            self._bridge_task.cancel()
+        for sub in self._bridge_subs:
+            sub.close()
+        for task in self._bridge_tasks:
+            task.cancel()
 
     # ------------------------------------------------------------- plumbing
 

@@ -20,6 +20,7 @@ from symbiont_tpu import subjects
 from symbiont_tpu.bus.core import Msg
 from symbiont_tpu.models.markov import MarkovModel
 from symbiont_tpu.schema import (
+    GeneratedTextChunk,
     GeneratedTextMessage,
     GenerateTextTask,
     RawTextMessage,
@@ -42,14 +43,17 @@ SEED_CORPUS = (
 class TextGeneratorService(Service):
     name = "text_generator"
 
-    def __init__(self, bus, lm_generate=None, lm_batcher=None,
+    def __init__(self, bus, lm_generate=None, lm_batcher=None, lm_stream=None,
                  train_on_ingest: bool = True):
         super().__init__(bus)
         self.markov = MarkovModel()
         self.markov.train(SEED_CORPUS)
         self.lm_generate = lm_generate  # Callable[[str, int], str] | None
-        self.lm_batcher = lm_batcher  # GenBatcher | None (preferred: batches
-        #                               concurrent requests into one decode)
+        self.lm_batcher = lm_batcher  # GenBatcher | None (batches concurrent
+        #                               requests into one decode)
+        self.lm_stream = lm_stream  # Callable[..., Iterator[str]] | None —
+        # when set, deltas stream out on events.text.generated.partial while
+        # decoding; the final full message still rides events.text.generated
         self.train_on_ingest = train_on_ingest
 
     async def _setup(self) -> None:
@@ -71,7 +75,12 @@ class TextGeneratorService(Service):
         task = from_json(GenerateTextTask, msg.data)
         with span("text_generator.generate", msg.headers,
                   max_length=task.max_length):
-            if self.lm_batcher is not None:
+            if self.lm_stream is not None and task.stream:
+                # per-request opt-in: streaming holds the engine for the
+                # whole decode, so only explicit stream=true requests take
+                # it — everything else rides the micro-batcher
+                text = await self._stream_generate(task, msg.headers)
+            elif self.lm_batcher is not None:
                 text = await self.lm_batcher.generate(task.prompt or "",
                                                       task.max_length)
             elif self.lm_generate is not None:
@@ -86,3 +95,52 @@ class TextGeneratorService(Service):
                                to_json_bytes(out),
                                headers=child_headers(msg.headers))
         metrics.inc("text_generator.generated")
+
+    async def _stream_generate(self, task: GenerateTextTask, headers) -> str:
+        """Drive the decode generator in an executor thread; every text delta
+        crossing back is published as a GeneratedTextChunk before the next
+        chunk even starts decoding. Returns the accumulated full text."""
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def produce() -> None:
+            try:
+                for delta in self.lm_stream(task.prompt or "",
+                                            task.max_length):
+                    loop.call_soon_threadsafe(queue.put_nowait, ("delta", delta))
+                loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
+            except BaseException as e:  # surface decode errors to the handler
+                loop.call_soon_threadsafe(queue.put_nowait, ("error", e))
+
+        producer = loop.run_in_executor(None, produce)
+        parts: list = []
+        seq = 0
+        try:
+            while True:
+                kind, payload = await queue.get()
+                if kind == "delta":
+                    parts.append(payload)
+                    await self.bus.publish(
+                        subjects.EVENTS_TEXT_GENERATED_PARTIAL,
+                        to_json_bytes(GeneratedTextChunk(
+                            original_task_id=task.task_id, text_delta=payload,
+                            seq=seq, done=False,
+                            timestamp_ms=current_timestamp_ms())),
+                        headers=child_headers(headers))
+                    seq += 1
+                    metrics.inc("text_generator.stream_chunks")
+                elif kind == "end":
+                    break
+                else:
+                    raise payload
+        finally:
+            await producer
+            # terminal chunk ALWAYS goes out — on a decode error too, so
+            # stream consumers get a close signal instead of hanging forever
+            await self.bus.publish(
+                subjects.EVENTS_TEXT_GENERATED_PARTIAL,
+                to_json_bytes(GeneratedTextChunk(
+                    original_task_id=task.task_id, text_delta="", seq=seq,
+                    done=True, timestamp_ms=current_timestamp_ms())),
+                headers=child_headers(headers))
+        return "".join(parts)

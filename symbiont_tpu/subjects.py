@@ -22,6 +22,10 @@ DATA_TEXT_WITH_EMBEDDINGS = "data.text.with_embeddings"
 DATA_PROCESSED_TEXT_TOKENIZED = "data.processed_text.tokenized"  # un-orphaned here
 TASKS_GENERATION_TEXT = "tasks.generation.text"
 EVENTS_TEXT_GENERATED = "events.text.generated"
+# streaming deltas (our addition — SURVEY.md §7 hard part #5 "streaming
+# tokens back out through NATS→SSE"); the final full message still rides
+# EVENTS_TEXT_GENERATED for reference-era consumers
+EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial"
 
 # request-reply (query path)
 TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query"
@@ -34,6 +38,7 @@ ALL_SUBJECTS = [
     DATA_PROCESSED_TEXT_TOKENIZED,
     TASKS_GENERATION_TEXT,
     EVENTS_TEXT_GENERATED,
+    EVENTS_TEXT_GENERATED_PARTIAL,
     TASKS_EMBEDDING_FOR_QUERY,
     TASKS_SEARCH_SEMANTIC_REQUEST,
 ]

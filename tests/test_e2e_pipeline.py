@@ -391,14 +391,17 @@ def test_lm_backend_generate_roundtrip(tmp_path):
 
             sse_events: list = []
 
-            async def sse_reader(n):
+            async def sse_reader(n_finals):
                 reader, writer = await asyncio.open_connection("127.0.0.1", port)
                 writer.write(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
                 await writer.drain()
-                while len(sse_events) < n:
+                finals = 0
+                while finals < n_finals:
                     line = await reader.readline()
                     if line.startswith(b"data: "):
-                        sse_events.append(json.loads(line[6:].strip()))
+                        e = json.loads(line[6:].strip())
+                        sse_events.append(e)
+                        finals += "generated_text" in e
                 writer.close()
 
             reader_task = asyncio.create_task(sse_reader(3))
@@ -407,12 +410,24 @@ def test_lm_backend_generate_roundtrip(tmp_path):
             for i in range(3):
                 status, body = await loop.run_in_executor(None, lambda i=i: _http(
                     "POST", port, "/api/generate-text",
-                    {"task_id": f"lm-{i}", "prompt": "seed", "max_length": 6}))
+                    {"task_id": f"lm-{i}", "prompt": "seed", "max_length": 6,
+                     "stream": True}))
                 assert status == 200
             await asyncio.wait_for(reader_task, timeout=20)
-            assert {e["original_task_id"] for e in sse_events} == {
-                "lm-0", "lm-1", "lm-2"}
-            assert all(isinstance(e["generated_text"], str) for e in sse_events)
+            # per-request streaming (stream=true): the SSE channel carries
+            # chunk deltas and final messages; per task, deltas
+            # concatenated == the final generated_text
+            finals = {e["original_task_id"]: e["generated_text"]
+                      for e in sse_events if "generated_text" in e}
+            assert set(finals) == {"lm-0", "lm-1", "lm-2"}
+            for tid, full in finals.items():
+                deltas = [e for e in sse_events
+                          if e.get("original_task_id") == tid
+                          and "text_delta" in e]
+                assert deltas, f"no stream chunks for {tid}"
+                assert deltas[-1]["done"] is True
+                assert "".join(d["text_delta"] for d in deltas) == full
+                assert [d["seq"] for d in deltas] == list(range(len(deltas)))
         finally:
             await stack.stop()
 

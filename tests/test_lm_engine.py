@@ -175,3 +175,64 @@ def test_gen_batcher_batches_concurrent_requests():
     results = asyncio.run(scenario())
     assert results == singles
     assert eng.stats["generate_calls"] == calls_before + 1  # one batch
+
+
+def test_generate_stream_greedy_matches_generate():
+    """Concatenated stream deltas == generate()'s full text (greedy), and
+    deltas arrive in multiple chunks for a multi-chunk request."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[16],
+                            temperature=0.0, stream_chunk=4))
+    full = eng.generate("hello", 16, temperature=0.0)
+    deltas = list(eng.generate_stream("hello", 16, temperature=0.0))
+    assert "".join(deltas) == full
+    assert len(deltas) > 1  # actually streamed, not one blob
+
+
+def test_generate_stream_respects_max_new():
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8],
+                            temperature=0.0, stream_chunk=8))
+    text = "".join(eng.generate_stream("x", 3, temperature=0.0))
+    assert len(text.encode()) <= 3  # byte tokenizer: 1 byte per token
+
+
+def test_incremental_decoder_multibyte_straddle():
+    """A multi-byte UTF-8 char split across chunks must not leak a
+    replacement char into the stream: the unstable tail is held back and the
+    concatenated deltas equal the full decode exactly."""
+    from symbiont_tpu.engine.lm import ByteTokenizer, IncrementalDecoder
+
+    tok = ByteTokenizer()
+    # "héllo" = 68 c3 a9 6c 6c 6f — split between c3 and a9
+    full = list("héllo".encode("utf-8"))
+    d = IncrementalDecoder(tok)
+    out = d.push(full[:2])       # ends mid-'é' → 'h' only, ufffd held back
+    assert out == "h"
+    out += d.push(full[:4])      # 'é' completed + 'l'
+    out += d.push(full)
+    out += d.flush(full)
+    assert out == "héllo"
+    assert "�" not in out
+
+
+def test_incremental_decoder_genuine_invalid_bytes():
+    """Genuinely invalid bytes DO surface (at flush), they are not eaten."""
+    from symbiont_tpu.engine.lm import ByteTokenizer, IncrementalDecoder
+
+    tok = ByteTokenizer()
+    toks = list(b"ok\xc3")  # dangling lead byte, never completed
+    d = IncrementalDecoder(tok)
+    out = d.push(toks)
+    out += d.flush(toks)
+    assert out == "ok�"

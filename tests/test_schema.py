@@ -11,6 +11,7 @@ import pytest
 
 from symbiont_tpu import schema
 from symbiont_tpu.schema import (
+    GeneratedTextChunk,
     GeneratedTextMessage,
     GenerateTextTask,
     PerceiveUrlTask,
@@ -74,6 +75,8 @@ CASES = [
         error_message=None),
     SemanticSearchApiResponse(search_request_id="r-1", results=[],
                               error_message="nothing found"),
+    GeneratedTextChunk(original_task_id="t-1", text_delta="hello ",
+                       seq=3, done=False, timestamp_ms=1718000000000),
 ]
 
 
@@ -87,8 +90,10 @@ def test_round_trip(msg):
 
 
 def test_all_thirteen_types_registered():
-    # parity check against reference: libs/shared_models/src/lib.rs declares 13
-    assert len(schema.WIRE_TYPES) == 13 + 2  # +SentenceEmbedding nested types
+    # parity check against reference: libs/shared_models/src/lib.rs declares
+    # 13 (+2 nested); GeneratedTextChunk is this framework's streaming
+    # addition
+    assert len(schema.WIRE_TYPES) == 13 + 2 + 1
     names = {t.__name__ for t in schema.WIRE_TYPES}
     assert {
         "PerceiveUrlTask", "RawTextMessage", "TokenizedTextMessage",
@@ -97,6 +102,7 @@ def test_all_thirteen_types_registered():
         "QueryForEmbeddingTask", "QueryEmbeddingResult", "QdrantPointPayload",
         "SemanticSearchNatsTask", "SemanticSearchResultItem",
         "SemanticSearchNatsResult", "SemanticSearchApiResponse",
+        "GeneratedTextChunk",
     } == names
 
 
