@@ -151,6 +151,16 @@ class ApiConfig:
 
 
 @dataclass
+class TextGeneratorConfig:
+    """Markov-backend persistence (SURVEY.md §5.4): the reference rebuilds
+    its chain from one hardcoded sentence at every boot, losing all learned
+    state (reference: text_generator_service/src/main.rs:169-173). Here the
+    chain persists across restarts; None disables."""
+
+    markov_state_path: Optional[str] = "data/markov_state.json"
+
+
+@dataclass
 class PerceptionConfig:
     scrape_timeout_s: float = 15.0  # reference: perception_service/src/main.rs:89-91
     user_agent: str = "SymbiontTPU/0.1 (+research crawler)"
@@ -185,6 +195,8 @@ class SymbiontConfig:
     vector_store: VectorStoreConfig = field(default_factory=VectorStoreConfig)
     graph_store: GraphStoreConfig = field(default_factory=GraphStoreConfig)
     api: ApiConfig = field(default_factory=ApiConfig)
+    text_generator: TextGeneratorConfig = field(
+        default_factory=TextGeneratorConfig)
     perception: PerceptionConfig = field(default_factory=PerceptionConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runner: RunnerConfig = field(default_factory=RunnerConfig)

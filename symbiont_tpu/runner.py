@@ -166,7 +166,9 @@ class SymbiontStack:
             self.services.append(
                 TextGeneratorService(self.bus, lm_batcher=lm_batcher,
                                      lm_stream=lm_stream,
-                                     train_on_ingest=lm_batcher is None))
+                                     train_on_ingest=lm_batcher is None,
+                                     state_path=(cfg.text_generator
+                                                 .markov_state_path)))
         if on("engine"):
             from symbiont_tpu.services.engine_service import EngineService
 

@@ -44,10 +44,20 @@ class TextGeneratorService(Service):
     name = "text_generator"
 
     def __init__(self, bus, lm_generate=None, lm_batcher=None, lm_stream=None,
-                 train_on_ingest: bool = True):
+                 train_on_ingest: bool = True, state_path=None):
         super().__init__(bus)
-        self.markov = MarkovModel()
-        self.markov.train(SEED_CORPUS)
+        # persistence (SURVEY.md §5.4): restore the learned chain; the
+        # reference rebuilds from one constant at every boot (main.rs:169-173)
+        self._state_path = state_path
+        self._dirty = False
+        self._last_save = 0.0
+        restored = self._load_state()
+        if restored is not None:
+            self.markov = restored  # seed transitions already in the chain —
+            # re-training them would double-count into the multiset weights
+        else:
+            self.markov = MarkovModel()
+            self.markov.train(SEED_CORPUS)
         self.lm_generate = lm_generate  # Callable[[str, int], str] | None
         self.lm_batcher = lm_batcher  # GenBatcher | None (batches concurrent
         #                               requests into one decode)
@@ -70,6 +80,67 @@ class TextGeneratorService(Service):
         raw = from_json(RawTextMessage, msg.data)
         self.markov.train(raw.raw_text)
         metrics.inc("text_generator.trained_docs")
+        self._dirty = True
+        await self._maybe_save()
+
+    async def stop(self) -> None:
+        await super().stop()
+        await self._maybe_save(force=True)  # flush unsaved learning
+
+    # ------------------------------------------------- markov persistence
+
+    def _load_state(self):
+        if not self._state_path:
+            return None
+        import json
+        from pathlib import Path
+
+        try:
+            raw = Path(self._state_path).read_text(encoding="utf-8")
+        except OSError:
+            return None  # first boot
+        try:
+            model = MarkovModel.from_state(json.loads(raw))
+            log.info("markov state restored from %s (%d chain keys)",
+                     self._state_path, len(model.chain))
+            return model
+        except Exception:
+            log.exception("corrupt markov state at %s; starting fresh",
+                          self._state_path)
+            return None
+
+    async def _maybe_save(self, force: bool = False) -> None:
+        """Debounced persist: at most one save per window (per-doc O(chain)
+        serialization would make cumulative ingest cost quadratic), JSON dump
+        + file I/O in an executor so the event loop never stalls behind a
+        large chain. The snapshot is copied on the loop first — the chain
+        mutates between handler awaits."""
+        import time
+
+        if not self._state_path or not self._dirty:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_save < 2.0:
+            return
+        state = self.markov.to_state()
+        snapshot = {"chain": {k: list(v) for k, v in state["chain"].items()},
+                    "starters": list(state["starters"])}
+        self._dirty = False
+        self._last_save = now
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write_state, snapshot)
+
+    def _write_state(self, snapshot: dict) -> None:
+        import json
+        import os
+        from pathlib import Path
+
+        path = Path(self._state_path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(snapshot, ensure_ascii=False),
+                       encoding="utf-8")
+        os.replace(tmp, path)  # atomic: a crash never leaves a torn file
 
     async def _handle_generate(self, msg: Msg) -> None:
         task = from_json(GenerateTextTask, msg.data)

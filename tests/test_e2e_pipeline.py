@@ -19,6 +19,7 @@ from symbiont_tpu.config import (
     EngineConfig,
     GraphStoreConfig,
     SymbiontConfig,
+    TextGeneratorConfig,
     VectorStoreConfig,
 )
 from symbiont_tpu.engine.engine import TpuEngine
@@ -54,6 +55,8 @@ def stack_config(tmp_path):
         vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
                                        shard_capacity=64),
         graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
         api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
     )
 
@@ -378,6 +381,8 @@ def test_lm_backend_generate_roundtrip(tmp_path):
         vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
                                        shard_capacity=64),
         graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
         api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
     )
 

@@ -153,6 +153,7 @@ def test_full_stack_over_external_qdrant(fake_qdrant, tmp_path):
         EngineConfig,
         GraphStoreConfig,
         SymbiontConfig,
+        TextGeneratorConfig,
     )
     from symbiont_tpu.runner import SymbiontStack
     from tests.test_e2e_pipeline import _fake_fetcher, _http, _wait_until
@@ -164,6 +165,8 @@ def test_full_stack_over_external_qdrant(fake_qdrant, tmp_path):
                             data_parallel=False, flush_deadline_ms=2.0),
         vector_store=_cfg(uri, dim=32),
         graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
         # external corpus → no fused subject served; skip the probe
         api=ApiConfig(host="127.0.0.1", port=0, fused_search=False),
     )

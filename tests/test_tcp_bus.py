@@ -169,7 +169,8 @@ def test_full_stack_over_native_broker(broker, tmp_path):
     in-proc bus — multi-transport parity for the pipeline."""
     from tests.test_e2e_pipeline import _fake_fetcher, _http
     from symbiont_tpu.config import (ApiConfig, EngineConfig, GraphStoreConfig,
-                                     SymbiontConfig, VectorStoreConfig)
+                                     SymbiontConfig, TextGeneratorConfig,
+                                     VectorStoreConfig)
     from symbiont_tpu.runner import SymbiontStack
 
     cfg = SymbiontConfig(
@@ -179,6 +180,8 @@ def test_full_stack_over_native_broker(broker, tmp_path):
         vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
                                        shard_capacity=64),
         graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
         api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5),
     )
     cfg.bus.url = f"symbus://127.0.0.1:{broker}"
