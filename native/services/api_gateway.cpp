@@ -279,6 +279,7 @@ struct Config {
   int embed_timeout_ms;
   int search_timeout_ms;
   int rerank_timeout_ms;
+  int health_timeout_ms;
   bool fused_search;
   int fused_timeout_ms;
   int fused_down_ms;
@@ -540,6 +541,37 @@ std::pair<int, std::string> route_semantic_search(const std::string& body) {
   return finish_search(bus, req, resp, trace);
 }
 
+std::string health_err_json(const std::string& message) {
+  json::Value o = json::Value::object();  // proper escaping for any message
+  o.set("ok", json::Value(false));
+  o.set("error_message", json::Value(message));
+  return o.dump();
+}
+
+std::pair<int, std::string> route_engine_health() {
+  // engine-plane health over HTTP (Python-twin parity): one bus round-trip
+  // to engine.health; 503 when no engine plane answers
+  symbus::Client bus;
+  if (!fresh_bus(bus)) return {503, health_err_json("bus unavailable")};
+  auto reply = bus.request(symbiont::subjects::ENGINE_HEALTH, "{}",
+                           g_cfg.health_timeout_ms,
+                           symbiont::child_headers({}));
+  if (!reply) return {503, health_err_json("engine plane unreachable")};
+  try {
+    json::Value v = json::parse(reply->data);
+    if (!v.is_object()) throw std::runtime_error("not an object");
+    if (v.has("error_message") && !v.at("error_message").is_null()) {
+      // the health op itself failed — surface as unhealthy, not 200
+      if (!v.has("ok")) v.set("ok", json::Value(false));
+      return {500, v.dump()};
+    }
+    return {200, v.dump()};
+  } catch (const std::exception& e) {
+    return {500, health_err_json(std::string("bad engine health reply: ")
+                                 + e.what())};
+  }
+}
+
 // --------------------------------------------------------------------- sse
 
 void serve_sse(int fd, const HttpRequest& req) {
@@ -654,6 +686,8 @@ void handle_connection(int fd) {
     } else if (req.method == "GET" && req.path == "/healthz") {
       status = 200;
       body = "{\"status\": \"ok\"}";
+    } else if (req.method == "GET" && req.path == "/api/health/engine") {
+      std::tie(status, body) = route_engine_health();
     } else {
       g_metrics.inc("api.unmatched");
       body = msg_json("not found");
@@ -684,6 +718,8 @@ int main() {
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_SEARCH_S", "20").c_str()));
   g_cfg.rerank_timeout_ms = (int)(1000 * std::atof(
       symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_RERANK_S", "10").c_str()));
+  g_cfg.health_timeout_ms = (int)(1000 * std::atof(
+      symbiont::env_or("SYMBIONT_BUS_REQUEST_TIMEOUT_HEALTH_S", "5").c_str()));
   {
     std::string fused = symbiont::env_or("SYMBIONT_API_FUSED_SEARCH", "true");
     g_cfg.fused_search = (fused != "false" && fused != "0" && fused != "no");

@@ -40,6 +40,7 @@ inline const char* ENGINE_VECTOR_UPSERT = "engine.vector.upsert";
 inline const char* ENGINE_VECTOR_SEARCH = "engine.vector.search";
 inline const char* ENGINE_QUERY_SEARCH = "engine.query.search";
 inline const char* ENGINE_GRAPH_SAVE = "engine.graph.save";
+inline const char* ENGINE_HEALTH = "engine.health";
 inline const char* Q_PERCEPTION = "q.perception";
 inline const char* Q_PREPROCESSING = "q.preprocessing";
 inline const char* Q_VECTOR_MEMORY = "q.vector_memory";

@@ -34,6 +34,8 @@ class BusConfig:
     request_timeout_search_s: float = 20.0  # reference: api_service/src/main.rs:430
     # rerank hop (our addition — the reference has no rerank stage)
     request_timeout_rerank_s: float = 10.0
+    # engine.health hop behind GET /api/health/engine (our addition)
+    request_timeout_health_s: float = 5.0
     # at-least-once pipeline: durable streams on the native broker (SURVEY.md
     # §5.3 — the reference's core NATS silently loses in-flight work). Only
     # effective on symbus:// transports; the in-proc bus stays at-most-once.

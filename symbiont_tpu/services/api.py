@@ -266,6 +266,8 @@ class ApiService:
                 return 200, json.dumps(metrics.snapshot())
             if path == "/healthz" and method == "GET":
                 return 200, json.dumps({"status": "ok"})
+            if path == "/api/health/engine" and method == "GET":
+                return await self._engine_health()
             # one bucket for everything unmatched: arbitrary scanner paths
             # must not create unbounded counter cardinality
             metrics.inc("api.unmatched")
@@ -365,6 +367,33 @@ class ApiService:
             if req.rerank and results:
                 return await self._apply_rerank(req, results, resp, trace)
             return 200, resp(results)
+
+    async def _engine_health(self) -> Tuple[int, str]:
+        """Engine-plane health over HTTP: one bus round-trip to
+        engine.health (backends map, model, stats, vector count) so
+        operators see the whole deployment from the gateway. 503 when no
+        engine plane answers."""
+        try:
+            reply = await self.bus.request(
+                subjects.ENGINE_HEALTH, b"{}",
+                timeout=self.bus_config.request_timeout_health_s,
+                headers=new_trace_headers())
+        except TimeoutError:
+            return 503, json.dumps(
+                {"ok": False, "error_message": "engine plane unreachable"})
+        try:
+            body = json.loads(reply.data)
+            if not isinstance(body, dict):
+                raise ValueError("not an object")
+        except ValueError as e:
+            return 500, json.dumps(
+                {"ok": False, "error_message": f"bad engine health reply: {e}"})
+        if body.get("error_message"):
+            # the health op itself failed (e.g. external store down) — a
+            # status-based monitor must see that as unhealthy, not 200
+            body.setdefault("ok", False)
+            return 500, json.dumps(body)
+        return 200, json.dumps(body)
 
     async def _fused_search(self, req: SemanticSearchApiRequest, trace):
         """Try the fused embed+top-k engine hop (one device round-trip).

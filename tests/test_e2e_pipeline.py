@@ -213,6 +213,11 @@ def test_api_validation_parity(stack_config):
             assert status == 200 and "counters" in body
             status, body = await http("GET", port, "/healthz")
             assert status == 200 and body["status"] == "ok"
+            # engine-plane health over HTTP (one bus hop to engine.health)
+            status, body = await http("GET", port, "/api/health/engine")
+            assert status == 200 and body["ok"] is True
+            assert body["backends"]["embed"] is True
+            assert "vector_count" in body
             # bundled UI at GET / (executor: urlopen must not block the loop
             # the server runs on)
             def fetch_root():
@@ -241,7 +246,8 @@ def test_search_timeout_maps_to_503(stack_config):
         bus = InprocBus()
         api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0,
                                         fused_search=False),
-                         BusConfig(request_timeout_embed_s=0.2))
+                         BusConfig(request_timeout_embed_s=0.2,
+                                   request_timeout_health_s=0.2))
         await api.start()
         loop = asyncio.get_running_loop()
         try:
@@ -250,6 +256,10 @@ def test_search_timeout_maps_to_503(stack_config):
                                     {"query_text": "q", "top_k": 1}))
             assert status == 503
             assert "Failed to get embedding" in body["error_message"]
+            # engine health with no engine plane → 503, not a hang
+            status, body = await loop.run_in_executor(
+                None, lambda: _http("GET", api.port, "/api/health/engine"))
+            assert status == 503 and body["ok"] is False
         finally:
             await api.stop()
 

@@ -408,6 +408,10 @@ def test_native_api_gateway_full_stack(broker):
                 # healthz + validation parity
                 status, body, _ = await hx("GET", "/healthz")
                 assert (status, body) == (200, {"status": "ok"})
+                # engine-plane health through the C++ gateway
+                status, body, _ = await hx("GET", "/api/health/engine")
+                assert status == 200 and body["ok"] is True
+                assert body["backends"]["embed"] is True
 
                 # bundled UI at GET /
                 c = http_client.HTTPConnection("127.0.0.1", api_port, timeout=30)
