@@ -24,6 +24,8 @@
 //
 // Usage: api_gateway [SYMBIONT_BUS_URL=...] [SYMBIONT_API_HOST/PORT=...]
 
+#include <cerrno>
+#include <cstdlib>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
@@ -126,7 +128,10 @@ struct HttpRequest {
   std::string body;
 };
 
-bool read_http_request(int fd, HttpRequest& req, int timeout_ms) {
+// On malformed/oversized requests that deserve an HTTP status (rather than a
+// silent close), *err_status is set to 400/413 and false is returned.
+bool read_http_request(int fd, HttpRequest& req, int timeout_ms,
+                       int* err_status = nullptr) {
   std::string buf;
   char chunk[16384];
   size_t header_end = std::string::npos;
@@ -167,16 +172,42 @@ bool read_http_request(int fd, HttpRequest& req, int timeout_ms) {
       for (auto& c : k) c = (char)std::tolower((unsigned char)c);
       std::string v = line.substr(colon + 1);
       size_t b = v.find_first_not_of(" \t");
-      req.headers[k] = b == std::string::npos ? "" : v.substr(b);
+      size_t e = v.find_last_not_of(" \t");
+      req.headers[k] = b == std::string::npos ? "" : v.substr(b, e - b + 1);
     }
     pos = eol + 2;
   }
 
   long long announced = 0;
   auto cl = req.headers.find("content-length");
-  if (cl != req.headers.end()) announced = std::atoll(cl->second.c_str());
+  if (cl != req.headers.end() && !cl->second.empty()) {
+    // Python-twin parity: empty value == no body; otherwise strictly numeric
+    const std::string& v = cl->second;
+    size_t i = (v[0] == '-' || v[0] == '+') ? 1 : 0;
+    bool numeric = v.size() > i;
+    for (size_t j = i; j < v.size(); ++j)
+      if (!std::isdigit((unsigned char)v[j])) numeric = false;
+    if (!numeric) {
+      if (err_status) *err_status = 400;
+      return false;
+    }
+    errno = 0;
+    announced = std::strtoll(v.c_str(), nullptr, 10);
+    if (errno == ERANGE) {
+      // out-of-range value must not silently wrap and mis-frame the body
+      if (err_status) *err_status = (v[0] == '-') ? 400 : 413;
+      return false;
+    }
+  }
   // cap the client-supplied length: negative wraps and huge values OOM
-  if (announced < 0 || announced > 16 * 1024 * 1024) return false;
+  if (announced < 0) {
+    if (err_status) *err_status = 400;
+    return false;
+  }
+  if (announced > 16 * 1024 * 1024) {
+    if (err_status) *err_status = 413;
+    return false;
+  }
   size_t want = (size_t)announced;
   while (req.body.size() < want) {
     int wait = (int)(deadline - (int64_t)symbiont::now_ms());
@@ -232,6 +263,7 @@ void write_response(int fd, int status, const std::string& body,
   const char* reason = status == 200   ? "OK"
                        : status == 400 ? "Bad Request"
                        : status == 404 ? "Not Found"
+                       : status == 413 ? "Payload Too Large"
                        : status == 503 ? "Service Unavailable"
                                        : "Internal Server Error";
   std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
@@ -283,6 +315,7 @@ struct Config {
   bool fused_search;
   int fused_timeout_ms;
   int fused_down_ms;
+  int fused_max_top_k;
 };
 
 Config g_cfg;
@@ -451,9 +484,14 @@ std::pair<int, std::string> route_semantic_search(const std::string& body) {
     return {503, resp.to_json_string()};
   }
 
-  if (g_cfg.fused_search && steady_now_ms() >= g_fused_down_until_ms.load()) {
-    // fused embed+top-k engine hop: one bus hop, one device round-trip;
-    // timeout or malformed reply falls back to the 2-hop orchestration
+  if (g_cfg.fused_search &&
+      req.top_k <= (uint64_t)std::max(0, g_cfg.fused_max_top_k) &&
+      steady_now_ms() >= g_fused_down_until_ms.load()) {
+    // fused embed+top-k engine hop (pre-warmed for the k<=16 buckets only —
+    // a larger k would pay a cold XLA compile inside the probe timeout and
+    // trip the negative cache for everyone): one bus hop, one device
+    // round-trip; timeout or malformed reply falls back to the 2-hop
+    // orchestration
     json::Value fq = json::Value::object();
     fq.set("text", json::Value(req.query_text));
     fq.set("top_k", json::Value((double)req.top_k));
@@ -643,7 +681,30 @@ void sse_bridge() {
 void handle_connection(int fd) {
   for (;;) {
     HttpRequest req;
-    if (!read_http_request(fd, req, 30000)) break;
+    int err_status = 0;
+    if (!read_http_request(fd, req, 30000, &err_status)) {
+      if (err_status) {
+        // Python-twin parity: a bad/oversized Content-Length gets a status,
+        // not a dropped socket; drain (bounded) so the close doesn't RST
+        // the queued response away from a mid-upload client
+        const char* msg = err_status == 413 ? "request body exceeds 16MB limit"
+                                            : "invalid Content-Length";
+        write_response(fd, err_status,
+                       std::string("{\"status\":\"error\",\"message\":\"") +
+                           msg + "\"}",
+                       req.headers, false);
+        char sink[16384];
+        int64_t drain_deadline = (int64_t)symbiont::now_ms() + 1000;
+        for (int i = 0; i < 64; ++i) {
+          int wait = (int)(drain_deadline - (int64_t)symbiont::now_ms());
+          if (wait <= 0) break;
+          struct pollfd p {fd, POLLIN, 0};
+          if (::poll(&p, 1, wait) <= 0) break;
+          if (::recv(fd, sink, sizeof(sink), 0) <= 0) break;
+        }
+      }
+      break;
+    }
     bool keep_alive = true;
     auto conn = req.headers.find("connection");
     if (conn != req.headers.end()) {
@@ -728,6 +789,8 @@ int main() {
       symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_TIMEOUT_S", "5").c_str()));
   g_cfg.fused_down_ms = (int)(1000 * std::atof(
       symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_DOWN_S", "60").c_str()));
+  g_cfg.fused_max_top_k = std::atoi(
+      symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_MAX_TOP_K", "16").c_str());
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) return 1;

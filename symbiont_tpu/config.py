@@ -105,6 +105,18 @@ class LmConfig:
     # this many tokens, emitting a text delta per chunk; 0 disables streaming
     stream_chunk: int = 16
 
+    def __post_init__(self) -> None:
+        # the streaming decode loop runs whole chunks against a KV cache with
+        # exactly new_bucket decode slots — a non-dividing chunk would scan
+        # past the cache and rely on dynamic_update_slice clamp semantics
+        if self.stream_chunk > 0:
+            bad = [b for b in self.new_token_buckets
+                   if b > self.stream_chunk and b % self.stream_chunk]
+            if bad:
+                raise ValueError(
+                    f"stream_chunk={self.stream_chunk} must divide every "
+                    f"new_token_bucket larger than it; offending buckets: {bad}")
+
 
 @dataclass
 class VectorStoreConfig:
@@ -119,6 +131,11 @@ class VectorStoreConfig:
     data_dir: str = "data/vector_store"
     device_resident: bool = True  # corpus matrix lives in TPU HBM
     shard_capacity: int = 65536  # rows per device-resident block
+    # warm_fused pre-compiles the fused embed+top-k executables for every
+    # power-of-two k bucket up to this value. Must cover the gateway's
+    # ApiConfig.fused_search_max_top_k (default 16) — a fused query in an
+    # unwarmed bucket pays a cold XLA compile inside the probe timeout
+    warm_top_k: int = 16
 
 
 @dataclass
@@ -150,6 +167,12 @@ class ApiConfig:
     # after a fused timeout, skip the fused probe for this long (the subject
     # is unserved when engine and store are not co-located)
     fused_search_down_s: float = 60.0
+    # fused serves the interactive small-k range its executables are
+    # pre-warmed for; larger top_k goes straight to the 2-hop path instead
+    # of paying a cold XLA compile inside the probe timeout and tripping the
+    # negative cache. Raise together with VectorStoreConfig.warm_top_k —
+    # the engine warms every power-of-two k bucket up to that value
+    fused_search_max_top_k: int = 16
 
 
 @dataclass

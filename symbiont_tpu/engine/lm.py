@@ -95,7 +95,10 @@ class IncrementalDecoder:
     incremental UTF-8 decoding) and only ever emits a confirmed-stable
     prefix; flush() emits the remainder, replacement chars included if the
     model genuinely produced invalid bytes. Concatenated deltas == the full
-    decode, always."""
+    decode whenever decode is prefix-stable (true for byte/BPE tokenizers);
+    if a tokenizer's decode rewrites earlier output (e.g. decode-time
+    cleanup), flush still emits everything past the longest common prefix —
+    the tail is never lost, but earlier deltas are not retracted."""
 
     def __init__(self, tokenizer):
         self._tok = tokenizer
@@ -114,7 +117,19 @@ class IncrementalDecoder:
         return self._delta_to(stable)
 
     def flush(self, all_tokens) -> str:
-        return self._delta_to(self._tok.decode(all_tokens))
+        text = self._tok.decode(all_tokens)
+        if text.startswith(self._emitted):
+            return self._delta_to(text)
+        # non-prefix-stable decode (e.g. decode-time whitespace cleanup):
+        # emit the suffix past the longest common prefix so the terminal
+        # output is never silently lost
+        i = 0
+        for a, b in zip(self._emitted, text):
+            if a != b:
+                break
+            i += 1
+        self._emitted = text
+        return text[i:]
 
 
 class LmEngine:

@@ -58,6 +58,7 @@ class VectorStore:
         self._device_rows = 0  # rows valid in the device copy
         self._dirty = True
         self._search_fns: dict = {}
+        self._warmed_capacity = None  # capacity warm_fused last compiled for
         self._wal_file = None
         if self.config.data_dir:
             Path(self.config.data_dir).mkdir(parents=True, exist_ok=True)
@@ -244,21 +245,42 @@ class VectorStore:
         with self._lock:
             return self._hits_from(scores, idx, top_k)
 
-    def warm_fused(self, engine, word_counts: Sequence[int] = (3, 40, 150)
-                   ) -> None:
+    def warm_fused(self, engine, word_counts: Sequence[int] = (3, 40, 150),
+                   top_ks: Optional[Sequence[int]] = None) -> None:
         """Pre-compile the fused embed+top-k executables for the store's
         CURRENT capacity across the engine's query length buckets — including
         an empty store (capacity is the first block, which the first
         shard_capacity upserts keep). Without this, the first fused query per
         (length-bucket, capacity) pays the full XLA compile inside the
-        gateway's short probe timeout."""
+        gateway's short probe timeout. Warms every power-of-two k bucket up
+        to config.warm_top_k (default 8 and 16) — the gateways route only
+        top_k ≤ ApiConfig.fused_search_max_top_k to the fused path, and the
+        two knobs must move together — and records the warmed capacity so
+        callers can re-warm when upserts cross a capacity block
+        (fused_warm_stale)."""
+        if top_ks is None:
+            top_ks = [8]
+            while top_ks[-1] < self.config.warm_top_k:
+                top_ks.append(top_ks[-1] * 2)
         with self._lock:
             self._sync_device()
             corpus = self._device_corpus
             n = len(self._ids)
-            k = self._k_static(8, max(n, 8), corpus.shape[0])
-        for wc in word_counts:
-            engine.embed_and_search("warm " * wc, corpus, n, k)
+            ks = sorted({self._k_static(k, max(n, k), corpus.shape[0])
+                         for k in top_ks})
+        for k in ks:
+            for wc in word_counts:
+                engine.embed_and_search("warm " * wc, corpus, n, k)
+        with self._lock:
+            self._warmed_capacity = corpus.shape[0]
+
+    def fused_warm_stale(self) -> bool:
+        """True when upserts have crossed a capacity block since the last
+        warm_fused — the next fused query would pay a fresh XLA compile, so
+        the owner should re-run warm_fused in the background."""
+        with self._lock:
+            return (self._warmed_capacity is not None
+                    and self._capacity(len(self._ids)) != self._warmed_capacity)
 
     # --------------------------------------------------------- persistence
 

@@ -52,6 +52,20 @@ import re
 _ORIGIN_RE = re.compile(r"^https?://(localhost|127\.0\.0\.1)(:\d+)?$")
 
 
+class _HttpError(Exception):
+    """Malformed/oversized request — answered with a status, then close.
+
+    Carries the request Origin (headers are parsed before the body checks
+    fire) so the error response gets CORS headers — a browser client must be
+    able to read the 413/400, same as the C++ twin."""
+
+    def __init__(self, status: int, message: str, origin: Optional[str] = None):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+        self.origin = origin
+
+
 class _SseHub:
     """Bounded broadcast: capacity-32 queues, drop-on-lag with a warning
     (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209)."""
@@ -154,7 +168,31 @@ class ApiService:
                            writer: asyncio.StreamWriter) -> None:
         try:
             while True:
-                request = await self._read_request(reader)
+                try:
+                    request = await self._read_request(reader)
+                except _HttpError as e:
+                    # a well-behaved client gets a status, not a dropped
+                    # socket (reference error-shape conventions)
+                    await self._write_response(
+                        writer, e.status,
+                        json.dumps({"status": "error", "message": e.message}),
+                        origin=e.origin, keep_alive=False)
+                    # discard any in-flight body (bounded) before closing:
+                    # an immediate close with unread bytes pending RSTs the
+                    # socket and can destroy the queued response client-side
+                    try:
+                        deadline = asyncio.get_running_loop().time() + 1.0
+                        for _ in range(64):
+                            left = deadline - asyncio.get_running_loop().time()
+                            if left <= 0:
+                                break
+                            chunk = await asyncio.wait_for(
+                                reader.read(65536), left)
+                            if not chunk:
+                                break
+                    except (asyncio.TimeoutError, OSError):
+                        pass
+                    break
                 if request is None:
                     break
                 method, path, headers, body = request
@@ -209,14 +247,17 @@ class ApiService:
                 k, _, v = h.decode("latin-1").partition(":")
                 headers[k.strip().lower()] = v.strip()
         body = b""
+        origin = headers.get("origin")
         try:
             n = int(headers.get("content-length", 0) or 0)
         except ValueError:
-            return None
+            raise _HttpError(400, "invalid Content-Length", origin)
         # C++ twin parity (api_gateway.cpp): cap the client-supplied length —
         # negative wraps and huge values would OOM the process
-        if n < 0 or n > 16 * 1024 * 1024:
-            return None
+        if n < 0:
+            raise _HttpError(400, "invalid Content-Length", origin)
+        if n > 16 * 1024 * 1024:
+            raise _HttpError(413, "request body exceeds 16MB limit", origin)
         if n:
             body = await reader.readexactly(n)
         return method, path.split("?")[0], headers, body
@@ -235,8 +276,8 @@ class ApiService:
                               content_type: str = "application/json",
                               keep_alive: bool = True) -> None:
         reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                   405: "Method Not Allowed", 500: "Internal Server Error",
-                   503: "Service Unavailable"}
+                   405: "Method Not Allowed", 413: "Payload Too Large",
+                   500: "Internal Server Error", 503: "Service Unavailable"}
         body = payload.encode("utf-8")
         head = (f"HTTP/1.1 {status} {reasons.get(status, 'OK')}\r\n"
                 f"Content-Type: {content_type}\r\n"
@@ -407,6 +448,12 @@ class ApiService:
         from symbiont_tpu.schema import QdrantPointPayload, SemanticSearchResultItem
 
         if _time.monotonic() < self._fused_down_until:
+            return None
+        if req.top_k > self.config.fused_search_max_top_k:
+            # fused executables are pre-warmed for the k≤16 buckets only; a
+            # larger k would pay a cold XLA compile inside the probe timeout
+            # AND trip the negative cache for everyone — take the 2-hop path
+            metrics.inc("api.fused_search_skipped_large_k")
             return None
         try:
             reply = await self.bus.request(

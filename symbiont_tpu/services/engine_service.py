@@ -61,28 +61,53 @@ class EngineService(Service):
         self.vector_store = vector_store
         self.graph_store = graph_store
         self._warm_task: Optional[asyncio.Task] = None
+        self._warm_failed = False  # last warm errored → next upsert retries
 
     async def start(self) -> None:
         if self.batcher:
             await self.batcher.start()
         await super().start()
-        if (self.engine is not None and self.vector_store is not None
-                and getattr(self.vector_store, "supports_fused", False)):
-            # background-compile the fused query executables for the store's
-            # current capacity across the query length buckets (works for an
-            # empty store too — capacity is the first block), so interactive
-            # queries don't eat the 20-40s TPU compile inside the gateway's
-            # probe timeout. Queries arriving mid-warmup fall back to the
-            # 2-hop path; the store lock is never held across a compile.
-            async def warm() -> None:
-                try:
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, self.vector_store.warm_fused, self.engine)
-                    log.info("fused query executables warmed")
-                except Exception:
-                    log.exception("fused warmup failed (non-fatal)")
+        self._spawn_fused_warm()
 
-            self._warm_task = asyncio.create_task(warm(), name="fused-warmup")
+    def _fused_enabled(self) -> bool:
+        return (self.engine is not None and self.vector_store is not None
+                and getattr(self.vector_store, "supports_fused", False))
+
+    def _spawn_fused_warm(self) -> None:
+        """Background-compile the fused query executables for the store's
+        current capacity across the query length buckets (works for an empty
+        store too — capacity is the first block), so interactive queries
+        don't eat the 20-40s TPU compile inside the gateway's probe timeout.
+        Queries arriving mid-warmup fall back to the 2-hop path; the store
+        lock is never held across a compile. Re-invoked when upserts cross a
+        capacity block (the executables are capacity-keyed)."""
+        if not self._fused_enabled():
+            return
+        if self._warm_task is not None and not self._warm_task.done():
+            return  # one warmup at a time; stale check re-fires after it
+        self._warm_failed = False
+
+        async def warm() -> None:
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(
+                    None, self.vector_store.warm_fused, self.engine)
+                log.info("fused query executables warmed")
+            except Exception:
+                log.exception("fused warmup failed (non-fatal)")
+                self._warm_failed = True  # next upsert retries
+                return
+            # an upsert may have crossed a capacity block while this warm
+            # was compiling (spawn attempts during a live warm are no-ops) —
+            # re-check so the stale window closes without waiting for the
+            # next upsert. Executor: the staleness check takes the store
+            # lock, which a concurrent device sync can hold for a while.
+            if await loop.run_in_executor(
+                    None, self.vector_store.fused_warm_stale):
+                self._warm_task = None
+                self._spawn_fused_warm()
+
+        self._warm_task = asyncio.create_task(warm(), name="fused-warmup")
 
     async def stop(self) -> None:
         if self._warm_task is not None:
@@ -199,6 +224,15 @@ class EngineService(Service):
             points = [(p["id"], p["vector"], p.get("payload", {}))
                       for p in req["points"]]
             n = await self._run_blocking(self.vector_store.upsert, points)
+            if self._fused_enabled() and (
+                    self._warm_failed or await self._run_blocking(
+                        self.vector_store.fused_warm_stale)):
+                # upserts crossed a capacity block (or the last warm failed):
+                # the fused executables are keyed by capacity, so the next
+                # query would pay a fresh XLA compile — re-warm in the
+                # background before it arrives. Executor: the staleness check
+                # takes the store lock (see _spawn_fused_warm)
+                self._spawn_fused_warm()
             return {"upserted": n}
         await self._handle(msg, "vector.upsert", op)
 

@@ -127,8 +127,15 @@ class TextGeneratorService(Service):
                     "starters": list(state["starters"])}
         self._dirty = False
         self._last_save = now
-        await asyncio.get_running_loop().run_in_executor(
-            None, self._write_state, snapshot)
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._write_state, snapshot)
+        except Exception:
+            # failed write (disk full, permissions): the delta is NOT saved —
+            # re-mark dirty so the next window retries instead of silently
+            # dropping learned state until a future ingest re-dirties it
+            self._dirty = True
+            log.exception("markov state save failed; will retry")
 
     def _write_state(self, snapshot: dict) -> None:
         import json

@@ -350,8 +350,9 @@ def test_scrape_failure_drops_silently(stack_config):
 
 
 def test_oversized_body_rejected(stack_config):
-    """C++ twin parity: content-length beyond the 16MB cap closes the
-    connection instead of buffering the body."""
+    """Content-length beyond the 16MB cap gets a 413 status (not a silently
+    dropped socket) and the body is never buffered; an unparseable
+    Content-Length gets a 400."""
 
     async def scenario():
         from symbiont_tpu.config import BusConfig
@@ -365,8 +366,19 @@ def test_oversized_body_rejected(stack_config):
             writer.write(b"POST /api/submit-url HTTP/1.1\r\nHost: x\r\n"
                          b"Content-Length: 999999999999\r\n\r\n")
             await writer.drain()
-            got = await asyncio.wait_for(reader.read(100), 5)
-            assert got == b""  # connection closed, nothing buffered
+            got = await asyncio.wait_for(reader.read(4096), 5)
+            assert got.startswith(b"HTTP/1.1 413 ")
+            assert b"16MB" in got
+            # server closed after answering (keep_alive=False)
+            assert await asyncio.wait_for(reader.read(100), 5) == b""
+            writer.close()
+
+            reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+            writer.write(b"POST /api/submit-url HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Length: banana\r\n\r\n")
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(4096), 5)
+            assert got.startswith(b"HTTP/1.1 400 ")
             writer.close()
         finally:
             await api.stop()
@@ -445,5 +457,30 @@ def test_lm_backend_generate_roundtrip(tmp_path):
                 assert [d["seq"] for d in deltas] == list(range(len(deltas)))
         finally:
             await stack.stop()
+
+    asyncio.run(scenario())
+
+
+def test_fused_search_skips_large_top_k():
+    """top_k above fused_search_max_top_k must bypass the fused probe
+    entirely (return None fast, no bus request) — a cold large-k bucket
+    would otherwise pay an XLA compile inside the probe timeout AND trip the
+    negative cache for every other search."""
+    import time
+
+    from symbiont_tpu.config import BusConfig
+    from symbiont_tpu.schema import SemanticSearchApiRequest
+    from symbiont_tpu.services.api import ApiService
+
+    async def scenario():
+        # no engine service subscribed: a non-skipped probe would block for
+        # the full 5s fused timeout
+        api = ApiService(InprocBus(), ApiConfig(host="127.0.0.1", port=0),
+                         BusConfig())
+        req = SemanticSearchApiRequest(query_text="q", top_k=50)
+        t0 = time.monotonic()
+        assert await api._fused_search(req, {}) is None
+        assert time.monotonic() - t0 < 1.0  # skipped, not timed out
+        assert api._fused_down_until == 0.0  # negative cache untouched
 
     asyncio.run(scenario())

@@ -233,3 +233,27 @@ def test_fused_query_search_empty_store(tmp_path):
     eng = _small_engine()
     store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path)))
     assert store.search_fused(eng, "anything", 5) == []
+
+
+def test_warm_fused_tracks_capacity_blocks(tmp_path):
+    """warm_fused records the capacity it compiled for (k=8 AND k=16
+    buckets); crossing a capacity block via upserts flags the warm as stale
+    so the owner re-warms before the next query pays a fresh compile."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    eng = _small_engine()
+    store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path),
+                                          shard_capacity=64))
+    assert not store.fused_warm_stale()  # never warmed → nothing to re-warm
+    store.warm_fused(eng, word_counts=(3,))
+    assert store._warmed_capacity == 64
+    assert not store.fused_warm_stale()
+
+    rng = np.random.default_rng(0)
+    store.upsert([(f"p{i}", rng.standard_normal(32), {})
+                  for i in range(65)])  # 65 rows cross the 64-row block
+    assert store.fused_warm_stale()
+    store.warm_fused(eng, word_counts=(3,))
+    assert store._warmed_capacity == 128
+    assert not store.fused_warm_stale()

@@ -236,3 +236,31 @@ def test_incremental_decoder_genuine_invalid_bytes():
     out = d.push(toks)
     out += d.flush(toks)
     assert out == "ok�"
+
+
+def test_stream_chunk_must_divide_new_buckets():
+    """The chunked streaming scan runs whole chunks against a cache with
+    exactly new_bucket decode slots; a non-dividing chunk would overrun it
+    (relying on dynamic_update_slice clamp semantics), so LmConfig rejects
+    the combination up front."""
+    with pytest.raises(ValueError, match="stream_chunk"):
+        LmConfig(stream_chunk=24, new_token_buckets=[64])
+    # buckets smaller than the chunk are fine: chunk shrinks to the bucket
+    LmConfig(stream_chunk=16, new_token_buckets=[8, 16, 64])
+
+
+def test_incremental_decoder_non_prefix_stable_decode():
+    """If decode is non-prefix-stable for a reason other than a trailing
+    replacement-char run (e.g. decode-time cleanup), flush must still emit
+    the divergent tail — the terminal output is never silently lost."""
+    from symbiont_tpu.engine.lm import IncrementalDecoder
+
+    class WeirdTok:
+        def decode(self, ids):
+            # decoding 3+ tokens "cleans up" earlier output: not a prefix
+            return "ab" if len(ids) < 3 else "aXc"
+
+    d = IncrementalDecoder(WeirdTok())
+    assert d.push([1, 2]) == "ab"
+    assert d.push([1, 2, 3]) == ""       # push stays conservative
+    assert d.flush([1, 2, 3]) == "Xc"    # flush emits past the common prefix

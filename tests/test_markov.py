@@ -109,3 +109,37 @@ def test_markov_corrupt_state_starts_fresh(tmp_path):
     path.write_text("{not json")
     svc = TextGeneratorService(InprocBus(), state_path=str(path))
     assert svc.markov.chain  # seed corpus trained; no crash
+
+
+def test_failed_state_save_is_retried(tmp_path, monkeypatch):
+    """A failed persist (disk full, permissions) must leave the chain dirty
+    so the next save window retries, instead of silently treating the
+    learned delta as saved."""
+    import asyncio
+
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def scenario():
+        svc = TextGeneratorService(InprocBus(),
+                                   state_path=str(tmp_path / "m.json"))
+        svc.markov.train("один два три")
+        svc._dirty = True
+
+        calls = {"n": 0}
+        real_write = svc._write_state
+
+        def failing_write(snapshot):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("disk full")
+            real_write(snapshot)
+
+        monkeypatch.setattr(svc, "_write_state", failing_write)
+        await svc._maybe_save(force=True)
+        assert svc._dirty  # failure re-marked dirty
+        await svc._maybe_save(force=True)
+        assert not svc._dirty
+        assert (tmp_path / "m.json").exists()
+
+    asyncio.run(scenario())

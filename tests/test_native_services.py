@@ -435,6 +435,23 @@ def test_native_api_gateway_full_stack(broker):
                 status, body, _ = await hx("GET", "/nope")
                 assert status == 404
 
+                # Python-twin parity: oversized / unparseable Content-Length
+                # answered with 413 / 400, not a silently dropped socket
+                r2, w2 = await asyncio.open_connection("127.0.0.1", api_port)
+                w2.write(b"POST /api/submit-url HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Length: 999999999999\r\n\r\n")
+                await w2.drain()
+                got = await asyncio.wait_for(r2.read(4096), 10)
+                assert got.startswith(b"HTTP/1.1 413 ")
+                w2.close()
+                r2, w2 = await asyncio.open_connection("127.0.0.1", api_port)
+                w2.write(b"POST /api/submit-url HTTP/1.1\r\nHost: x\r\n"
+                         b"Content-Length: banana\r\n\r\n")
+                await w2.drain()
+                got = await asyncio.wait_for(r2.read(4096), 10)
+                assert got.startswith(b"HTTP/1.1 400 ")
+                w2.close()
+
                 # CORS: exact-host origins only
                 _, _, hdrs = await hx("GET", "/healthz",
                                       headers={"Origin": "http://localhost:3000"})
