@@ -405,6 +405,19 @@ std::pair<int, std::string> route_generate_text(const std::string& body) {
     o.set("task_id", json::Value(task.task_id));
     return {400, o.dump()};
   }
+  // sampling overrides (our addition): same bounds as the Python twin
+  if (task.temperature && (*task.temperature < 0.0f || *task.temperature > 10.0f)) {
+    json::Value o = json::Value::object();
+    o.set("message", json::Value("temperature must be between 0 and 10"));
+    o.set("task_id", json::Value(task.task_id));
+    return {400, o.dump()};
+  }
+  if (task.top_k && *task.top_k > 100000) {
+    json::Value o = json::Value::object();
+    o.set("message", json::Value("top_k must be at most 100000"));
+    o.set("task_id", json::Value(task.task_id));
+    return {400, o.dump()};
+  }
   if (!publish_locked(symbiont::subjects::TASKS_GENERATION_TEXT,
                       task.to_json_string(), symbiont::child_headers({})))
     return {500, msg_json("bus publish failed")};

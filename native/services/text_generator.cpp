@@ -138,6 +138,10 @@ int main() try {
       json::Value req = json::Value::object();
       req.set("prompt", task.prompt ? json::Value(*task.prompt) : json::Value());
       req.set("max_new_tokens", json::Value((double)task.max_length));
+      // per-request sampling overrides ride through to the engine plane
+      if (task.temperature)
+        req.set("temperature", json::Value((double)*task.temperature));
+      if (task.top_k) req.set("top_k", json::Value((double)*task.top_k));
       auto reply = bus.request(symbiont::subjects::ENGINE_GENERATE, req.dump(),
                                engine_timeout_ms,
                                symbiont::child_headers(msg->headers));

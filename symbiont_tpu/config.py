@@ -226,6 +226,21 @@ class SymbiontConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runner: RunnerConfig = field(default_factory=RunnerConfig)
 
+    def __post_init__(self) -> None:
+        # cross-section invariant: every top_k the gateway routes to the
+        # fused path must land in a pre-warmed k bucket, or the first such
+        # query pays a cold XLA compile inside the probe timeout and trips
+        # the negative cache for everyone. Fail at startup, not in that
+        # degraded 60s window. (The standalone C++ gateway reads
+        # SYMBIONT_API_FUSED_SEARCH_MAX_TOP_K with the same default; keep
+        # them in lockstep in deployment env.)
+        if self.api.fused_search_max_top_k > self.vector_store.warm_top_k:
+            raise ValueError(
+                f"api.fused_search_max_top_k ({self.api.fused_search_max_top_k})"
+                f" must be <= vector_store.warm_top_k "
+                f"({self.vector_store.warm_top_k}): fused queries above the "
+                f"warmed k buckets would compile cold inside the probe timeout")
+
 
 # Reference-era env aliases → (section, field) (reference: .env.example:1-12).
 _ENV_ALIASES = {
@@ -331,4 +346,18 @@ def load_config(
         elif explicit:
             raise FileNotFoundError(f"config file not found: {path}")
     _apply_overrides(cfg, env_map)
+    _validate(cfg)
     return cfg
+
+
+def _validate(cfg: SymbiontConfig) -> None:
+    """Re-run every dataclass __post_init__ validator AFTER file/env
+    overrides: _merge_dict/_apply_overrides mutate the already-constructed
+    sections via setattr, which bypasses dataclass construction — without
+    this, the validators only ever see defaults."""
+    for section_field in dataclasses.fields(cfg):
+        section = getattr(cfg, section_field.name)
+        post = getattr(section, "__post_init__", None)
+        if post is not None:
+            post()
+    cfg.__post_init__()

@@ -160,14 +160,18 @@ class MicroBatcher(_BatcherBase):
 class _PendingGen:
     prompt: str
     max_new: int
+    temperature: float
+    top_k: int
     future: asyncio.Future
 
 
 class GenBatcher(_BatcherBase):
     """Micro-batching for autoregressive generation (the LmEngine analog of
-    MicroBatcher). Sampling params stay the engine defaults, which is what
-    the bus surface exposes; requests group by new-token bucket (an
-    executable is specialized on max_new)."""
+    MicroBatcher). Requests group by new-token bucket only (an executable is
+    specialized on max_new); per-request temperature/top_k ride as per-row
+    traced vectors inside one shared decode, so mixed-sampling requests
+    still batch together. Per-request overrides default to the engine
+    config."""
 
     def __init__(self, lm, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None):
@@ -176,9 +180,15 @@ class GenBatcher(_BatcherBase):
         super().__init__(max_batch or lm.config.gen_max_batch, deadline)
         self.lm = lm
 
-    async def generate(self, prompt: str, max_new_tokens: int) -> str:
+    async def generate(self, prompt: str, max_new_tokens: int,
+                       temperature: Optional[float] = None,
+                       top_k: Optional[int] = None) -> str:
+        cfg = self.lm.config
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._submit(_PendingGen(prompt, int(max_new_tokens), fut))
+        self._submit(_PendingGen(prompt, int(max_new_tokens),
+                                 float(temperature), int(top_k), fut))
         return await fut
 
     def _size(self, item: _PendingGen) -> int:
@@ -197,9 +207,10 @@ class GenBatcher(_BatcherBase):
         for group in groups.values():
             try:
                 texts = await asyncio.get_running_loop().run_in_executor(
-                    None, self.lm.generate_batch,
-                    [p.prompt for p in group],
-                    [p.max_new for p in group])
+                    None, lambda g=group: self.lm.generate_batch(
+                        [p.prompt for p in g], [p.max_new for p in g],
+                        temperature=[p.temperature for p in g],
+                        top_k=[p.top_k for p in g]))
                 for p, text in zip(group, texts):
                     if not p.future.cancelled():
                         p.future.set_result(text)

@@ -237,27 +237,46 @@ class LmEngine:
         return self.generate_batch([prompt], [max_new_tokens],
                                    temperature=temperature, top_k=top_k)[0]
 
+    def _norm_sampling_rows(self, value, default, bb: int, n: int, cast):
+        """Scalar-or-per-request sampling param → per-row list of length bb
+        (batch bucket). None → engine default (element-wise too); padding
+        rows decode greedily (their output is discarded)."""
+        if value is None:
+            value = default
+        if isinstance(value, (list, tuple, np.ndarray)):
+            if len(value) != n:
+                raise ValueError(
+                    f"per-request sampling list length {len(value)} != {n}")
+            rows = [cast(default if v is None else v) for v in value]
+        else:
+            rows = [cast(value)] * n
+        return rows + [cast(0)] * (bb - n)
+
     def generate_batch(self, prompts: Sequence[str],
                        max_new_tokens: Sequence[int],
-                       temperature: Optional[float] = None,
-                       top_k: Optional[int] = None) -> list:
+                       temperature=None, top_k=None) -> list:
         """Batched decode: B prompts through ONE (prompt_bucket, new_bucket)
         executable — concurrent generation requests share the decode loop's
         weight reads instead of serializing B single-row decodes. Rows are
         right-aligned internally by gpt.generate, so each row's output is
         independent of its batchmates (greedy decode of a batch == greedy
         decode of each row alone; asserted in tests). Per-request
-        max_new_tokens trim a shared new-token bucket."""
+        max_new_tokens trim a shared new-token bucket; temperature/top_k may
+        be scalars or per-request sequences (sampling params are traced
+        per-row vectors in the decode executable, so requests with different
+        sampling still share one batch)."""
         import jax
         import jax.numpy as jnp
 
         cfg = self.config
-        temperature = cfg.temperature if temperature is None else temperature
-        top_k = cfg.top_k if top_k is None else top_k
         if len(prompts) != len(max_new_tokens):
             raise ValueError("prompts and max_new_tokens length mismatch")
         prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
             prompts, max(max_new_tokens))
+        bb, n = prompt_ids.shape[0], len(prompts)
+        temps = self._norm_sampling_rows(temperature, cfg.temperature,
+                                         bb, n, float)
+        ks = self._norm_sampling_rows(top_k, cfg.top_k, bb, n, int)
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         with self._lock:
             self._key, sub = jax.random.split(self._key)
@@ -267,7 +286,7 @@ class LmEngine:
                     self.params, jnp.asarray(prompt_ids),
                     jnp.asarray(prompt_mask),
                     sub, self.model_cfg, max_new_tokens=new_bucket,
-                    temperature=float(temperature), top_k=int(top_k),
+                    temperature=temps, top_k=ks,
                     eos_id=int(eos_id))
                 tokens = np.asarray(tokens)  # materialize → full decode done
             lengths = np.asarray(lengths)

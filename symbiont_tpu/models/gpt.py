@@ -26,6 +26,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Params = Any
 
@@ -267,14 +268,52 @@ def forward(
 # ---------------------------------------------------------------------------
 
 
-def _sample(logits: jax.Array, key: jax.Array, temperature: float, top_k: int) -> jax.Array:
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+def _top_k_bucket(top_k: int, vocab: int) -> int:
+    """Static power-of-two bucket for the top-k cutoff. lax.top_k needs a
+    static k, but compiling one executable per client-supplied value would
+    mint unbounded executables (the ills bucketing exists to prevent
+    everywhere else in this repo) — so the compiled cutoff width is the next
+    power of two and the *exact* requested k selects the threshold
+    dynamically inside it (_sample). 0 = no cutoff (top_k<=0, or >= vocab
+    where the cutoff is a no-op)."""
+    if top_k <= 0 or top_k >= vocab:
+        return 0
+    b = 8
+    while b < top_k:
+        b *= 2
+    return min(b, vocab)
+
+
+def _norm_sampling(temperature, top_k, B: int, vocab: int):
+    """Normalize scalar-or-per-row sampling params to [B] device vectors plus
+    the static top-k bucket wide enough for every row's cutoff."""
+    t = np.broadcast_to(np.asarray(temperature, np.float32), (B,))
+    k = np.broadcast_to(np.asarray(top_k, np.int32), (B,))
+    cut = [int(x) for x in k if 0 < int(x) < vocab]
+    bucket = _top_k_bucket(max(cut), vocab) if cut else 0
+    return jnp.asarray(t), jnp.asarray(k), bucket
+
+
+def _sample(logits: jax.Array, key: jax.Array, temperature, top_k,
+            top_k_bucket: int) -> jax.Array:
+    """temperature/top_k are TRACED per-row [B] vectors (a new sampling value
+    must not recompile the decode loop, and rows of one batch may carry
+    different sampling params); only top_k_bucket is static. Per row:
+    temperature<=0 selects greedy; top_k<=0 (or >= vocab) disables the
+    cutoff; otherwise semantics match exact top-k for any k in the bucket."""
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(t, 1e-6)[..., None]
+    tk = jnp.asarray(top_k, jnp.int32)
+    if top_k_bucket > 0:
+        vals = jax.lax.top_k(scaled, top_k_bucket)[0]  # [..., bucket] desc
+        idx = jnp.clip(tk, 1, top_k_bucket) - 1
+        kth = jnp.take_along_axis(vals, idx[..., None], axis=-1)  # exact k-th
+        cut = (tk > 0) & (tk < vocab)
+        scaled = jnp.where(cut[..., None] & (scaled < kth), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(t <= 0.0, greedy, sampled)
 
 
 def _align_prompt(prompt_ids: jax.Array, prompt_mask: jax.Array,
@@ -296,13 +335,13 @@ def _align_prompt(prompt_ids: jax.Array, prompt_mask: jax.Array,
     return ids_r, positions, kv_valid, prompt_len
 
 
-def _decode_step(params, cfg: GPTConfig, kv_valid, temperature: float,
-                 top_k: int, eos_id: int):
+def _decode_step(params, cfg: GPTConfig, kv_valid, temperature, top_k,
+                 top_k_bucket: int, eos_id: int):
     """The one-token decode step shared by the full scan and chunked scans."""
 
     def step(carry, step_key):
         cache, cur_logits, cur_pos, done = carry
-        tok = _sample(cur_logits, step_key, temperature, top_k)
+        tok = _sample(cur_logits, step_key, temperature, top_k, top_k_bucket)
         tok = jnp.where(done, 0, tok)
         if eos_id >= 0:
             counted = ~done & (tok != eos_id)
@@ -333,23 +372,52 @@ def prefill(params, prompt_ids, prompt_mask, cfg: GPTConfig,
     return cache, logits[:, -1, :], kv_valid, prompt_len
 
 
-@partial(jax.jit,
-         static_argnames=("cfg", "temperature", "top_k", "eos_id"))
-def decode_chunk(params, cache, cur_logits, cur_pos, done, kv_valid, keys,
-                 cfg: GPTConfig, temperature: float = 0.8, top_k: int = 40,
-                 eos_id: int = -1):
-    """Scan `len(keys)` decode steps from a carried state; chunk length is
-    static via the keys shape, so a streaming loop reuses ONE executable per
-    (prompt_bucket, chunk) pair. Returns (carry..., tokens [B, C],
-    counted [B, C])."""
-    step = _decode_step(params, cfg, kv_valid, temperature, top_k, eos_id)
+@partial(jax.jit, static_argnames=("cfg", "top_k_bucket", "eos_id"))
+def _decode_chunk_jit(params, cache, cur_logits, cur_pos, done, kv_valid,
+                      keys, temperature, top_k, cfg: GPTConfig,
+                      top_k_bucket: int, eos_id: int):
+    step = _decode_step(params, cfg, kv_valid, temperature, top_k,
+                        top_k_bucket, eos_id)
     (cache, logits, pos, done), (tokens, counted) = jax.lax.scan(
         step, (cache, cur_logits, cur_pos, done), keys)
     return cache, logits, pos, done, tokens.T, counted.T
 
 
+def decode_chunk(params, cache, cur_logits, cur_pos, done, kv_valid, keys,
+                 cfg: GPTConfig, temperature=0.8, top_k=40,
+                 eos_id: int = -1):
+    """Scan `len(keys)` decode steps from a carried state; chunk length is
+    static via the keys shape, so a streaming loop reuses ONE executable per
+    (prompt_bucket, chunk) pair — temperature and the exact top_k are traced
+    per-row vectors (only the power-of-two top_k bucket is compiled in), so
+    new sampling values reuse it too. Returns (carry..., tokens [B, C],
+    counted [B, C])."""
+    t, k, bucket = _norm_sampling(temperature, top_k,
+                                  cur_logits.shape[0], cfg.vocab_size)
+    return _decode_chunk_jit(
+        params, cache, cur_logits, cur_pos, done, kv_valid, keys,
+        t, k, cfg, top_k_bucket=bucket, eos_id=eos_id)
+
+
 @partial(jax.jit,
-         static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "eos_id"))
+         static_argnames=("cfg", "max_new_tokens", "top_k_bucket", "eos_id"))
+def _generate_jit(params, prompt_ids, prompt_mask, key, temperature, top_k,
+                  cfg: GPTConfig, max_new_tokens: int, top_k_bucket: int,
+                  eos_id: int):
+    B = prompt_ids.shape[0]
+    cache, next_logits, kv_valid, prompt_len = prefill(
+        params, prompt_ids, prompt_mask, cfg, max_new_tokens)
+
+    step = _decode_step(params, cfg, kv_valid, temperature, top_k,
+                        top_k_bucket, eos_id)
+    keys = jax.random.split(key, max_new_tokens)
+    init = (cache, next_logits, prompt_len, jnp.zeros((B,), bool))
+    _, (tokens, counted) = jax.lax.scan(step, init, keys)
+    tokens = tokens.T  # [B, max_new]
+    lengths = counted.T.astype(jnp.int32).sum(axis=1)
+    return tokens, lengths
+
+
 def generate(
     params: Params,
     prompt_ids: jax.Array,  # [B, P] left-padded with pad_id? No: right-aligned real tokens
@@ -357,8 +425,8 @@ def generate(
     key: jax.Array,
     cfg: GPTConfig,
     max_new_tokens: int = 64,
-    temperature: float = 0.8,
-    top_k: int = 40,
+    temperature=0.8,
+    top_k=40,
     eos_id: int = -1,
 ) -> tuple[jax.Array, jax.Array]:
     """Prefill + scan decode. Returns (tokens [B, max_new_tokens], lengths [B]).
@@ -368,18 +436,17 @@ def generate(
     index P-1 and decode steps share cache indices P, P+1, ... across the
     batch, with left-padding slots masked out of attention via kv_valid.
     Rows stop at eos_id (if ≥0); lengths counts tokens generated before eos.
-    """
-    B = prompt_ids.shape[0]
-    cache, next_logits, kv_valid, prompt_len = prefill(
-        params, prompt_ids, prompt_mask, cfg, max_new_tokens)
 
-    step = _decode_step(params, cfg, kv_valid, temperature, top_k, eos_id)
-    keys = jax.random.split(key, max_new_tokens)
-    init = (cache, next_logits, prompt_len, jnp.zeros((B,), bool))
-    _, (tokens, counted) = jax.lax.scan(step, init, keys)
-    tokens = tokens.T  # [B, max_new]
-    lengths = counted.T.astype(jnp.int32).sum(axis=1)
-    return tokens, lengths
+    temperature and the exact top_k are traced per-row [B] vectors (scalars
+    broadcast) — per-request sampling values never recompile, and rows of one
+    batch may sample differently; only (shapes, cfg, top_k's power-of-two
+    bucket, eos_id) key the executable.
+    """
+    t, k, bucket = _norm_sampling(temperature, top_k,
+                                  prompt_ids.shape[0], cfg.vocab_size)
+    return _generate_jit(params, prompt_ids, prompt_mask, key, t, k, cfg,
+                         max_new_tokens=max_new_tokens,
+                         top_k_bucket=bucket, eos_id=eos_id)
 
 
 # ---------------------------------------------------------------------------

@@ -161,16 +161,21 @@ class TokenizedTextMessage:
 class GenerateTextTask:
     """reference: libs/shared_models/src/lib.rs:26-30
 
-    `stream` is this framework's addition: when true (and an LM backend with
-    streaming is active), token deltas go out on
-    events.text.generated.partial while decoding. Optional, so reference-era
-    clients (which omit it) remain wire-compatible — and unstreamed requests
-    keep riding the generation micro-batcher."""
+    `stream`, `temperature` and `top_k` are this framework's additions:
+    when true (and an LM backend with streaming is active), `stream` sends
+    token deltas out on events.text.generated.partial while decoding;
+    `temperature`/`top_k` override the LM engine's sampling defaults per
+    request (temperature 0 = greedy; ignored by the Markov backend, which
+    has no sampling knobs). All optional, so reference-era clients (which
+    omit them) remain wire-compatible — and unstreamed requests keep riding
+    the generation micro-batcher."""
 
     task_id: str
     prompt: Optional[str]
     max_length: int
     stream: Optional[bool] = None
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
 
 
 @wire

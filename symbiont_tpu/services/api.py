@@ -345,6 +345,16 @@ class ApiService:
             return 400, json.dumps({
                 "message": f"max_length must be between 1 and {self.config.max_gen_length}",
                 "task_id": task.task_id})
+        # sampling overrides (our addition): bound them here so a bad value
+        # fails fast at the HTTP surface, not inside the decode loop
+        if task.temperature is not None and not 0.0 <= task.temperature <= 10.0:
+            return 400, json.dumps({
+                "message": "temperature must be between 0 and 10",
+                "task_id": task.task_id})
+        if task.top_k is not None and task.top_k > 100_000:
+            return 400, json.dumps({
+                "message": "top_k must be at most 100000",
+                "task_id": task.task_id})
         await self.bus.publish(subjects.TASKS_GENERATION_TEXT,
                                to_json_bytes(task), headers=new_trace_headers())
         return 200, json.dumps({

@@ -206,13 +206,20 @@ class EngineService(Service):
         async def op(req: dict) -> dict:
             prompt = req.get("prompt") or ""
             max_new = int(req.get("max_new_tokens", 50))
+            temperature = req.get("temperature")
+            temperature = None if temperature is None else float(temperature)
+            top_k = req.get("top_k")
+            top_k = None if top_k is None else int(top_k)
             if self.lm_batcher is not None:
                 # shared micro-batcher: concurrent engine.generate callers
                 # decode as one batch with the bus-surface requests
-                text = await self.lm_batcher.generate(prompt, max_new)
+                text = await self.lm_batcher.generate(
+                    prompt, max_new, temperature=temperature, top_k=top_k)
             else:
                 text = await self._run_blocking(
-                    self.lm.generate, prompt, max_new)
+                    lambda: self.lm.generate(prompt, max_new,
+                                             temperature=temperature,
+                                             top_k=top_k))
             name = self.lm.config.model_dir or f"symbiont-lm/{self.lm.config.arch}"
             return {"text": text, "model_name": name}
         await self._handle(msg, "generate", op)

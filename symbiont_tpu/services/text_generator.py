@@ -58,7 +58,9 @@ class TextGeneratorService(Service):
         else:
             self.markov = MarkovModel()
             self.markov.train(SEED_CORPUS)
-        self.lm_generate = lm_generate  # Callable[[str, int], str] | None
+        self.lm_generate = lm_generate  # (prompt, max_new, *, temperature=,
+        #                                  top_k=) -> str | None
+        #                                 (LmEngine.generate's signature)
         self.lm_batcher = lm_batcher  # GenBatcher | None (batches concurrent
         #                               requests into one decode)
         self.lm_stream = lm_stream  # Callable[..., Iterator[str]] | None —
@@ -159,12 +161,17 @@ class TextGeneratorService(Service):
                 # it — everything else rides the micro-batcher
                 text = await self._stream_generate(task, msg.headers)
             elif self.lm_batcher is not None:
-                text = await self.lm_batcher.generate(task.prompt or "",
-                                                      task.max_length)
+                text = await self.lm_batcher.generate(
+                    task.prompt or "", task.max_length,
+                    temperature=task.temperature, top_k=task.top_k)
             elif self.lm_generate is not None:
                 text = await asyncio.get_running_loop().run_in_executor(
-                    None, self.lm_generate, task.prompt or "", task.max_length)
+                    None, lambda: self.lm_generate(
+                        task.prompt or "", task.max_length,
+                        temperature=task.temperature, top_k=task.top_k))
             else:
+                # Markov backend has no sampling knobs: temperature/top_k
+                # are accepted on the wire but ignored (documented in schema)
                 text = self.markov.generate(task.max_length)
         out = GeneratedTextMessage(original_task_id=task.task_id,
                                    generated_text=text,
@@ -184,7 +191,9 @@ class TextGeneratorService(Service):
         def produce() -> None:
             try:
                 for delta in self.lm_stream(task.prompt or "",
-                                            task.max_length):
+                                            task.max_length,
+                                            temperature=task.temperature,
+                                            top_k=task.top_k):
                     loop.call_soon_threadsafe(queue.put_nowait, ("delta", delta))
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
             except BaseException as e:  # surface decode errors to the handler

@@ -53,3 +53,32 @@ def test_unknown_file_key_rejected(tmp_path):
         assert "bogus" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_fused_top_k_must_be_covered_by_warm_buckets():
+    """api.fused_search_max_top_k above vector_store.warm_top_k would send
+    fused queries into unwarmed k buckets (cold compile inside the probe
+    timeout) — rejected at startup."""
+    import pytest
+
+    from symbiont_tpu.config import ApiConfig, SymbiontConfig, VectorStoreConfig
+
+    with pytest.raises(ValueError, match="warm_top_k"):
+        SymbiontConfig(api=ApiConfig(fused_search_max_top_k=64))
+    SymbiontConfig(api=ApiConfig(fused_search_max_top_k=64),
+                   vector_store=VectorStoreConfig(warm_top_k=64))
+
+
+def test_validators_fire_on_loaded_overrides():
+    """File/env overrides mutate sections via setattr, bypassing dataclass
+    construction — load_config must re-run the validators afterwards."""
+    import pytest
+
+    from symbiont_tpu.config import load_config
+
+    with pytest.raises(ValueError, match="warm_top_k"):
+        load_config(env={"SYMBIONT_API_FUSED_SEARCH_MAX_TOP_K": "64"})
+    with pytest.raises(ValueError, match="stream_chunk"):
+        load_config(env={"SYMBIONT_LM_STREAM_CHUNK": "24"})
+    load_config(env={"SYMBIONT_API_FUSED_SEARCH_MAX_TOP_K": "64",
+                     "SYMBIONT_VECTOR_STORE_WARM_TOP_K": "64"})

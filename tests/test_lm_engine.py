@@ -264,3 +264,84 @@ def test_incremental_decoder_non_prefix_stable_decode():
     assert d.push([1, 2]) == "ab"
     assert d.push([1, 2, 3]) == ""       # push stays conservative
     assert d.flush([1, 2, 3]) == "Xc"    # flush emits past the common prefix
+
+
+def test_gen_batcher_mixed_sampling_shares_one_decode():
+    """Per-request temperature/top_k are per-row traced vectors, so
+    concurrent requests with DIFFERENT sampling params still decode as ONE
+    batch — and each greedy row matches its single-call output exactly
+    (rows are independent of their batchmates)."""
+    import asyncio
+
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.batcher import GenBatcher
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8],
+                            temperature=0.0, top_k=40, gen_max_batch=4,
+                            gen_flush_deadline_ms=50.0))
+    greedy_single = eng.generate("aa", 6, temperature=0.0)
+    calls_before = eng.stats["generate_calls"]
+
+    async def scenario():
+        b = GenBatcher(eng)
+        await b.start()
+        try:
+            return await asyncio.gather(
+                b.generate("aa", 6),                      # default → greedy
+                b.generate("aa", 6, temperature=0.0),     # explicit default
+                b.generate("aa", 6, temperature=5.0, top_k=0))  # sampled
+        finally:
+            await b.close()
+
+    default, explicit, sampled = asyncio.run(scenario())
+    assert default == explicit == greedy_single  # greedy rows unperturbed
+    assert isinstance(sampled, str)
+    # mixed sampling params share ONE decode call
+    assert eng.stats["generate_calls"] == calls_before + 1
+
+
+def test_generate_top_k_beyond_vocab_is_safe():
+    """top_k larger than the vocab must behave as full-vocab sampling, not
+    crash lax.top_k (regression: client-supplied top_k=1000 with a 257-byte
+    vocab)."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8]))
+    out = eng.generate("x", 6, temperature=1.0, top_k=1000)
+    assert isinstance(out, str)
+
+
+def test_sampling_top_k_bucket_bounds_executables():
+    """_top_k_bucket: log-bounded static buckets; exact-k threshold stays
+    dynamic."""
+    from symbiont_tpu.models.gpt import _top_k_bucket
+
+    assert _top_k_bucket(0, 257) == 0        # no cutoff
+    assert _top_k_bucket(257, 257) == 0      # >= vocab → cutoff is a no-op
+    assert _top_k_bucket(1000, 257) == 0
+    assert _top_k_bucket(1, 257) == 8
+    assert _top_k_bucket(8, 257) == 8
+    assert _top_k_bucket(9, 257) == 16
+    assert _top_k_bucket(40, 50257) == 64
+    assert _top_k_bucket(200, 257) == 256
+
+
+def test_sampling_values_do_not_recompile():
+    """New temperature/top_k values within a bucket must reuse the compiled
+    decode executable (they are traced, not static)."""
+    from symbiont_tpu.models import gpt as gpt_mod
+
+    eng = LmEngine(TINY)
+    eng.generate("a", 6, temperature=0.7, top_k=5)
+    n = gpt_mod._generate_jit._cache_size()
+    eng.generate("a", 6, temperature=0.9, top_k=7)  # same top-k bucket (8)
+    eng.generate("a", 6, temperature=1.3, top_k=3)
+    assert gpt_mod._generate_jit._cache_size() == n

@@ -669,7 +669,10 @@ def test_text_generator_lm_backend(broker):
                 model_dir = None
                 arch = "test"
 
-            def generate(self, prompt, max_new_tokens, **kw):
+            def generate(self, prompt, max_new_tokens, temperature=None,
+                         top_k=None):
+                if temperature is not None:
+                    return f"lm says: {prompt}! t={temperature} k={top_k}"
                 return f"lm says: {prompt}!"
 
         engine_bus = await _tcp_bus(broker)
@@ -689,6 +692,15 @@ def test_text_generator_lm_backend(broker):
             out = from_json(GeneratedTextMessage, msg.data)
             assert out.generated_text == "lm says: hello tpu!"
             assert out.original_task_id == task.task_id
+
+            # per-request sampling params ride the C++ worker → engine hop
+            task = GenerateTextTask(task_id=generate_uuid(), prompt="again",
+                                    max_length=32, temperature=1.5, top_k=7)
+            await bus.publish(subjects.TASKS_GENERATION_TEXT, to_json_bytes(task))
+            msg = await sub.next(15.0)
+            assert msg is not None, "no generated event (sampled)"
+            out = from_json(GeneratedTextMessage, msg.data)
+            assert out.generated_text == "lm says: again! t=1.5 k=7"
             await bus.close()
         finally:
             stop_worker(proc)
