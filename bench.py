@@ -1,7 +1,10 @@
-"""Benchmark: embeddings/sec/chip on the flagship embedding path.
+"""Benchmark: every PERF.md table number in ONE parsed JSON line.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+where extras carry every number docs/PERF.md quotes (MFU, search p50s,
+ingest rate, rerank pairs/s, decode tok/s + TTFT, streaming first-delta) so
+no doc number exists without a matching archived field (VERDICT r1 item 2).
 
 The reference publishes no numbers (BASELINE.md: "none exist"), so
 vs_baseline is measured, not quoted: the same model on the same chip run the
@@ -10,11 +13,15 @@ batches of 8 (reference: embedding_generator.rs:83-91,146) — versus this
 framework's way (length-bucketed static shapes, big batches, bf16). The ratio
 is the design win of SURVEY.md §5.7/§7 on identical hardware.
 
-Extra detail lines go to stderr; stdout carries exactly the one JSON line.
+MFU here = useful matmul FLOPs (real tokens, real sequence lengths — padding
+does NOT count as useful work) / elapsed / chip peak bf16 FLOPs. A second
+field reports hardware utilization including padding, which shows how much
+of the gap is padding waste vs dispatch overhead.
 
-`python bench.py --full` additionally measures BASELINE.md configs #4 and #5
-(cross-encoder rerank pairs/s; GPT-2-geometry decode tokens/s + TTFT) — the
-results land on stderr and in docs/PERF.md's table.
+Extra detail lines go to stderr; stdout carries exactly the one JSON line.
+`python bench.py --quick` runs only the primary embedding metric (~1 min);
+the default full run takes several minutes (it compiles several decode
+executables).
 """
 
 from __future__ import annotations
@@ -44,7 +51,44 @@ def make_sentences(n: int, rng) -> list:
     return out
 
 
-def bench_rerank() -> None:
+# ------------------------------------------------------------------ MFU math
+
+# peak dense bf16 FLOP/s per chip, keyed by substrings of jax device_kind
+_PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+]
+
+
+def chip_peak_flops(device) -> float | None:
+    kind = device.device_kind.lower()
+    if device.platform not in ("tpu", "axon"):
+        return None  # MFU is only meaningful against a known accelerator peak
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def bert_fwd_flops(lengths, H: int, I: int, L: int, seq_for_attn=None) -> float:
+    """Matmul-only BERT forward FLOPs for a batch of sequences.
+
+    Per token per layer: qkv+out projections 8H², MLP 4HI; attention
+    (QKᵀ + AV) 4·S·H where S is the sequence length attended over. With
+    seq_for_attn=None S is the sentence's own (real) length — useful-work
+    FLOPs; pass the padded bucket length to count what the chip executed."""
+    lengths = np.asarray(lengths, np.float64)
+    s_attn = lengths if seq_for_attn is None else np.asarray(seq_for_attn,
+                                                             np.float64)
+    per_tok = L * (8.0 * H * H + 4.0 * H * I)
+    return float((lengths * per_tok + L * 4.0 * H * lengths * s_attn).sum())
+
+
+# ------------------------------------------------------------------- benches
+
+def bench_rerank(results: dict) -> None:
     """BASELINE.md config #4: ms-marco-MiniLM-L-6 geometry cross-encoder,
     pairs/sec over a top-k-sized candidate set."""
     from symbiont_tpu.config import EngineConfig
@@ -63,11 +107,13 @@ def bench_rerank() -> None:
         t0 = time.time()
         eng.rerank(query, passages)
         dt = min(dt, time.time() - t0)
+    results["rerank_pairs_per_s"] = round(256 / dt, 1)
+    results["rerank_hop_ms"] = round(dt * 1000, 1)
     log(f"rerank (MiniLM-L6 CE geometry, 256 pairs, pad-128, bf16): "
-        f"{256 / dt:.0f} pairs/s (p50 rerank hop {dt * 1000:.1f}ms)")
+        f"{256 / dt:.0f} pairs/s (256-pair hop {dt * 1000:.1f}ms)")
 
 
-def bench_search_latency() -> None:
+def bench_search_latency(results: dict) -> None:
     """BASELINE.md north-star metric #2: p50 semantic-search latency — query
     embed (MiniLM-L6 geometry) + exact cosine top-k over a 10k-row
     device-resident corpus. This is the compute path of the 2-hop
@@ -94,6 +140,9 @@ def bench_search_latency() -> None:
         store.upsert([(f"p{i}", vecs[i], {"sentence_text": corpus[i]})
                       for i in range(len(corpus))])
         t_upsert = time.time() - t0
+        results["ingest_10k_emb_per_s"] = round(10_000 / t_embed, 1)
+        results["upsert_10k_points_per_s"] = round(10_000 / t_upsert, 1)
+        results["upsert_10k_s"] = round(t_upsert, 2)
         log(f"bulk ingest: 10k sentences embedded in {t_embed:.2f}s "
             f"({10_000 / t_embed:.0f} emb/s), upserted in {t_upsert:.2f}s")
 
@@ -117,32 +166,37 @@ def bench_search_latency() -> None:
         for ql in ["a b c", " ".join(["word"] * 40)]:
             split(ql), fused(ql)
         p50, p95 = measure(split)
+        results["search_split_p50_ms"] = round(p50, 1)
+        results["search_split_p95_ms"] = round(p95, 1)
         log(f"semantic search, split path (10k corpus, top-5): "
             f"p50 {p50:.1f}ms, p95 {p95:.1f}ms (embed call + top-k call)")
         p50f, p95f = measure(fused)
+        results["search_fused_p50_ms"] = round(p50f, 1)
+        results["search_fused_p95_ms"] = round(p95f, 1)
         log(f"semantic search, FUSED path (10k corpus, top-5): "
             f"p50 {p50f:.1f}ms, p95 {p95f:.1f}ms "
             f"(one compiled embed+top-k program, one device round-trip)")
 
 
-def bench_lm_decode() -> None:
+def bench_lm_decode(results: dict) -> None:
     """BASELINE.md config #5: GPT-2-small geometry (124M, vocab 50257)
     autoregressive decode — tokens/sec/chip and time-to-first-token."""
-    _bench_decode_geometry("GPT-2 124M", dict(
+    _bench_decode_geometry("GPT-2 124M", "gpt2_124m", results, dict(
         vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
         intermediate_size=3072, max_position_embeddings=1024, arch="gpt2"))
 
 
-def bench_tinyllama_decode() -> None:
+def bench_tinyllama_decode(results: dict) -> None:
     """BASELINE.md config #5 (second named model): TinyLlama-1.1B geometry —
     22 layers, GQA 32/4, SwiGLU, RoPE — decode on one chip, bf16."""
-    _bench_decode_geometry("TinyLlama 1.1B", dict(
+    _bench_decode_geometry("TinyLlama 1.1B", "tinyllama_1b", results, dict(
         vocab_size=32000, hidden_size=2048, num_layers=22, num_heads=32,
         num_kv_heads=4, intermediate_size=5632, max_position_embeddings=2048,
         arch="llama"))
 
 
-def _bench_decode_geometry(label: str, cfg_kw: dict) -> None:
+def _bench_decode_geometry(label: str, key: str, results: dict,
+                           cfg_kw: dict) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -155,10 +209,10 @@ def _bench_decode_geometry(label: str, cfg_kw: dict) -> None:
     B, P, NEW = 8, 64, 128
     ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, P)), jnp.int32)
     mask = jnp.ones((B, P), jnp.int32)
-    key = jax.random.key(0)
+    key_ = jax.random.key(0)
 
     def run(max_new):
-        toks, _ = gpt_mod.generate(params, ids, mask, key, cfg,
+        toks, _ = gpt_mod.generate(params, ids, mask, key_, cfg,
                                    max_new_tokens=max_new, temperature=0.8,
                                    top_k=40)
         jax.block_until_ready(toks)
@@ -174,9 +228,92 @@ def _bench_decode_geometry(label: str, cfg_kw: dict) -> None:
         t0 = time.time()
         run(NEW)
         dt = min(dt, time.time() - t0)
+    results[f"{key}_tok_per_s"] = round(B * NEW / dt, 1)
+    results[f"{key}_tok_per_s_stream"] = round(NEW / dt, 1)
+    results[f"{key}_ttft_ms"] = round(ttft * 1000, 1)
     log(f"lm decode ({label} geometry, bf16, batch {B}, prompt {P}, "
         f"{NEW} new): {B * NEW / dt:.0f} tokens/s/chip "
         f"({NEW / dt:.0f} tok/s/stream), TTFT {ttft * 1000:.0f}ms")
+
+
+def bench_streaming(results: dict) -> None:
+    """Token streaming (GPT-2 geometry): time to the FIRST text delta out of
+    generate_stream — the user-visible latency win of chunked decode."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(
+        enabled=True, arch="gpt2", hidden_size=768, num_layers=12,
+        num_heads=12, intermediate_size=3072, max_positions=1024,
+        dtype="bfloat16", prompt_buckets=[64], new_token_buckets=[128],
+        stream_chunk=16, temperature=0.8))
+    prompt = "the tensor processing unit " * 8
+
+    def first_delta_and_total():
+        t0 = time.time()
+        first = None
+        for _ in eng.generate_stream(prompt, 128):
+            if first is None:
+                first = time.time() - t0
+        return first, time.time() - t0
+
+    first_delta_and_total()  # warm: compiles prefill + chunk executables
+    best_first, best_total = float("inf"), float("inf")
+    for _ in range(3):
+        first, total = first_delta_and_total()
+        best_first = min(best_first, first)
+        best_total = min(best_total, total)
+    results["stream_first_delta_ms"] = round(best_first * 1000, 1)
+    results["stream_total_128_s"] = round(best_total, 2)
+    log(f"streaming (GPT-2 geom, prompt 64, 128 new, chunk 16): first text "
+        f"delta {best_first * 1000:.0f}ms, full stream {best_total:.2f}s")
+
+
+def bench_compute_mfu(results: dict, peak: float | None) -> None:
+    """Compute-only MFU: 20 chained forwards on device-resident data (inputs
+    varied per iteration so XLA cannot hoist the loop body), no host↔device
+    transfers in the timed region. This is the chip-side capability a
+    locally-attached deployment gets; the end-to-end MFU above additionally
+    pays the tunnel's transfer wall."""
+    if peak is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+    from symbiont_tpu.models import bert as bert_mod
+
+    H, I, L = 384, 1536, 6
+    eng = TpuEngine(EngineConfig(
+        embedding_dim=H, length_buckets=[64], batch_buckets=[1024],
+        max_batch=1024, dtype="bfloat16", data_parallel=False))
+    cfg = eng.model_cfg
+    B, S, N = 1024, 64, 20
+    ids = jnp.ones((B, S), jnp.int32)
+    mask = jnp.ones((B, S), jnp.int32)
+
+    @jax.jit
+    def loop(params, ids, mask):
+        def body(c, i):
+            e = bert_mod.embed_sentences(params, (ids + i) % cfg.vocab_size,
+                                         mask, cfg, pooling="mean")
+            return c + e.sum(), None
+        return jax.lax.scan(body, jnp.float32(0),
+                            jnp.arange(N, dtype=jnp.int32))[0]
+
+    jax.block_until_ready(loop(eng.params, ids, mask))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(loop(eng.params, ids, mask))
+        best = min(best, time.time() - t0)
+    tokens = N * B * S
+    flops = tokens * L * (8 * H * H + 4 * H * I) + N * B * L * 4 * H * S * S
+    results["mfu_compute_only_pct"] = round(100 * flops / best / peak, 2)
+    results["compute_only_emb_per_s"] = round(N * B / best, 1)
+    log(f"compute-only (no transfers, [1024,64] bf16): "
+        f"{N * B / best:.0f} emb/s, MFU {100 * flops / best / peak:.1f}%")
 
 
 def main() -> None:
@@ -188,14 +325,17 @@ def main() -> None:
 
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
+    peak = chip_peak_flops(dev)
     rng = np.random.default_rng(0)
     sentences = make_sentences(2048, rng)
 
     # MiniLM-L6 geometry (BASELINE.md config #1), bf16, synthetic weights —
     # throughput is weight-value independent.
+    H, I, L = 384, 1536, 6
+
     def mk_engine(length_buckets, batch_buckets, max_batch):
         return TpuEngine(EngineConfig(
-            embedding_dim=384, length_buckets=length_buckets,
+            embedding_dim=H, length_buckets=length_buckets,
             batch_buckets=batch_buckets, max_batch=max_batch,
             dtype="bfloat16", data_parallel=False))
 
@@ -212,6 +352,33 @@ def main() -> None:
     log(f"bucketed policy: {len(sentences)} sentences in {dt_ours:.2f}s "
         f"→ {eps_ours:.0f} emb/s (compiles={ours.stats['compiles']})")
 
+    # MFU: useful FLOPs use each sentence's REAL token count and length;
+    # executed FLOPs replay the engine's actual batch plan — every row of
+    # every (length-bucket × batch-bucket) executable, including batch-row
+    # padding — at the padded length (what the chip actually ran).
+    from symbiont_tpu.engine.bucketing import plan_batches
+
+    cfg_e = ours.config
+    max_len = min(cfg_e.length_buckets[-1],
+                  ours.model_cfg.max_position_embeddings)
+    lengths = [len(e) for e in ours.tokenizer.encode_batch(sentences, max_len)]
+    exec_rows: list = []  # one padded length per EXECUTED row
+    for bucket, indices in plan_batches(lengths, cfg_e.length_buckets,
+                                        cfg_e.max_batch):
+        exec_rows.extend([bucket] * ours._batch_bucket(len(indices)))
+    useful = bert_fwd_flops(lengths, H, I, L)
+    executed = bert_fwd_flops(exec_rows, H, I, L, seq_for_attn=exec_rows)
+    results: dict = {}
+    if peak:
+        results["mfu_pct"] = round(100 * useful / dt_ours / peak, 2)
+        results["hw_util_incl_padding_pct"] = round(
+            100 * executed / dt_ours / peak, 2)
+        log(f"MFU {results['mfu_pct']:.2f}% useful "
+            f"({results['hw_util_incl_padding_pct']:.2f}% incl. padding) "
+            f"against {peak / 1e12:.0f} TFLOP/s bf16 peak")
+    else:
+        log("MFU: n/a (not a TPU device)")
+
     # --- reference policy: pad-to-512, serial batch 8 ---------------------
     # The reference materializes every batch before starting the next
     # (to_vec2 inside the batch loop, embedding_generator.rs:146-216), so
@@ -226,14 +393,17 @@ def main() -> None:
             ref.embed_texts(sentences[i:i + 8])
         dt_ref = min(dt_ref, time.time() - t0)
     eps_ref = n_ref / dt_ref
+    results["ref_policy_emb_per_s"] = round(eps_ref, 1)
     log(f"reference policy (pad-512, batch 8): {n_ref} sentences in "
         f"{dt_ref:.2f}s → {eps_ref:.0f} emb/s")
 
-    if "--full" in sys.argv:
-        bench_search_latency()
-        bench_rerank()
-        bench_lm_decode()
-        bench_tinyllama_decode()
+    if "--quick" not in sys.argv:
+        bench_compute_mfu(results, peak)
+        bench_search_latency(results)
+        bench_rerank(results)
+        bench_lm_decode(results)
+        bench_tinyllama_decode(results)
+        bench_streaming(results)
 
     log(f"total bench time {time.time() - t_start:.0f}s")
     print(json.dumps({
@@ -241,6 +411,7 @@ def main() -> None:
         "value": round(eps_ours, 1),
         "unit": "embeddings/s",
         "vs_baseline": round(eps_ours / eps_ref, 2),
+        **results,
     }))
 
 

@@ -52,6 +52,39 @@ def pad_batch_rows(
     return ids, mask, n
 
 
+def pad_ids_rows(
+    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad token-id sequences to [n, bucket] ids + true lengths [n].
+
+    The attention mask is NOT materialized on host: the device executable
+    rebuilds it as `arange(bucket) < lengths[:, None]`, halving the
+    host→device bytes vs shipping an explicit [n, bucket] mask — on a
+    network-attached chip h2d bandwidth is part of the ingest wall."""
+    n = len(seqs)
+    ids = np.full((n, bucket), pad_id, np.int32)
+    lengths = np.zeros((n,), np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s[:bucket])
+        ids[i, : len(s)] = s
+        lengths[i] = len(s)
+    return ids, lengths
+
+
+def pad_batch_rows_ids(
+    ids: np.ndarray, lengths: np.ndarray, batch_bucket: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Row-pad (ids, lengths) up to batch_bucket; padding rows get length 0
+    (their pooled output is discarded). Returns real row count."""
+    n = ids.shape[0]
+    if n == batch_bucket:
+        return ids, lengths, n
+    pad_rows = batch_bucket - n
+    ids = np.concatenate([ids, np.tile(ids[-1:], (pad_rows, 1))], axis=0)
+    lengths = np.concatenate([lengths, np.zeros(pad_rows, np.int32)])
+    return ids, lengths, n
+
+
 def plan_batches(
     lengths: Sequence[int],
     length_buckets: Sequence[int],

@@ -13,6 +13,20 @@ serial batch-8, pad-to-max loop with:
 
 The engine is synchronous at this layer; the async micro-batching facade for
 the interactive query path lives in engine/batcher.py.
+
+Concurrency contract ("single owner" made precise): single-owner means this
+process — one TpuEngine instance owns the device; no other code touches it.
+The engine's entry points (embed_texts / embed_and_search / rerank / warmup)
+ARE safe to call from multiple threads concurrently: JAX dispatch is
+thread-safe and the XLA runtime serializes device execution per stream, so
+interleaved calls only interleave host-side dispatch, never device state.
+Two internal locks keep the bookkeeping consistent under that concurrency:
+_lock guards the executable cache, _stats_lock guards counters (asserted by
+a concurrent stress test). Deliberately NOT serialized: a bulk embed_texts
+must not block an interactive rerank/fused query behind its whole batch —
+that's the two-queue-policies design of SURVEY.md §7 hard part 4. (LmEngine
+is different: its decode loop carries KV-cache state across a long scan, so
+it DOES hold its lock for the whole generate call.)
 """
 
 from __future__ import annotations
@@ -27,7 +41,8 @@ import numpy as np
 from symbiont_tpu.config import EngineConfig
 from symbiont_tpu.engine.bucketing import (
     choose_bucket,
-    pad_batch_rows,
+    pad_batch_rows_ids,
+    pad_ids_rows,
     pad_to_bucket,
     plan_batches,
 )
@@ -114,8 +129,14 @@ class TpuEngine:
         if attn_impl not in ("auto", "flash", "xla"):
             raise ValueError(
                 f"attn_impl must be auto|flash|xla, got {attn_impl!r}")
+        # 'auto' is resolved PER LENGTH BUCKET in _get_executable: measured
+        # on v5e, XLA's fused attention beats the pallas flash kernel by
+        # ~35% at short lengths (S<=128; the kernel's tiling only pays off
+        # once S² memory matters), so flash is reserved for buckets >= 256.
+        self._auto_attn = attn_impl == "auto"
+        self._flash_ok = jax.default_backend() == "tpu"
         if attn_impl == "auto":
-            attn_impl = "flash" if jax.default_backend() == "tpu" else "xla"
+            attn_impl = "xla"  # default; long buckets override per-executable
         if model_cfg.attn_impl != attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
         if cross_cfg is not None and cross_cfg.dtype != self.config.dtype:
@@ -128,7 +149,8 @@ class TpuEngine:
         self.cross_params = cross_params
         self.cross_cfg = cross_cfg
 
-        self._lock = threading.Lock()  # single-owner: serialize device access
+        self._lock = threading.Lock()  # guards the executable cache
+        self._stats_lock = threading.Lock()  # guards the counters below
         self._exec_cache: OrderedDict = OrderedDict()
 
         self._data_parallel = False
@@ -150,11 +172,28 @@ class TpuEngine:
             if cross_params is not None:
                 self.cross_params = jax.device_put(cross_params)
 
-        # stats (SURVEY.md §5.5: the reference has none)
+        # stats (SURVEY.md §5.5: the reference has none). Mutate via _bump
+        # only — bare `stats[k] += 1` is a read-modify-write that loses
+        # increments under concurrent entry points.
         self.stats = {"embed_calls": 0, "sentences_embedded": 0,
                       "rerank_calls": 0, "qsearch_calls": 0, "compiles": 0}
 
+    def _bump(self, **counts: int) -> None:
+        with self._stats_lock:
+            for k, v in counts.items():
+                self.stats[k] += v
+
     # ------------------------------------------------------------------ jit
+
+    def _attn_cfg(self, cfg, L: int):
+        """Resolve attn_impl='auto' per length bucket: flash only where the
+        pallas kernel's tiling wins (S >= 256 on TPU); XLA's fused attention
+        is ~35% faster at the short buckets (measured on v5e)."""
+        if self._auto_attn and self._flash_ok and L >= 256:
+            import dataclasses
+
+            return dataclasses.replace(cfg, attn_impl="flash")
+        return cfg
 
     def _get_executable(self, kind: str, L: int, B: int) -> Callable:
         import jax
@@ -166,11 +205,23 @@ class TpuEngine:
                 return self._exec_cache[key]
 
         if kind == "embed":
-            cfg, pooling, normalize = self.model_cfg, self.pooling, self.normalize
+            import jax.numpy as jnp
 
-            def fn(params, ids, mask):
-                return bert_mod.embed_sentences(params, ids, mask, cfg,
-                                                pooling=pooling, normalize=normalize)
+            cfg, pooling, normalize = (self._attn_cfg(self.model_cfg, L),
+                                       self.pooling, self.normalize)
+            d2h_bf16 = self.config.dtype == "bfloat16"
+
+            def fn(params, ids, lengths):
+                # mask rebuilt on device from lengths (half the h2d bytes);
+                # bf16 engines also ship results back as bf16 (half the d2h
+                # bytes — on a network-attached chip d2h bandwidth is the
+                # bulk-ingest wall), cast to f32 on host
+                mask = (jnp.arange(ids.shape[1]) < lengths[:, None]
+                        ).astype(jnp.int32)
+                emb = bert_mod.embed_sentences(params, ids, mask, cfg,
+                                               pooling=pooling,
+                                               normalize=normalize)
+                return emb.astype(jnp.bfloat16) if d2h_bf16 else emb
         elif kind == "qsearch":
             # fused interactive query: BERT forward + pool + normalize +
             # cosine scores against the device-resident corpus + top-k, ONE
@@ -179,7 +230,7 @@ class TpuEngine:
             # network-attached chip each costs ~100ms).
             import jax.numpy as jnp
 
-            cfg, pooling = self.model_cfg, self.pooling
+            cfg, pooling = self._attn_cfg(self.model_cfg, L), self.pooling
             cap, k = B  # for qsearch the batch slot carries (capacity, top_k)
 
             def fn(params, ids, mask, corpus, n_valid):
@@ -191,30 +242,47 @@ class TpuEngine:
                 scores = jnp.where(valid, scores, -jnp.inf)
                 return jax.lax.top_k(scores, k)
         elif kind == "rerank":
-            ccfg = self.cross_cfg
+            import jax.numpy as jnp
 
-            def fn(params, ids, mask, types):
-                return bert_mod.cross_encoder_score(params, ids, mask, ccfg, types)
+            ccfg = self._attn_cfg(self.cross_cfg, L)
+
+            def fn(params, ids, lengths, len_a):
+                # mask and token-type ids rebuilt on device from two [B]
+                # length vectors (vs two [B, L] matrices over the wire)
+                pos = jnp.arange(ids.shape[1])
+                mask = (pos < lengths[:, None]).astype(jnp.int32)
+                types = ((pos >= len_a[:, None]) & (pos < lengths[:, None])
+                         ).astype(jnp.int32)
+                return bert_mod.cross_encoder_score(params, ids, mask, ccfg,
+                                                    types)
         else:
             raise ValueError(kind)
 
         jitted = jax.jit(fn)
         with self._lock:
+            # two threads can race the cold-miss check above; the loser
+            # discards its wrapper and reuses the winner's, so one shape
+            # never compiles (or counts) twice
+            if key in self._exec_cache:
+                self._exec_cache.move_to_end(key)
+                return self._exec_cache[key]
             self._exec_cache[key] = jitted
-            self.stats["compiles"] += 1
             while len(self._exec_cache) > self.config.executable_cache_size:
                 self._exec_cache.popitem(last=False)
+        self._bump(compiles=1)
         return jitted
 
-    def _device_batch(self, ids: np.ndarray, mask: np.ndarray):
+    def _device_batch(self, *arrays: np.ndarray):
+        """Move batch-dim-0 arrays to the device (sharded over 'data' when
+        data-parallel)."""
         import jax.numpy as jnp
 
         if self._batch_sharding is not None:
             import jax
 
-            return (jax.device_put(jnp.asarray(ids), self._batch_sharding),
-                    jax.device_put(jnp.asarray(mask), self._batch_sharding))
-        return jnp.asarray(ids), jnp.asarray(mask)
+            return tuple(jax.device_put(jnp.asarray(a), self._batch_sharding)
+                         for a in arrays)
+        return tuple(jnp.asarray(a) for a in arrays)
 
     def _batch_bucket(self, n: int) -> int:
         b = choose_bucket(n, self.config.batch_buckets)
@@ -247,17 +315,16 @@ class TpuEngine:
             for bucket, indices in plan_batches(lengths, buckets,
                                                 self.config.max_batch):
                 seqs = [encoded[i] for i in indices]
-                ids, mask = pad_to_bucket(seqs, bucket, self.tokenizer.pad_id)
+                ids, lens = pad_ids_rows(seqs, bucket, self.tokenizer.pad_id)
                 bb = self._batch_bucket(len(indices))
-                ids, mask, n_real = pad_batch_rows(ids, mask, bb)
+                ids, lens, n_real = pad_batch_rows_ids(ids, lens, bb)
                 fn = self._get_executable("embed", bucket, bb)
-                ids_d, mask_d = self._device_batch(ids, mask)
-                pending.append((indices, n_real, fn(self.params, ids_d, mask_d)))
+                ids_d, lens_d = self._device_batch(ids, lens)
+                pending.append((indices, n_real, fn(self.params, ids_d, lens_d)))
             _start_host_copies(batch for _, _, batch in pending)
             for indices, n_real, res_dev in pending:
                 out[indices] = np.asarray(res_dev)[:n_real]
-        self.stats["embed_calls"] += 1
-        self.stats["sentences_embedded"] += len(texts)
+        self._bump(embed_calls=1, sentences_embedded=len(texts))
         return out
 
     def embed_query(self, text: str) -> np.ndarray:
@@ -286,7 +353,7 @@ class TpuEngine:
             scores, idx = fn(self.params, jnp.asarray(ids), jnp.asarray(mask),
                              corpus_dev, n_valid)
             _start_host_copies((scores, idx))  # both d2h copies in flight
-            self.stats["qsearch_calls"] += 1
+            self._bump(qsearch_calls=1)
             return np.asarray(scores), np.asarray(idx)
 
     # --------------------------------------------------------------- rerank
@@ -301,32 +368,35 @@ class TpuEngine:
                       self.cross_cfg.max_position_embeddings)
         pairs = [self.tokenizer.encode_pair(query, p, max_len) for p in passages]
         lengths = [len(ids) for ids, _ in pairs]
+        # segment-A width per pair (types are a contiguous 0-run then 1-run);
+        # the executable rebuilds mask AND token-type ids from two [B]
+        # vectors instead of shipping two [B, L] matrices
+        a_widths = [sum(1 for t in types if t == 0) for _, types in pairs]
         buckets = [b for b in self.config.length_buckets
                    if b <= self.cross_cfg.max_position_embeddings]
         out = np.zeros((len(passages),), np.float32)
-        import jax.numpy as jnp
 
         pending = []
         with maybe_profile("engine.rerank"):
             for bucket, indices in plan_batches(lengths, buckets,
                                                 self.config.max_batch):
-                ids, mask = pad_to_bucket([pairs[i][0] for i in indices], bucket,
-                                          self.tokenizer.pad_id)
-                types, _ = pad_to_bucket([pairs[i][1] for i in indices], bucket, 0)
+                ids, lens = pad_ids_rows([pairs[i][0] for i in indices],
+                                         bucket, self.tokenizer.pad_id)
+                len_a = np.asarray([min(a_widths[i], bucket) for i in indices],
+                                   np.int32)
                 bb = self._batch_bucket(len(indices))
-                ids, mask, n_real = pad_batch_rows(ids, mask, bb)
-                types = np.concatenate(
-                    [types, np.zeros((bb - n_real, bucket), np.int32)], axis=0
-                ) if types.shape[0] < bb else types
+                ids, lens, n_real = pad_batch_rows_ids(ids, lens, bb)
+                if len_a.shape[0] < bb:
+                    len_a = np.concatenate(
+                        [len_a, np.zeros(bb - n_real, np.int32)])
                 fn = self._get_executable("rerank", bucket, bb)
-                ids_d, mask_d = self._device_batch(ids, mask)
+                ids_d, lens_d, len_a_d = self._device_batch(ids, lens, len_a)
                 pending.append((indices, n_real,
-                                fn(self.cross_params, ids_d, mask_d,
-                                   jnp.asarray(types))))
+                                fn(self.cross_params, ids_d, lens_d, len_a_d)))
             _start_host_copies(batch for _, _, batch in pending)
             for indices, n_real, res_dev in pending:
                 out[indices] = np.asarray(res_dev)[:n_real]
-        self.stats["rerank_calls"] += 1
+        self._bump(rerank_calls=1)
         return out
 
     # ---------------------------------------------------------------- warm
@@ -338,17 +408,16 @@ class TpuEngine:
         when a cross-encoder is loaded — the rerank hop has the tightest
         caller timeout (request_timeout_rerank_s), so it can least afford a
         first-request compile."""
-        import jax.numpy as jnp
-
         for L in buckets or self.config.length_buckets[:2]:
             for B in batches or self.config.batch_buckets[:2]:
                 bb = self._batch_bucket(B)
                 ids = np.ones((bb, L), np.int32)
-                mask = np.ones((bb, L), np.int32)
+                lens = np.full((bb,), L, np.int32)
                 fn = self._get_executable("embed", L, bb)
-                ids_d, mask_d = self._device_batch(ids, mask)
-                np.asarray(fn(self.params, ids_d, mask_d))
+                ids_d, lens_d = self._device_batch(ids, lens)
+                np.asarray(fn(self.params, ids_d, lens_d))
                 if self.cross_params is not None:
                     fn = self._get_executable("rerank", L, bb)
-                    types = jnp.zeros((bb, L), jnp.int32)
-                    np.asarray(fn(self.cross_params, ids_d, mask_d, types))
+                    len_a = np.full((bb,), L // 2, np.int32)
+                    (len_a_d,) = self._device_batch(len_a)
+                    np.asarray(fn(self.cross_params, ids_d, lens_d, len_a_d))

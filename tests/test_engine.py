@@ -257,3 +257,65 @@ def test_warm_fused_tracks_capacity_blocks(tmp_path):
     store.warm_fused(eng, word_counts=(3,))
     assert store._warmed_capacity == 128
     assert not store.fused_warm_stale()
+
+
+def test_concurrent_entry_points_stress(tmp_path):
+    """The engine's concurrency contract (module docstring): embed / rerank /
+    fused-search may run concurrently from multiple threads — results must
+    equal the serial baselines and the stats counters must be exact (bare
+    `+=` would lose increments under this contention)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[2, 4], max_batch=4, dtype="float32",
+                       data_parallel=False, rerank_enabled=True)
+    eng = TpuEngine(cfg)
+    store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path),
+                                          shard_capacity=64))
+    corpus = [f"doc {i} about topic {i % 3}" for i in range(12)]
+    vecs = eng.embed_texts(corpus)
+    store.upsert([(f"p{i}", vecs[i], {"i": i}) for i in range(len(corpus))])
+
+    texts = [f"query text number {i}" for i in range(6)]
+    base_embed = eng.embed_texts(texts)
+    base_rerank = eng.rerank("topic", corpus[:5])
+    base_fused = [h.id for h in store.search_fused(eng, "topic 1", 4)]
+    s0 = dict(eng.stats)
+
+    N = 8
+    with ThreadPoolExecutor(max_workers=12) as pool:
+        emb_f = [pool.submit(eng.embed_texts, texts) for _ in range(N)]
+        rr_f = [pool.submit(eng.rerank, "topic", corpus[:5]) for _ in range(N)]
+        fu_f = [pool.submit(store.search_fused, eng, "topic 1", 4)
+                for _ in range(N)]
+        for f in emb_f:
+            np.testing.assert_allclose(f.result(), base_embed, rtol=1e-5)
+        for f in rr_f:
+            np.testing.assert_allclose(f.result(), base_rerank, rtol=1e-5)
+        for f in fu_f:
+            assert [h.id for h in f.result()] == base_fused
+
+    # counters exact under contention
+    assert eng.stats["embed_calls"] == s0["embed_calls"] + N
+    assert eng.stats["rerank_calls"] == s0["rerank_calls"] + N
+    assert eng.stats["qsearch_calls"] == s0["qsearch_calls"] + N
+    assert eng.stats["sentences_embedded"] == s0["sentences_embedded"] + N * len(texts)
+
+
+def test_cold_executable_race_compiles_once():
+    """Two threads racing a COLD executable key must converge on one cached
+    executable and count one compile (the loser discards its wrapper)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng = _small_engine()
+    texts = ["same shape text"] * 2
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        a = pool.submit(eng.embed_texts, texts)
+        b = pool.submit(eng.embed_texts, texts)
+        np.testing.assert_allclose(a.result(), b.result(), rtol=1e-6)
+    # both calls hit one (bucket, batch-bucket) shape → exactly one compile
+    assert eng.stats["compiles"] == 1
+    assert len(eng._exec_cache) == 1
