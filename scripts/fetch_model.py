@@ -1,0 +1,62 @@
+"""Fetch a pretrained checkpoint snapshot for the engine (needs egress).
+
+The reference downloads its model from the HF hub on every boot
+(reference: services/preprocessing_service/src/embedding_generator.rs:25-58);
+this framework is offline-first — the engine only ever reads a LOCAL model
+dir (config.engine.model_dir). This script is the documented bridge: run it
+once where egress exists, ship the directory, point the engine at it.
+
+    python scripts/fetch_model.py sentence-transformers/all-MiniLM-L6-v2 \
+        --out models/minilm
+    SYMBIONT_ENGINE_MODEL_DIR=models/minilm python -m symbiont_tpu.runner engine
+
+Then (optional) pre-convert so engine restarts skip conversion entirely:
+
+    python -m symbiont_tpu.models.convert models/minilm --out models/minilm-ckpt
+
+The gated test tier validates a fetched snapshot end-to-end:
+
+    SYMBIONT_MODEL_DIR=models/minilm python -m pytest tests/test_real_assets.py -q
+
+BASELINE.md model set: sentence-transformers/all-MiniLM-L6-v2 (config #1),
+BAAI/bge-base-en-v1.5 (#2), intfloat/e5-large-v2 (#3),
+cross-encoder/ms-marco-MiniLM-L-6-v2 (#4, use --pooler when converting),
+sentence-transformers/paraphrase-multilingual-mpnet-base-v2 (the reference's
+default, main.rs:305).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+NEEDED = ["config.json", "tokenizer.json", "tokenizer_config.json",
+          "special_tokens_map.json", "vocab.txt", "sentencepiece.bpe.model",
+          "*.safetensors", "*.safetensors.index.json"]
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model_id", help="hub id, e.g. sentence-transformers/all-MiniLM-L6-v2")
+    ap.add_argument("--out", required=True, help="local directory to populate")
+    ap.add_argument("--revision", default="main")
+    args = ap.parse_args(argv)
+
+    from huggingface_hub import snapshot_download
+
+    path = snapshot_download(
+        args.model_id, revision=args.revision, allow_patterns=NEEDED,
+        local_dir=args.out)
+    out = Path(path)
+    have = sorted(p.name for p in out.iterdir())
+    print(f"fetched {args.model_id}@{args.revision} -> {out}")
+    print(f"files: {have}")
+    if not any(n.endswith(".safetensors") or n.endswith(".index.json") for n in have):
+        raise SystemExit("no safetensors in snapshot — this repo may only ship "
+                         ".bin weights; re-run without allow_patterns or convert "
+                         "with transformers first")
+
+
+if __name__ == "__main__":
+    main()

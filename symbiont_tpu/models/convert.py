@@ -206,6 +206,77 @@ def convert_gpt(state_dict: Dict[str, Any], cfg) -> Params:
     return params
 
 
+def export_hf_bert(params: Params, cfg: BertConfig, out_dir: str | Path,
+                   tokenizer_file: str | Path | None = None) -> Path:
+    """Inverse of convert_bert: write a hub-format model dir
+    (config.json + model.safetensors, torch tensor-name layout) from a bert.py
+    pytree — so checkpoints trained IN this framework are loadable by both the
+    engine's standard model_dir path and by `transformers` itself. Kernels go
+    back to torch Linear's [out, in]; tensor names match what BertModel's own
+    save_pretrained produces (no "bert." prefix — convert_bert strips either
+    form)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    sd: Dict[str, np.ndarray] = {}
+
+    def put_linear(prefix: str, p: dict) -> None:
+        sd[f"{prefix}.weight"] = np.ascontiguousarray(
+            np.asarray(p["kernel"], np.float32).T)
+        sd[f"{prefix}.bias"] = np.asarray(p["bias"], np.float32)
+
+    def put_ln(prefix: str, p: dict) -> None:
+        sd[f"{prefix}.weight"] = np.asarray(p["scale"], np.float32)
+        sd[f"{prefix}.bias"] = np.asarray(p["bias"], np.float32)
+
+    emb = params["embeddings"]
+    sd["embeddings.word_embeddings.weight"] = np.asarray(
+        emb["word_embeddings"], np.float32)
+    sd["embeddings.position_embeddings.weight"] = np.asarray(
+        emb["position_embeddings"], np.float32)
+    sd["embeddings.token_type_embeddings.weight"] = np.asarray(
+        emb["token_type_embeddings"], np.float32)
+    put_ln("embeddings.LayerNorm", emb["ln"])
+    for i, layer in enumerate(params["layers"]):
+        p = f"encoder.layer.{i}"
+        put_linear(f"{p}.attention.self.query", layer["attention"]["query"])
+        put_linear(f"{p}.attention.self.key", layer["attention"]["key"])
+        put_linear(f"{p}.attention.self.value", layer["attention"]["value"])
+        put_linear(f"{p}.attention.output.dense", layer["attention"]["out"])
+        put_ln(f"{p}.attention.output.LayerNorm", layer["attention"]["ln"])
+        put_linear(f"{p}.intermediate.dense", layer["mlp"]["in"])
+        put_linear(f"{p}.output.dense", layer["mlp"]["out"])
+        put_ln(f"{p}.output.LayerNorm", layer["mlp"]["ln"])
+    if "pooler" in params:
+        put_linear("pooler.dense", params["pooler"])
+    if "classifier" in params:
+        put_linear("classifier", params["classifier"])
+
+    from safetensors.numpy import save_file
+
+    # metadata format=pt: transformers refuses safetensors without it
+    save_file(sd, str(out_dir / "model.safetensors"), metadata={"format": "pt"})
+    hf_cfg = {
+        "model_type": "bert",
+        "architectures": ["BertModel"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "intermediate_size": cfg.intermediate_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "type_vocab_size": cfg.type_vocab_size,
+        "layer_norm_eps": cfg.layer_norm_eps,
+        "hidden_act": cfg.hidden_act,
+        "pad_token_id": 0,
+    }
+    (out_dir / "config.json").write_text(json.dumps(hf_cfg, indent=2))
+    if tokenizer_file is not None:
+        import shutil
+
+        shutil.copyfile(tokenizer_file, out_dir / "tokenizer.json")
+    return out_dir
+
+
 def load_gpt_model(model_dir: str | Path):
     """One-call load: (params, GPTConfig) from a local HF model dir."""
     from symbiont_tpu.models.gpt import GPTConfig

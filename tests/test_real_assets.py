@@ -1,0 +1,217 @@
+"""Real checkpoint assets through the full load path — no network.
+
+VERDICT.md round-1 gap #1: the converter had "never eaten a real
+model.safetensors" and the engine had never loaded a model dir end-to-end.
+This tier builds GENUINE assets on disk in the exact formats the HF hub ships
+— a `model.safetensors` written by transformers' own serializer and a
+WordPiece `tokenizer.json` actually *trained* by the `tokenizers` library —
+then drives the standard production path: EngineConfig(model_dir=...) →
+convert.load_bert_model + HFTokenizer → TpuEngine.embed_texts, golden-checked
+against transformers' forward + the reference's masked mean pooling
+(reference: services/preprocessing_service/src/embedding_generator.rs:198-207).
+
+A second, env-gated tier (SYMBIONT_MODEL_DIR) runs the same golden check
+against a real pretrained checkpoint (all-MiniLM-L6-v2 / mpnet) when one is
+present — see scripts/fetch_model.py for the documented fetch path.
+"""
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+tokenizers = pytest.importorskip("tokenizers")
+
+from symbiont_tpu.config import EngineConfig  # noqa: E402
+from symbiont_tpu.engine.engine import TpuEngine  # noqa: E402
+from symbiont_tpu.engine.tokenizer import HFTokenizer  # noqa: E402
+
+CORPUS = [
+    "the systolic array multiplies matrices in bfloat16",
+    "high bandwidth memory feeds the matrix unit",
+    "the compiler fuses elementwise operations into the matmul",
+    "static shapes let the scheduler tile the loop onto hardware",
+    "collectives ride the interconnect between chips in the mesh",
+    "the vector store ranks documents by cosine similarity",
+    "sentence embeddings are pooled from the final hidden states",
+    "the scraper extracts the main content from a web page",
+    "messages flow through the broker between worker services",
+    "the gateway streams generated text to the browser",
+    "a knowledge graph links documents sentences and tokens",
+    "checkpoints let a restarted engine skip the conversion step",
+    "length buckets avoid padding every sentence to the maximum",
+    "the decoder caches keys and values between steps",
+    "search latency is dominated by the forward pass of the query",
+    "gradients are averaged across the data parallel axis",
+] * 4
+
+
+def _train_wordpiece(out_file: Path, vocab_size: int = 200) -> int:
+    """Train a real WordPiece tokenizer (the algorithm and file format every
+    BERT-family model in BASELINE.md ships) and save tokenizer.json."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordPiece
+    from tokenizers.normalizers import BertNormalizer
+    from tokenizers.pre_tokenizers import BertPreTokenizer
+    from tokenizers.processors import TemplateProcessing
+    from tokenizers.trainers import WordPieceTrainer
+
+    tok = Tokenizer(WordPiece(unk_token="[UNK]"))
+    tok.normalizer = BertNormalizer(lowercase=True)
+    tok.pre_tokenizer = BertPreTokenizer()
+    trainer = WordPieceTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"])
+    tok.train_from_iterator(CORPUS, trainer)
+    cls_id = tok.token_to_id("[CLS]")
+    sep_id = tok.token_to_id("[SEP]")
+    tok.post_processor = TemplateProcessing(
+        single="[CLS] $A [SEP]",
+        pair="[CLS] $A [SEP] $B:1 [SEP]:1",
+        special_tokens=[("[CLS]", cls_id), ("[SEP]", sep_id)])
+    tok.save(str(out_file))
+    return tok.get_vocab_size()
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory) -> Path:
+    """A model dir indistinguishable in format from a hub snapshot:
+    config.json + model.safetensors (transformers' own safe serializer) +
+    a trained tokenizer.json."""
+    d = tmp_path_factory.mktemp("real_model")
+    vocab = _train_wordpiece(d / "tokenizer.json")
+    torch.manual_seed(7)
+    cfg = transformers.BertConfig(
+        vocab_size=vocab, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64)
+    model = transformers.BertModel(cfg).eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return d
+
+
+@pytest.fixture(scope="module")
+def hf_ref(model_dir):
+    model = transformers.BertModel.from_pretrained(model_dir).eval()
+    tok = transformers.PreTrainedTokenizerFast(
+        tokenizer_file=str(model_dir / "tokenizer.json"),
+        pad_token="[PAD]", cls_token="[CLS]", sep_token="[SEP]",
+        unk_token="[UNK]")
+    return model, tok
+
+
+def _hf_mean_pool(model, tok, texts):
+    enc = tok(texts, padding=True, return_tensors="pt")
+    with torch.no_grad():
+        h = model(input_ids=enc["input_ids"],
+                  attention_mask=enc["attention_mask"]).last_hidden_state
+    m = enc["attention_mask"].unsqueeze(-1).float()
+    return ((h * m).sum(1) / m.sum(1)).numpy()
+
+
+def test_assets_are_the_real_formats(model_dir):
+    assert (model_dir / "model.safetensors").exists()  # not a .bin, not .npz
+    assert (model_dir / "config.json").exists()
+    assert (model_dir / "tokenizer.json").exists()
+    # the tokenizer is a trained subword model, not a toy word-level map
+    import json
+
+    tj = json.loads((model_dir / "tokenizer.json").read_text())
+    assert tj["model"]["type"] == "WordPiece"
+    assert any(k.startswith("##") for k in tj["model"]["vocab"])  # subwords
+
+
+def test_engine_loads_model_dir_and_matches_hf(model_dir, hf_ref):
+    """The production path: EngineConfig(model_dir) → converted safetensors
+    weights + HFTokenizer → bucketed embed — golden vs transformers."""
+    model, tok = hf_ref
+    eng = TpuEngine(EngineConfig(model_dir=str(model_dir), dtype="float32",
+                                 length_buckets=[16, 32, 64],
+                                 batch_buckets=[2, 4, 8], max_batch=8,
+                                 data_parallel=False))
+    assert isinstance(eng.tokenizer, HFTokenizer)
+    texts = ["the systolic array multiplies matrices",
+             "search latency is dominated by the forward pass",
+             "checkpoints skip conversion"]
+    ours = eng.embed_texts(texts)
+    ref = _hf_mean_pool(model, tok, texts)
+    np.testing.assert_allclose(ours, ref, atol=3e-5, rtol=1e-4)
+
+
+def test_tokenizer_ids_match_transformers(model_dir, hf_ref):
+    _, tok = hf_ref
+    ours = HFTokenizer(model_dir / "tokenizer.json")
+    for text in ["high bandwidth memory feeds the matrix unit",
+                 "an unseen word zyzzyva splits into subwords"]:
+        ref_ids = tok(text)["input_ids"]
+        assert ours.encode(text, 64) == ref_ids
+
+
+def test_sharded_safetensors_roundtrip(model_dir, tmp_path):
+    """The hub ships big models as sharded safetensors + index.json — the
+    layout the reference special-cases (embedding_generator.rs:36-50).
+    load_state_dict must reassemble it identically to the single file."""
+    from symbiont_tpu.models.convert import load_state_dict
+
+    single = load_state_dict(model_dir)
+    model = transformers.BertModel.from_pretrained(model_dir).eval()
+    sharded_dir = tmp_path / "sharded"
+    model.save_pretrained(sharded_dir, safe_serialization=True,
+                          max_shard_size="50KB")
+    assert (sharded_dir / "model.safetensors.index.json").exists()
+    assert not (sharded_dir / "model.safetensors").exists()
+    sharded = load_state_dict(sharded_dir)
+    assert set(sharded) == set(single)
+    for k in single:
+        np.testing.assert_array_equal(sharded[k], single[k])
+
+
+def test_convert_cli_on_real_safetensors(model_dir, tmp_path, capsys):
+    """`python -m symbiont_tpu.models.convert` on a hub-format dir caches a
+    checkpoint the engine can boot from without reconversion."""
+    from symbiont_tpu.models import convert as convert_mod
+    from symbiont_tpu.train.checkpoint import load_params
+
+    out = tmp_path / "ckpt"
+    convert_mod.main([str(model_dir), "--out", str(out)])
+    assert "converted OK" in capsys.readouterr().out
+    _, meta = load_params(out)
+    assert meta["kind"] == "bert"
+
+
+# --------------------------------------------------------- gated real tier
+
+REAL_DIR = os.environ.get("SYMBIONT_MODEL_DIR")
+
+
+@pytest.mark.skipif(
+    not REAL_DIR, reason="SYMBIONT_MODEL_DIR not set — run scripts/fetch_model.py "
+    "where egress exists, then point SYMBIONT_MODEL_DIR at the snapshot")
+def test_real_pretrained_checkpoint_golden():
+    """Golden embeddings vs transformers on a REAL pretrained checkpoint
+    (all-MiniLM-L6-v2 / mpnet-multilingual — BASELINE.md configs #1/#3), plus
+    a semantic sanity check: related sentences score higher than unrelated."""
+    d = Path(REAL_DIR)
+    model = transformers.AutoModel.from_pretrained(d).eval()
+    tok = transformers.AutoTokenizer.from_pretrained(d)
+    eng = TpuEngine(EngineConfig(model_dir=str(d), dtype="float32",
+                                 data_parallel=False))
+    texts = ["A cat sits on the mat.",
+             "A kitten rests on a rug.",
+             "The stock market fell sharply today."]
+    ours = eng.embed_texts(texts)
+    enc = tok(texts, padding=True, truncation=True, return_tensors="pt")
+    with torch.no_grad():
+        h = model(**{k: v for k, v in enc.items()
+                     if k in ("input_ids", "attention_mask")}).last_hidden_state
+    m = enc["attention_mask"].unsqueeze(-1).float()
+    ref = ((h * m).sum(1) / m.sum(1)).numpy()
+    cos = (ours * ref).sum(-1) / (
+        np.linalg.norm(ours, axis=-1) * np.linalg.norm(ref, axis=-1))
+    assert cos.min() > 0.999, cos
+    # semantically meaningful: paraphrase pair beats the unrelated pair
+    n = ours / np.linalg.norm(ours, axis=-1, keepdims=True)
+    assert n[0] @ n[1] > n[0] @ n[2]
