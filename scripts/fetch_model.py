@@ -33,6 +33,19 @@ from pathlib import Path
 NEEDED = ["config.json", "tokenizer.json", "tokenizer_config.json",
           "special_tokens_map.json", "vocab.txt", "sentencepiece.bpe.model",
           "*.safetensors", "*.safetensors.index.json"]
+# load_state_dict handles a SINGLE-file torch checkpoint too (convert.py:58-63)
+# — fetched only as a fallback so safetensors-shipping repos don't pull both
+# formats. Sharded .bin (pytorch_model-*-of-*.bin) is NOT loadable; repos that
+# ship only that format need a transformers conversion first.
+BIN_FALLBACK = ["pytorch_model.bin"]
+
+
+def _weight_files(out: Path) -> list:
+    """Files load_state_dict can actually boot from."""
+    return [p.name for p in out.iterdir() if p.is_file() and
+            (p.name.endswith(".safetensors")
+             or p.name.endswith(".safetensors.index.json")
+             or p.name == "pytorch_model.bin")]
 
 
 def main(argv=None) -> None:
@@ -49,13 +62,20 @@ def main(argv=None) -> None:
         args.model_id, revision=args.revision, allow_patterns=NEEDED,
         local_dir=args.out)
     out = Path(path)
-    have = sorted(p.name for p in out.iterdir())
+    if not _weight_files(out):
+        print("no safetensors in snapshot — falling back to torch .bin weights")
+        snapshot_download(
+            args.model_id, revision=args.revision,
+            allow_patterns=NEEDED + BIN_FALLBACK, local_dir=args.out)
+    # top-level regular files only: the hub's .cache bookkeeping dir lives
+    # inside local_dir and is not part of the snapshot
+    have = sorted(p.name for p in out.iterdir() if p.is_file())
     print(f"fetched {args.model_id}@{args.revision} -> {out}")
     print(f"files: {have}")
-    if not any(n.endswith(".safetensors") or n.endswith(".index.json") for n in have):
-        raise SystemExit("no safetensors in snapshot — this repo may only ship "
-                         ".bin weights; re-run without allow_patterns or convert "
-                         "with transformers first")
+    if not _weight_files(out):
+        raise SystemExit("snapshot has no safetensors or single-file "
+                         "pytorch_model.bin — convert with transformers first "
+                         "(sharded .bin checkpoints are not loadable here)")
 
 
 if __name__ == "__main__":

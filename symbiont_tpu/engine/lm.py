@@ -325,26 +325,44 @@ class LmEngine:
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         chunk = min(cfg.stream_chunk, new_bucket)
 
+        # Lock discipline: the engine lock is held only around device work
+        # (prefill, each decode_chunk) and NEVER across a yield — a stalled
+        # SSE consumer must not starve concurrent generate()/generate_batch()
+        # callers waiting on the same lock. This is safe because the KV cache
+        # is owned by this generator frame: decode_chunk is functional
+        # (params read-only, cache carried in and out as a value), so other
+        # engine calls interleaving between chunks can't observe or mutate
+        # this stream's state. The stream stays consumer-paced: nothing
+        # decodes while the consumer is parked between deltas.
+        decode_s = 0.0
         with self._lock:
-            self._key, sub = jax.random.split(self._key)
+            # timers start inside the lock: decode_s counts this stream's own
+            # device work, not time spent waiting on other callers
             t0 = time.perf_counter()
+            self._key, sub = jax.random.split(self._key)
             cache, logits, kv_valid, prompt_len = gpt_mod.prefill(
                 self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
                 self.model_cfg, new_bucket)
-            done = jnp.zeros((prompt_ids.shape[0],), bool)
-            pos = prompt_len
-            all_tokens: list = []
-            decoder = IncrementalDecoder(self.tokenizer)
-            stop = False
+            decode_s += time.perf_counter() - t0
+        done = jnp.zeros((prompt_ids.shape[0],), bool)
+        pos = prompt_len
+        all_tokens: list = []
+        decoder = IncrementalDecoder(self.tokenizer)
+        stop = False
+        try:
             while len(all_tokens) < max_new_tokens and not stop:
                 sub, use = jax.random.split(sub)
                 keys = jax.random.split(use, chunk)
-                cache, logits, pos, done, toks, counted = gpt_mod.decode_chunk(
-                    self.params, cache, logits, pos, done, kv_valid, keys,
-                    self.model_cfg, temperature=float(temperature),
-                    top_k=int(top_k), eos_id=int(eos_id))
-                toks = np.asarray(toks)[0]
-                counted = np.asarray(counted)[0]
+                with self._lock:
+                    t1 = time.perf_counter()
+                    (cache, logits, pos, done, toks,
+                     counted) = gpt_mod.decode_chunk(
+                        self.params, cache, logits, pos, done, kv_valid, keys,
+                        self.model_cfg, temperature=float(temperature),
+                        top_k=int(top_k), eos_id=int(eos_id))
+                    toks = np.asarray(toks)[0]
+                    counted = np.asarray(counted)[0]
+                    decode_s += time.perf_counter() - t1
                 for t, c in zip(toks, counted):
                     if not c:  # EOS (or a post-EOS slot): stream ends here
                         stop = True
@@ -358,9 +376,12 @@ class LmEngine:
             final_delta = decoder.flush(all_tokens)
             if final_delta:
                 yield final_delta
-            self.stats["generate_calls"] += 1
-            self.stats["tokens_generated"] += len(all_tokens)
-            self.stats["decode_s"] += time.perf_counter() - t0
+        finally:
+            # runs on normal exit AND on generator close (client disconnect)
+            with self._lock:
+                self.stats["generate_calls"] += 1
+                self.stats["tokens_generated"] += len(all_tokens)
+                self.stats["decode_s"] += decode_s
 
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""

@@ -255,9 +255,21 @@ def export_hf_bert(params: Params, cfg: BertConfig, out_dir: str | Path,
 
     # metadata format=pt: transformers refuses safetensors without it
     save_file(sd, str(out_dir / "model.safetensors"), metadata={"format": "pt"})
+    # model_type must invert BertConfig.from_hf exactly: an XLM-RoBERTa-family
+    # pytree (position_offset = pad_token_id + 1, e.g. the default
+    # mpnet-multilingual model) written back as model_type='bert'/pad=0 would
+    # reload with offset-0 position ids — silently wrong embeddings both here
+    # and in transformers. from_hf derives offset from pad_token_id, so
+    # pad_token_id = position_offset - 1 round-trips it.
+    if cfg.position_offset:
+        model_type, architectures = "xlm-roberta", ["XLMRobertaModel"]
+        pad_token_id = cfg.position_offset - 1
+    else:
+        model_type, architectures = "bert", ["BertModel"]
+        pad_token_id = 0
     hf_cfg = {
-        "model_type": "bert",
-        "architectures": ["BertModel"],
+        "model_type": model_type,
+        "architectures": architectures,
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "num_hidden_layers": cfg.num_layers,
@@ -267,7 +279,7 @@ def export_hf_bert(params: Params, cfg: BertConfig, out_dir: str | Path,
         "type_vocab_size": cfg.type_vocab_size,
         "layer_norm_eps": cfg.layer_norm_eps,
         "hidden_act": cfg.hidden_act,
-        "pad_token_id": 0,
+        "pad_token_id": pad_token_id,
     }
     (out_dir / "config.json").write_text(json.dumps(hf_cfg, indent=2))
     if tokenizer_file is not None:

@@ -156,9 +156,11 @@ class TextGeneratorService(Service):
         with span("text_generator.generate", msg.headers,
                   max_length=task.max_length):
             if self.lm_stream is not None and task.stream:
-                # per-request opt-in: streaming holds the engine for the
-                # whole decode, so only explicit stream=true requests take
-                # it — everything else rides the micro-batcher
+                # per-request opt-in: a stream decodes chunk-by-chunk (the
+                # engine lock is released between chunks, lm.py:328-336) but
+                # still can't share one batched executable with other
+                # requests, so only explicit stream=true requests take it —
+                # everything else rides the micro-batcher
                 text = await self._stream_generate(task, msg.headers)
             elif self.lm_batcher is not None:
                 text = await self.lm_batcher.generate(

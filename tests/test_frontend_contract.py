@@ -1,0 +1,333 @@
+"""Reference-frontend compatibility, asserted instead of claimed.
+
+README says the reference's Next.js UI works against these gateways
+unmodified. This module backs that claim: CONTRACT below transcribes every
+expectation the reference UI's own code makes of its API — routes it fetches,
+request payloads it sends, response fields it destructures, and the SSE
+framing EventSource requires (reference: frontend/src/app/page.tsx:7-48
+interfaces, :63-96 SSE wiring, :98-197 handlers) — and both gateways are
+driven through all of them.
+
+Two layers of enforcement:
+1. `test_contract_matches_reference_source` re-DERIVES the routes and
+   interface fields from the reference's page.tsx with regexes and asserts
+   CONTRACT matches, so the transcription itself can't rot (runs only where
+   the reference checkout exists; the gateway tests below never need it).
+2. `test_python_gateway_meets_contract` / `test_native_gateway_meets_contract`
+   run the checks against live gateways end-to-end (real ingest → search →
+   generate → SSE).
+"""
+
+import asyncio
+import json
+import re
+import shutil
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REFERENCE_TSX = Path("/root/reference/frontend/src/app/page.tsx")
+
+CONTRACT = {
+    # route → payload the UI posts (page.tsx:106-110,134-139,166-171)
+    "routes": {
+        "/api/submit-url": {"url": "http://example.com/doc1"},
+        "/api/generate-text": {"task_id": "contract-task-1", "prompt": None,
+                               "max_length": 50},
+        "/api/search/semantic": {"query_text": "vector memory stores",
+                                 "top_k": 5},
+    },
+    "sse_route": "/api/events",  # page.tsx:66 EventSource target
+    # response fields the UI destructures (page.tsx interfaces)
+    "ApiResponse": {"message"},  # task_id optional (page.tsx:7-10)
+    "SharedGeneratedTextMessage": {"original_task_id", "generated_text",
+                                   "timestamp_ms"},
+    "SemanticSearchApiResponsePayload": {"search_request_id", "results",
+                                         "error_message"},
+    "SemanticSearchResultItem": {"qdrant_point_id", "score", "payload"},
+    "QdrantPointPayload": {"original_document_id", "source_url",
+                           "sentence_text", "sentence_order", "model_name",
+                           "processed_at_ms"},
+    # the UI runs on a different origin (localhost:3000) than the API, so
+    # fetch/EventSource need CORS on every route (reference CORS setup:
+    # api_service/src/main.rs:555-567)
+    "cors_origin": "http://localhost:3000",
+}
+
+DOC_HTML = """
+  <html><body><article>
+    <p>TPUs accelerate matrix multiplication. They excel at embeddings!</p>
+    <p>Vector memory stores every sentence.</p>
+  </article></body></html>"""
+
+
+# ------------------------------------------------- layer 1: derive from TSX
+
+@pytest.mark.skipif(not REFERENCE_TSX.exists(),
+                    reason="reference checkout not present")
+def test_contract_matches_reference_source():
+    """CONTRACT is a faithful transcription of page.tsx: same fetched routes,
+    same interface field names. If the reference UI changes, this fails
+    before the gateway tests can silently test the wrong contract."""
+    src = REFERENCE_TSX.read_text()
+
+    fetched = set(re.findall(r"fetch\(`\$\{API_BASE_URL\}(/[\w/-]+)`", src))
+    assert {f"/api{r}" for r in fetched} == set(CONTRACT["routes"])
+    (sse,) = re.findall(r"EventSource\(`\$\{API_BASE_URL\}(/[\w/-]+)`", src)
+    assert f"/api{sse}" == CONTRACT["sse_route"]
+
+    def interface_fields(name: str) -> set:
+        m = re.search(rf"interface {name} \{{(.*?)\}}", src, re.S)
+        assert m, f"interface {name} not found in page.tsx"
+        return set(re.findall(r"^\s*(\w+)\??:", m.group(1), re.M))
+
+    assert interface_fields("ApiResponse") == CONTRACT["ApiResponse"] | {"task_id"}
+    for iface in ("SharedGeneratedTextMessage", "SemanticSearchResultItem",
+                  "QdrantPointPayload", "SemanticSearchApiResponsePayload"):
+        assert interface_fields(iface) == CONTRACT[iface], iface
+    # the payload the generate handler builds (page.tsx:128-132)
+    for field in ("task_id", "prompt", "max_length"):
+        assert field in CONTRACT["routes"]["/api/generate-text"]
+    assert re.search(r"prompt:.*?null", src)  # UI really sends null prompts
+
+
+# ----------------------------------------------- layer 2: drive the gateways
+
+def _http(method, port, path, body=None, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"null"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), dict(e.headers)
+
+
+async def _check_contract(port, wait_ingested):
+    """Drive one live gateway through every CONTRACT expectation."""
+    loop = asyncio.get_running_loop()
+
+    def hx(method, path, body=None, headers=None):
+        return loop.run_in_executor(
+            None, lambda: _http(method, port, path, body, headers))
+
+    origin = {"Origin": CONTRACT["cors_origin"]}
+
+    # --- SSE first (the UI connects on mount, before any form submit) -----
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {CONTRACT['sse_route']} HTTP/1.1\r\n"
+                 f"Host: x\r\nAccept: text/event-stream\r\n"
+                 f"Origin: {CONTRACT['cors_origin']}\r\n\r\n".encode())
+    await writer.drain()
+    head = (await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 15)).decode()
+    status_line, *header_lines = head.split("\r\n")
+    assert " 200 " in status_line, status_line
+    sse_headers = {k.strip().lower(): v.strip() for k, _, v in
+                   (h.partition(":") for h in header_lines if ":" in h)}
+    # EventSource hard-fails on any other content type
+    assert sse_headers["content-type"].startswith("text/event-stream")
+    # cross-origin EventSource silently dies without CORS
+    assert sse_headers.get("access-control-allow-origin") in (
+        CONTRACT["cors_origin"], "*")
+    await asyncio.sleep(0.3)  # let the hub register this client
+
+    # --- submit-url (page.tsx:106-116) ------------------------------------
+    status, body, headers = await hx("POST", "/api/submit-url",
+                                     CONTRACT["routes"]["/api/submit-url"],
+                                     origin)
+    assert status == 200, body
+    assert isinstance(body["message"], str) and body["message"]
+    assert headers.get("Access-Control-Allow-Origin") in (
+        CONTRACT["cors_origin"], "*")
+    # error path renders data.message too (page.tsx:115)
+    status, body, _ = await hx("POST", "/api/submit-url", {"url": " "}, origin)
+    assert status != 200 and isinstance(body["message"], str)
+
+    await wait_ingested()
+
+    # --- semantic search (page.tsx:166-190) -------------------------------
+    status, body, headers = await hx(
+        "POST", "/api/search/semantic",
+        CONTRACT["routes"]["/api/search/semantic"], origin)
+    assert status == 200, body
+    assert set(body) >= CONTRACT["SemanticSearchApiResponsePayload"]
+    assert body["error_message"] is None
+    assert isinstance(body["search_request_id"], str)
+    assert body["results"], "ingested corpus must be searchable"
+    for item in body["results"]:
+        assert set(item) >= CONTRACT["SemanticSearchResultItem"]
+        assert isinstance(item["score"], (int, float))  # .toFixed(4) on it
+        assert set(item["payload"]) == CONTRACT["QdrantPointPayload"]
+    assert headers.get("Access-Control-Allow-Origin") in (
+        CONTRACT["cors_origin"], "*")
+
+    # --- generate-text (page.tsx:134-144) ---------------------------------
+    status, body, _ = await hx("POST", "/api/generate-text",
+                               CONTRACT["routes"]["/api/generate-text"],
+                               origin)
+    assert status == 200, body
+    assert isinstance(body["message"], str) and body["message"]
+
+    # --- the generated result arrives over SSE (page.tsx:71-82) -----------
+    async def next_data_frame():
+        while True:  # EventSource ignores comment keep-alives (": ...")
+            frame = await reader.readuntil(b"\n\n")
+            lines = [ln[6:] for ln in frame.decode().splitlines()
+                     if ln.startswith("data: ")]
+            if lines:
+                return json.loads("\n".join(lines))
+
+    event = await asyncio.wait_for(next_data_frame(), 30)
+    assert set(event) >= CONTRACT["SharedGeneratedTextMessage"]
+    assert event["original_task_id"] == \
+        CONTRACT["routes"]["/api/generate-text"]["task_id"]
+    assert isinstance(event["generated_text"], str)
+    assert isinstance(event["timestamp_ms"], int)
+    writer.close()
+
+
+def test_python_gateway_meets_contract(tmp_path):
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (ApiConfig, EngineConfig,
+                                     GraphStoreConfig, SymbiontConfig,
+                                     TextGeneratorConfig, VectorStoreConfig)
+    from symbiont_tpu.runner import SymbiontStack
+
+    cfg = SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16, 32],
+                            batch_buckets=[2, 8], max_batch=8,
+                            dtype="float32", data_parallel=False,
+                            flush_deadline_ms=2.0),
+        vector_store=VectorStoreConfig(dim=32, data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
+        api=ApiConfig(host="127.0.0.1", port=0, sse_keepalive_s=0.5))
+
+    async def scenario():
+        stack = SymbiontStack(cfg, bus=InprocBus(),
+                              fetcher=lambda url: DOC_HTML)
+        await stack.start()
+        try:
+            async def wait_ingested():
+                # generous: first embed compiles executables (~20s CPU)
+                for _ in range(1200):
+                    if stack.vector_store.count() >= 3:
+                        return
+                    await asyncio.sleep(0.1)
+                raise TimeoutError("ingest pipeline stalled")
+
+            await _check_contract(stack.api.port, wait_ingested)
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_gateway_meets_contract(tmp_path):
+    """Same contract against the C++ gateway with C++ workers behind it."""
+    import tempfile
+
+    from tests.test_native_services import (_free_port, _tcp_bus, _wait_ready,
+                                            spawn_worker, stop_worker)
+    from tests.test_native_services import broker as _broker_fixture  # noqa: F401
+
+    import subprocess
+
+    from tests.conftest import NATIVE_MAKE_TARGET, native_bin
+
+    REPO = Path(__file__).resolve().parent.parent
+    subprocess.run(["make", "-C", str(REPO / "native"), NATIVE_MAKE_TARGET],
+                   check=True, capture_output=True)
+    import socket
+    import time
+
+    port = _free_port()
+    broker_proc = subprocess.Popen(
+        [native_bin("symbus_broker"), "--port", str(port),
+         "--host", "127.0.0.1"], stderr=subprocess.PIPE)
+    for _ in range(100):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        broker_proc.kill()
+        raise RuntimeError("broker did not start")
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.services.engine_service import EngineService
+
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], dtype="float32"))
+        api_port = _free_port()
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(port)
+            svc = EngineService(engine_bus, engine=eng, vector_store=store)
+            await svc.start()
+            workers = [spawn_worker("perception", port),
+                       spawn_worker("preprocessing", port),
+                       spawn_worker("vector_memory", port),
+                       spawn_worker("text_generator", port),
+                       spawn_worker("api_gateway", port,
+                                    {"SYMBIONT_API_PORT": str(api_port)})]
+            try:
+                for w in workers:
+                    await _wait_ready(w)
+
+                # serve the CONTRACT submit-url target for the C++ scraper
+                import http.server
+                import threading
+
+                class Handler(http.server.BaseHTTPRequestHandler):
+                    def do_GET(self):
+                        page = DOC_HTML.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length", str(len(page)))
+                        self.end_headers()
+                        self.wfile.write(page)
+
+                    def log_message(self, *a):
+                        pass
+
+                web = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+                threading.Thread(target=web.serve_forever, daemon=True).start()
+                CONTRACT["routes"]["/api/submit-url"] = {
+                    "url": f"http://127.0.0.1:{web.server_address[1]}/doc1"}
+
+                async def wait_ingested():
+                    # generous: first embed compiles executables (~20s CPU)
+                    for _ in range(1200):
+                        if store.count() >= 3:
+                            return
+                        await asyncio.sleep(0.1)
+                    raise TimeoutError("native ingest pipeline stalled")
+
+                try:
+                    await _check_contract(api_port, wait_ingested)
+                finally:
+                    web.shutdown()
+            finally:
+                for w in workers:
+                    stop_worker(w)
+                await svc.stop()
+                await engine_bus.close()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        broker_proc.terminate()
+        broker_proc.wait(timeout=5)

@@ -194,6 +194,62 @@ def test_generate_stream_greedy_matches_generate():
     assert len(deltas) > 1  # actually streamed, not one blob
 
 
+def test_slow_stream_consumer_does_not_starve_generate():
+    """Regression (round-2 verdict weak #3): generate_stream used to hold the
+    engine lock across yields, so a paused/slow SSE consumer starved every
+    concurrent generate()/generate_batch() caller. The lock must be free
+    while the stream consumer is parked between deltas."""
+    import threading
+
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[16],
+                            temperature=0.0, stream_chunk=4))
+    stream = eng.generate_stream("hello", 16, temperature=0.0)
+    first = next(stream)  # consumer now parked mid-stream, holding nothing
+    assert first
+
+    result = {}
+
+    def concurrent():
+        result["out"] = eng.generate("other prompt", 8, temperature=0.0)
+
+    t = threading.Thread(target=concurrent)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), \
+        "generate() starved by a paused stream consumer holding the lock"
+    assert isinstance(result["out"], str)
+
+    # the paused stream resumes and still matches generate() exactly
+    rest = "".join(stream)
+    assert first + rest == eng.generate("hello", 16, temperature=0.0)
+
+
+def test_closed_stream_still_records_stats():
+    """A client disconnect (generator close) must not lose the stats update
+    and must release the engine for other callers."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=64, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[16],
+                            temperature=0.0, stream_chunk=4))
+    stream = eng.generate_stream("hello", 16, temperature=0.0)
+    next(stream)
+    stream.close()  # simulates the SSE client going away mid-stream
+    assert eng.stats["generate_calls"] == 1
+    assert eng.stats["tokens_generated"] > 0
+    # engine is free: a follow-up call completes
+    assert isinstance(eng.generate("x", 8), str)
+
+
 def test_generate_stream_respects_max_new():
     from symbiont_tpu.config import LmConfig
     from symbiont_tpu.engine.lm import LmEngine

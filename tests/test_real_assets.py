@@ -182,6 +182,58 @@ def test_convert_cli_on_real_safetensors(model_dir, tmp_path, capsys):
     assert meta["kind"] == "bert"
 
 
+def test_export_hf_bert_roundtrip_via_transformers(model_dir, hf_ref, tmp_path):
+    """export_hf_bert is the inverse of convert_bert: a pytree written back
+    to hub format must reload through transformers' own BertModel AND through
+    our loader with bit-identical weights and golden-equal pooled outputs —
+    so checkpoints trained in this framework are portable both ways."""
+    from symbiont_tpu.models.convert import export_hf_bert, load_bert_model
+
+    params, cfg = load_bert_model(model_dir)
+    out = tmp_path / "exported"
+    export_hf_bert(params, cfg, out,
+                   tokenizer_file=model_dir / "tokenizer.json")
+
+    # transformers reloads the exported dir (its own deserializer is the
+    # judge of tensor names/shapes) and produces the same hidden states
+    model, tok = hf_ref
+    re_model = transformers.BertModel.from_pretrained(out).eval()
+    texts = ["the systolic array multiplies matrices",
+             "checkpoints skip conversion"]
+    ref = _hf_mean_pool(model, tok, texts)
+    got = _hf_mean_pool(re_model, tok, texts)
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    # and our own loader round-trips bit-identically
+    params2, cfg2 = load_bert_model(out)
+    assert cfg2 == cfg
+    import jax
+
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_hf_bert_preserves_position_offset(model_dir, tmp_path):
+    """Advisor finding (round 2, medium): exporting an XLM-RoBERTa-family
+    pytree (position_offset=2, the default mpnet-multilingual geometry) as
+    model_type='bert'/pad=0 silently dropped the offset on reload. The
+    exported config must invert BertConfig.from_hf."""
+    import dataclasses
+
+    from symbiont_tpu.models.convert import (export_hf_bert, load_bert_model,
+                                             load_hf_config)
+
+    params, cfg = load_bert_model(model_dir)
+    cfg = dataclasses.replace(cfg, position_offset=2)  # pad_token_id 1 + 1
+    out = tmp_path / "xlmr"
+    export_hf_bert(params, cfg, out)
+    hf_cfg = load_hf_config(out)
+    assert hf_cfg["model_type"] == "xlm-roberta"
+    assert hf_cfg["pad_token_id"] == 1
+    _, cfg2 = load_bert_model(out)
+    assert cfg2.position_offset == 2
+
+
 # --------------------------------------------------------- gated real tier
 
 REAL_DIR = os.environ.get("SYMBIONT_MODEL_DIR")
