@@ -74,17 +74,24 @@ Metrics g_metrics;
 
 // ------------------------------------------------------------------ sse hub
 
+// Clients may register with a task_id filter (?task_id= on /api/events): the
+// reference broadcasts every generation event to every SSE client
+// (main.rs:215-270 — its UI correlates by original_task_id client-side);
+// unfiltered clients keep that behavior, filtered ones receive only their
+// task's events.
 class SseHub {
  public:
   struct Queue {
     std::mutex mu;
     std::condition_variable cv;
     std::deque<std::string> items;
+    std::string task_filter;  // "" = unfiltered (broadcast semantics)
     bool closed = false;
   };
 
-  std::shared_ptr<Queue> register_client() {
+  std::shared_ptr<Queue> register_client(const std::string& task_filter = "") {
     auto q = std::make_shared<Queue>();
+    q->task_filter = task_filter;
     std::lock_guard<std::mutex> g(mu_);
     clients_.push_back(q);
     return q;
@@ -101,7 +108,23 @@ class SseHub {
 
   void broadcast(const std::string& payload, size_t capacity) {
     std::lock_guard<std::mutex> g(mu_);
+    std::string event_tid;
+    bool parsed = false;
     for (auto& q : clients_) {
+      if (!q->task_filter.empty()) {
+        if (!parsed) {  // parse once, only if some client filters
+          parsed = true;
+          try {
+            json::Value v = json::parse(payload);
+            if (v.is_object() && v.has("original_task_id") &&
+                !v.at("original_task_id").is_null())
+              event_tid = v.at("original_task_id").as_string();
+          } catch (const std::exception&) {
+            // unparseable payload: delivered to unfiltered clients only
+          }
+        }
+        if (event_tid != q->task_filter) continue;  // not this client's task
+      }
       std::lock_guard<std::mutex> qg(q->mu);
       if (q->items.size() >= capacity) {
         g_metrics.inc("api.sse_dropped");
@@ -124,6 +147,7 @@ SseHub g_hub;
 
 struct HttpRequest {
   std::string method, path;
+  std::string query;  // raw query string (after '?'), "" if none
   std::map<std::string, std::string> headers;  // lowercase keys
   std::string body;
 };
@@ -159,7 +183,10 @@ bool read_http_request(int fd, HttpRequest& req, int timeout_ms,
   req.method = start.substr(0, sp1);
   req.path = start.substr(sp1 + 1, sp2 - sp1 - 1);
   auto qmark = req.path.find('?');
-  if (qmark != std::string::npos) req.path.resize(qmark);
+  if (qmark != std::string::npos) {
+    req.query = req.path.substr(qmark + 1);
+    req.path.resize(qmark);
+  }
 
   size_t pos = line_end + 2;
   while (pos < head.size()) {
@@ -625,13 +652,49 @@ std::pair<int, std::string> route_engine_health() {
 
 // --------------------------------------------------------------------- sse
 
+// percent-decode for query values (task ids are uuids, but a strict client
+// may still escape; '+' is a space per application/x-www-form-urlencoded)
+std::string url_decode(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '+') {
+      out += ' ';
+    } else if (s[i] == '%' && i + 2 < s.size() &&
+               std::isxdigit((unsigned char)s[i + 1]) &&
+               std::isxdigit((unsigned char)s[i + 2])) {
+      out += (char)std::stoi(s.substr(i + 1, 2), nullptr, 16);
+      i += 2;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+std::string query_param(const std::string& query, const std::string& key) {
+  size_t pos = 0;
+  while (pos < query.size()) {
+    size_t amp = query.find('&', pos);
+    std::string pair = query.substr(
+        pos, amp == std::string::npos ? std::string::npos : amp - pos);
+    auto eq = pair.find('=');
+    if (eq != std::string::npos && url_decode(pair.substr(0, eq)) == key)
+      return url_decode(pair.substr(eq + 1));
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+  return "";
+}
+
 void serve_sse(int fd, const HttpRequest& req) {
   std::string head =
       "HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
       "Cache-Control: no-cache\r\n" +
       cors_headers(req.headers) + "Connection: keep-alive\r\n\r\n";
   if (!send_all(fd, head)) return;
-  auto q = g_hub.register_client();
+  // ?task_id=<id> opts into per-task routing (see SseHub)
+  auto q = g_hub.register_client(query_param(req.query, "task_id"));
   g_metrics.inc("api.sse_clients");
   for (;;) {
     std::string payload;

@@ -1,0 +1,193 @@
+"""Offline deployment-topology validation.
+
+This sandbox has no docker, so deploy/docker-compose.yml can't be *executed*
+here — but nearly everything that goes wrong in a compose topology is
+statically checkable, and one class of bug is historically load-bearing: the
+reference shipped v0.3.0 with `knowledge_graph_service` subscribed to a
+subject NO service publishes (reference: knowledge_graph_service/src/main.rs:9,
+CHANGELOG.md:57-60 — the orphaned `data.processed_text.tokenized`). The
+orphan check below makes that bug class impossible to ship in a compose file.
+
+Checks:
+  1. YAML parses; every service has image or build; build dockerfiles exist.
+  2. Native-image `command:` entrypoints name real native binaries.
+  3. Every SYMBIONT_* env var matches a real config field (catches typos —
+     the config system ignores unknown env, so a typo'd var silently noops).
+  4. depends_on targets exist.
+  5. Subject topology: every consumed bus subject has a producer and vice
+     versa, derived from the role→subject tables mirroring SURVEY.md §1-L3.
+
+Usage:  python -m symbiont_tpu.deploy deploy/docker-compose.yml
+Exit 0 clean, 1 with one problem per line on stderr.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from symbiont_tpu import subjects as S
+
+NATIVE_BINARIES = {"symbus_broker", "perception", "preprocessing",
+                   "vector_memory", "knowledge_graph", "text_generator",
+                   "api_gateway"}
+
+# role → (produces, consumes) over pipeline + request-reply subjects
+# (request-reply: the requester "consumes" the service's reply inline, so the
+# responder side is modeled as the producer of the reply service).
+_PIPELINE: Dict[str, Tuple[Set[str], Set[str]]] = {
+    "gateway": ({S.TASKS_PERCEIVE_URL, S.TASKS_GENERATION_TEXT},
+                {S.EVENTS_TEXT_GENERATED}),
+    "perception": ({S.DATA_RAW_TEXT_DISCOVERED}, {S.TASKS_PERCEIVE_URL}),
+    "preprocessing": ({S.DATA_TEXT_WITH_EMBEDDINGS,
+                       S.DATA_PROCESSED_TEXT_TOKENIZED},
+                      {S.DATA_RAW_TEXT_DISCOVERED}),
+    "vector_memory": (set(), {S.DATA_TEXT_WITH_EMBEDDINGS}),
+    "knowledge_graph": (set(), {S.DATA_PROCESSED_TEXT_TOKENIZED}),
+    "text_generator": ({S.EVENTS_TEXT_GENERATED}, {S.TASKS_GENERATION_TEXT}),
+    # the engine plane serves request-reply only (engine.*): no pipeline edges
+    "engine": (set(), set()),
+}
+
+# compose service name / runner service name → topology role
+_ROLE_BY_NAME = {"gateway": "gateway", "api": "gateway",
+                 "api_gateway": "gateway"}
+
+
+def _known_env_keys() -> Set[str]:
+    """Every env var the config layer actually reads (canonical + aliases),
+    plus process-level vars consumed outside the config tree."""
+    from symbiont_tpu.config import _ENV_ALIASES, SymbiontConfig
+
+    cfg = SymbiontConfig()
+    keys = set(_ENV_ALIASES)
+    for section_field in dataclasses.fields(cfg):
+        section = getattr(cfg, section_field.name)
+        for f in dataclasses.fields(section):
+            keys.add(f"SYMBIONT_{section_field.name.upper()}_{f.name.upper()}")
+    # read directly by services/tools, not through the config tree
+    keys |= {"SYMBIONT_BUS_URL", "SYMBIONT_FRONTEND_PATH",
+             "SYMBIONT_COORDINATOR", "SYMBIONT_NUM_PROCESSES",
+             "SYMBIONT_PROCESS_ID", "SYMBIONT_MODEL_DIR"}
+    return keys
+
+
+def _env_dict(svc: dict) -> Dict[str, str]:
+    """Normalize compose `environment:` — both the list form
+    (["KEY=value", ...]) and the mapping form ({KEY: value}) are valid
+    compose syntax and must be validated identically."""
+    env = svc.get("environment") or {}
+    if isinstance(env, dict):
+        return {str(k): "" if v is None else str(v) for k, v in env.items()}
+    out: Dict[str, str] = {}
+    for e in env:
+        if isinstance(e, str):
+            k, _, v = e.partition("=")
+            out[k] = v
+    return out
+
+
+def _service_roles(name: str, svc: dict) -> List[str]:
+    """Topology roles a compose service plays."""
+    cmd = svc.get("command") or []
+    entry = cmd[0] if isinstance(cmd, list) and cmd else (
+        cmd.split()[0] if isinstance(cmd, str) and cmd else "")
+    if entry in _PIPELINE:
+        return [entry]
+    if entry in _ROLE_BY_NAME:
+        return [_ROLE_BY_NAME[entry]]
+    # python runner container: roles from SYMBIONT_RUNNER_SERVICES
+    wanted = _env_dict(svc).get("SYMBIONT_RUNNER_SERVICES")
+    if wanted:
+        if wanted == "all":
+            return [r for r in _PIPELINE]
+        return [_ROLE_BY_NAME.get(w.strip(), w.strip())
+                for w in wanted.split(",") if w.strip()]
+    if name in _PIPELINE or name in _ROLE_BY_NAME:
+        return [_ROLE_BY_NAME.get(name, name)]
+    return []
+
+
+def validate_compose(path: str | Path) -> List[str]:
+    import yaml
+
+    path = Path(path)
+    problems: List[str] = []
+    try:
+        doc = yaml.safe_load(path.read_text())
+    except yaml.YAMLError as e:
+        return [f"YAML parse error: {e}"]
+    services = (doc or {}).get("services")
+    if not isinstance(services, dict) or not services:
+        return ["no services defined"]
+
+    known_env = _known_env_keys()
+    roles: List[str] = []
+    for name, svc in services.items():
+        svc = svc or {}
+        build, image = svc.get("build"), svc.get("image")
+        if not build and not image:
+            problems.append(f"{name}: neither build nor image")
+        if build:
+            # string form `build: <context>` is compose shorthand for
+            # context-only with Dockerfile at the context root
+            if isinstance(build, str):
+                build = {"context": build}
+            ctx = (path.parent / build.get("context", ".")).resolve()
+            df = ctx / build.get("dockerfile", "Dockerfile")
+            if not df.exists():
+                problems.append(f"{name}: dockerfile {df} does not exist")
+        cmd = svc.get("command") or []
+        entry = cmd[0] if isinstance(cmd, list) and cmd else (
+            cmd.split()[0] if isinstance(cmd, str) and cmd else "")
+        if build and entry and entry not in NATIVE_BINARIES \
+                and entry not in ("python", "python3"):
+            problems.append(f"{name}: command {entry!r} is not a native "
+                            f"binary ({sorted(NATIVE_BINARIES)}) or python")
+        for key in _env_dict(svc):
+            if key.startswith("SYMBIONT_") and key not in known_env:
+                problems.append(f"{name}: unknown env var {key} "
+                                "(typo? config would silently ignore it)")
+        deps = svc.get("depends_on") or {}
+        dep_names = deps if isinstance(deps, list) else list(deps)
+        for d in dep_names:
+            if d not in services:
+                problems.append(f"{name}: depends_on unknown service {d!r}")
+        if not svc.get("profiles"):  # optional-profile services excluded
+            roles.extend(_service_roles(name, svc))
+
+    # subject orphan check over the default-profile topology
+    produced: Set[str] = set()
+    consumed: Set[str] = set()
+    for r in roles:
+        if r in _PIPELINE:
+            p, c = _PIPELINE[r]
+            produced |= p
+            consumed |= c
+    for subj in sorted(consumed - produced):
+        problems.append(f"orphaned subject: {subj} is consumed but no "
+                        "service in the topology produces it "
+                        "(the reference's v0.3.0 knowledge-graph bug class)")
+    for subj in sorted(produced - consumed):
+        problems.append(f"dead-end subject: {subj} is produced but no "
+                        "service in the topology consumes it")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    problems = validate_compose(argv[0])
+    for p in problems:
+        print(f"TOPOLOGY: {p}", file=sys.stderr)
+    if not problems:
+        print(f"{argv[0]}: topology OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,8 @@ import re
 # exact host (+optional port): http://localhost.evil.com must NOT match
 _ORIGIN_RE = re.compile(r"^https?://(localhost|127\.0\.0\.1)(:\d+)?$")
 
+_UNPARSED = object()  # broadcast(): payload task_id not yet extracted
+
 
 class _HttpError(Exception):
     """Malformed/oversized request — answered with a status, then close.
@@ -68,23 +70,37 @@ class _HttpError(Exception):
 
 class _SseHub:
     """Bounded broadcast: capacity-32 queues, drop-on-lag with a warning
-    (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209)."""
+    (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209).
+
+    Clients may register with a task_id filter (?task_id= on /api/events):
+    the reference broadcasts every generation event to every SSE client
+    (main.rs:215-270 — its UI correlates by original_task_id client-side);
+    unfiltered clients keep that behavior, filtered ones receive only their
+    task's events."""
 
     def __init__(self, capacity: int = 32):
         self.capacity = capacity
-        self._clients: List[asyncio.Queue] = []
+        self._clients: List[Tuple[asyncio.Queue, Optional[str]]] = []
 
-    def register(self) -> asyncio.Queue:
+    def register(self, task_id: Optional[str] = None) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue(maxsize=self.capacity)
-        self._clients.append(q)
+        self._clients.append((q, task_id))
         return q
 
     def unregister(self, q: asyncio.Queue) -> None:
-        if q in self._clients:
-            self._clients.remove(q)
+        self._clients = [(c, t) for (c, t) in self._clients if c is not q]
 
     def broadcast(self, payload: str) -> None:
-        for q in list(self._clients):
+        event_tid = _UNPARSED
+        for q, want in list(self._clients):
+            if want is not None:
+                if event_tid is _UNPARSED:  # parse once, only if needed
+                    try:
+                        event_tid = json.loads(payload).get("original_task_id")
+                    except (ValueError, AttributeError):
+                        event_tid = None
+                if event_tid != want:
+                    continue  # not this client's task
             try:
                 q.put_nowait(payload)
             except asyncio.QueueFull:
@@ -95,7 +111,7 @@ class _SseHub:
         """Wake every SSE handler with a close sentinel (None) so graceful
         shutdown doesn't deadlock in Server.wait_closed() behind permanently
         connected clients."""
-        for q in list(self._clients):
+        for q, _tid in list(self._clients):
             try:
                 q.put_nowait(None)
             except asyncio.QueueFull:
@@ -195,10 +211,10 @@ class ApiService:
                     break
                 if request is None:
                     break
-                method, path, headers, body = request
+                method, path, query, headers, body = request
                 keep_alive = headers.get("connection", "").lower() != "close"
                 if path == "/api/events" and method == "GET":
-                    await self._serve_sse(writer, headers)
+                    await self._serve_sse(writer, headers, query)
                     return  # SSE occupies the connection
                 if path in ("/", "/index.html") and method == "GET":
                     html = _frontend_html()
@@ -260,7 +276,8 @@ class ApiService:
             raise _HttpError(413, "request body exceeds 16MB limit", origin)
         if n:
             body = await reader.readexactly(n)
-        return method, path.split("?")[0], headers, body
+        path, _, query = path.partition("?")
+        return method, path, query, headers, body
 
     def _cors(self, origin: Optional[str]) -> str:
         # reference allows localhost/127.0.0.1 origins (main.rs:555-567)
@@ -529,8 +546,12 @@ class ApiService:
 
     # ------------------------------------------------------------------ SSE
 
-    async def _serve_sse(self, writer, headers: Dict[str, str]) -> None:
-        """SSE with 15s keep-alive comments (reference: main.rs:190-213)."""
+    async def _serve_sse(self, writer, headers: Dict[str, str],
+                         query: str = "") -> None:
+        """SSE with 15s keep-alive comments (reference: main.rs:190-213).
+        ?task_id=<id> opts into per-task routing (see _SseHub)."""
+        from urllib.parse import parse_qs
+
         origin = headers.get("origin")
         head = ("HTTP/1.1 200 OK\r\n"
                 "Content-Type: text/event-stream\r\n"
@@ -539,7 +560,8 @@ class ApiService:
                 "Connection: keep-alive\r\n\r\n")
         writer.write(head.encode("latin-1"))
         await writer.drain()
-        q = self.hub.register()
+        task_filter = (parse_qs(query).get("task_id") or [None])[0] or None
+        q = self.hub.register(task_filter)
         metrics.inc("api.sse_clients")
         try:
             while True:

@@ -1,0 +1,99 @@
+"""Worker process for the 2-process multi-host bring-up test.
+
+Run by tests/test_multihost.py, one subprocess per "host": each process owns
+4 virtual CPU devices (xla_force_host_platform_device_count=4) and joins a
+2-process jax.distributed cluster through the SAME production path a real
+multi-host TPU deployment uses — `init_distributed` → `build_mesh` →
+sharded train step (docs/DEPLOYMENT.md Topology 3). Nothing here is
+test-double'd: the coordinator service, cross-process device discovery, and
+the XLA collectives the train step's gradient psum lowers to are all real.
+
+Protocol (parsed by the parent test): prints one line
+    MULTIHOST ok global=<N> local=<n> procs=<P> loss=<float> sum=<int>
+and exits 0; any assertion failure exits nonzero with a traceback.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    # must win over the sandbox's axon sitecustomize before backend init
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbiont_tpu.models import gpt as gpt_mod
+    from symbiont_tpu.parallel.mesh import build_mesh, init_distributed
+    from symbiont_tpu.train.trainer import TrainState, _adamw, lm_train_step
+
+    # coordinator/process topology arrives via SYMBIONT_COORDINATOR /
+    # SYMBIONT_NUM_PROCESSES / SYMBIONT_PROCESS_ID (set by the parent test),
+    # exactly as a launcher would set them on a non-TPU cluster.
+    n_global = init_distributed()
+    n_local = len(jax.local_devices())
+    procs = jax.process_count()
+    assert procs == 2, f"expected 2 processes, got {procs}"
+    assert n_global == 2 * n_local, (n_global, n_local)
+
+    # one DP mesh over the WHOLE cluster: both processes' devices
+    mesh = build_mesh([n_global, 1])
+    assert {d.process_index for d in mesh.devices.flat} == {0, 1}, \
+        "mesh must span both processes"
+
+    cfg = gpt_mod.GPTConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=32,
+        arch="llama", num_kv_heads=2, dtype="float32",
+        tie_word_embeddings=True)
+    tx = _adamw(1e-3)
+    rep = NamedSharding(mesh, P())
+
+    # init params + opt state INSIDE jit with replicated out_shardings: under
+    # multi-process JAX, eager ops on non-addressable arrays are invalid, so
+    # all global state is born on-device from a shared seed.
+    @jax.jit
+    def init_state(key):
+        params = gpt_mod.init_params(key, cfg)
+        return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+    state = jax.jit(init_state, out_shardings=rep)(jax.random.key(0))
+
+    # global batch sharded over 'data': each process materializes only ITS
+    # addressable shards; rows therefore physically live on different hosts.
+    B, S = n_global, 16
+    rng = np.random.default_rng(7)  # same seed → same global view everywhere
+    full_ids = rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32)
+    bs = NamedSharding(mesh, P("data"))
+    ids = jax.make_array_from_callback((B, S), bs, lambda idx: full_ids[idx])
+    mask = jax.make_array_from_callback(
+        (B, S), bs, lambda idx: np.ones((B, S), np.int32)[idx])
+
+    # prove a collective actually crosses the process boundary: a global sum
+    # of the data-sharded array must equal the host-known total
+    total = int(jax.jit(jnp.sum)(ids).addressable_shards[0].data)
+    assert total == int(full_ids.sum()), (total, int(full_ids.sum()))
+
+    # ONE cross-process DP train step (gradient psum over 'data' spans hosts)
+    state, metrics = lm_train_step(state, {"ids": ids, "mask": mask}, cfg, tx)
+    loss = float(metrics["loss"].addressable_shards[0].data)
+    assert np.isfinite(loss), loss
+    assert int(state.step.addressable_shards[0].data) == 1
+
+    print(f"MULTIHOST ok global={n_global} local={n_local} procs={procs} "
+          f"loss={loss:.6f} sum={total}", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(1)

@@ -1,0 +1,145 @@
+"""Deployment artifacts, validated to the offline ceiling.
+
+No docker exists in this sandbox, so `deploy/docker-compose.yml` (parity
+target: the reference's one-command 10-container bring-up,
+docker-compose.yml:1-151) is validated statically instead of executed:
+YAML lint, dockerfile existence + COPY-source checks, env-var wiring against
+the real config layer, and the subject-topology orphan check — the exact bug
+class the reference shipped (orphaned data.processed_text.tokenized,
+CHANGELOG.md:57-60).
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from symbiont_tpu.deploy import validate_compose  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+COMPOSE = REPO / "deploy" / "docker-compose.yml"
+
+
+def test_shipped_compose_is_clean():
+    assert validate_compose(COMPOSE) == []
+
+
+def test_compose_covers_reference_bringup():
+    """Same one-command surface as the reference: broker (its NATS), all five
+    worker roles, gateway, engine; optional external stores mirror the
+    reference's Qdrant/Neo4j images."""
+    doc = yaml.safe_load(COMPOSE.read_text())
+    svcs = doc["services"]
+    for required in ("broker", "engine", "perception", "preprocessing",
+                     "vector_memory", "knowledge_graph", "text_generator",
+                     "gateway"):
+        assert required in svcs, required
+        assert not svcs[required].get("profiles"), \
+            f"{required} must be in the default profile"
+    # externals are opt-in and match the reference's pinned images
+    assert svcs["qdrant"]["profiles"] == ["external-stores"]
+    assert svcs["qdrant"]["image"] == "qdrant/qdrant:v1.14.0"
+    assert svcs["neo4j"]["image"] == "neo4j:5.18.0"
+    # health-gated bring-up (the reference has no healthchecks at all in
+    # v0.3.0 — SURVEY.md §5.3): workers wait for a healthy broker
+    assert "healthcheck" in svcs["broker"]
+    assert "healthcheck" in svcs["gateway"]
+    for w in ("perception", "preprocessing", "vector_memory",
+              "knowledge_graph", "text_generator", "engine"):
+        assert svcs[w]["depends_on"]["broker"]["condition"] == \
+            "service_healthy", w
+
+
+def test_dockerfile_copy_sources_exist():
+    """Every COPY source in both dockerfiles exists relative to the build
+    context (repo root) — a rename breaks the build only at docker time,
+    which this sandbox doesn't have, so catch it here."""
+    for df in ("Dockerfile.native", "Dockerfile.engine"):
+        text = (REPO / "deploy" / df).read_text()
+        assert text.lstrip().startswith(("#", "ARG", "FROM"))
+        assert "FROM" in text
+        for m in re.finditer(r"^COPY (?!--from)([^\n]+)", text, re.M):
+            *sources, _dest = m.group(1).split()
+            for src in sources:
+                assert (REPO / src).exists(), f"{df}: COPY source {src} missing"
+
+
+def test_orphaned_subject_detected(tmp_path):
+    """Removing preprocessing from the topology orphans the embeddings
+    subject (vector_memory consumes it, nobody produces) — the validator
+    must say so."""
+    doc = yaml.safe_load(COMPOSE.read_text())
+    del doc["services"]["preprocessing"]
+    p = tmp_path / "compose.yml"
+    p.write_text(yaml.safe_dump(doc))
+    problems = validate_compose(p)
+    assert any("orphaned subject: data.text.with_embeddings" in x
+               for x in problems), problems
+    assert any("dead-end subject: data.raw_text.discovered" in x
+               for x in problems), problems
+
+
+def test_env_typo_detected(tmp_path):
+    doc = yaml.safe_load(COMPOSE.read_text())
+    doc["services"]["engine"]["environment"].append(
+        "SYMBIONT_ENGINE_MODELDIR=/oops")  # missing underscore
+    p = tmp_path / "compose.yml"
+    p.write_text(yaml.safe_dump(doc))
+    problems = validate_compose(p)
+    assert any("SYMBIONT_ENGINE_MODELDIR" in x for x in problems), problems
+
+
+def test_mapping_style_environment_also_validated(tmp_path):
+    """compose allows environment as a mapping ({KEY: value}) as well as a
+    list (["KEY=value"]); typo detection and runner-role extraction must see
+    both forms (regression: mapping form used to bypass both checks)."""
+    doc = yaml.safe_load(COMPOSE.read_text())
+    doc["services"]["engine"]["environment"] = {
+        "SYMBIONT_ENGINE_MODELDIR": "/oops",  # typo'd key, mapping form
+        "SYMBIONT_RUNNER_SERVICES": "engine",
+        "SYMBIONT_BUS_URL": "symbus://broker:4233"}
+    p = tmp_path / "compose.yml"
+    p.write_text(yaml.safe_dump(doc))
+    problems = validate_compose(p)
+    assert any("SYMBIONT_ENGINE_MODELDIR" in x for x in problems), problems
+    # role extraction still worked: no orphan/dead-end false positives beyond
+    # the injected typo
+    assert all("subject" not in x for x in problems), problems
+
+
+def test_string_form_build_checks_dockerfile(tmp_path):
+    """`build: <context>` shorthand must still get a Dockerfile-existence
+    check (regression: only the dict form was handled)."""
+    doc = yaml.safe_load(COMPOSE.read_text())
+    doc["services"]["broker"]["build"] = str(tmp_path / "nodir")
+    p = tmp_path / "compose.yml"
+    p.write_text(yaml.safe_dump(doc))
+    problems = validate_compose(p)
+    assert any("broker" in x and "does not exist" in x
+               for x in problems), problems
+
+
+def test_bad_depends_on_and_missing_dockerfile_detected(tmp_path):
+    doc = yaml.safe_load(COMPOSE.read_text())
+    doc["services"]["gateway"]["depends_on"] = {"nonexistent": {
+        "condition": "service_started"}}
+    doc["services"]["broker"]["build"]["dockerfile"] = "deploy/Nope"
+    p = tmp_path / "deploy" / "compose.yml"
+    p.parent.mkdir()
+    # keep the ../ build context resolvable from the tmp copy
+    doc["services"]["broker"]["build"]["context"] = str(REPO)
+    doc["services"]["gateway"]["build"]["context"] = str(REPO)
+    p.write_text(yaml.safe_dump(doc))
+    problems = validate_compose(p)
+    assert any("depends_on unknown service 'nonexistent'" in x
+               for x in problems), problems
+    assert any("Nope does not exist" in x for x in problems), problems
+
+
+def test_cli_entrypoint(capsys):
+    from symbiont_tpu.deploy import main
+
+    assert main([str(COMPOSE)]) == 0
+    assert "topology OK" in capsys.readouterr().out

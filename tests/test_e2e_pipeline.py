@@ -461,6 +461,75 @@ def test_lm_backend_generate_roundtrip(tmp_path):
     asyncio.run(scenario())
 
 
+def test_sse_task_id_filter():
+    """Per-task SSE routing (?task_id=): the reference broadcasts every
+    generation event to every SSE client (main.rs:215-270) and the UI
+    correlates client-side; a filtered client must receive ONLY its task's
+    events while unfiltered clients keep full-broadcast behavior."""
+    from symbiont_tpu import subjects
+    from symbiont_tpu.schema import GeneratedTextMessage, to_json_bytes
+    from symbiont_tpu.services.api import ApiService
+    from symbiont_tpu.utils.ids import current_timestamp_ms
+
+    async def scenario():
+        bus = InprocBus()
+        api = ApiService(bus, ApiConfig(host="127.0.0.1", port=0,
+                                        sse_keepalive_s=0.2))
+        await api.start()
+        port = api.port
+        try:
+            async def sse_client(query: str):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(f"GET /api/events{query} HTTP/1.1\r\n"
+                             f"Host: x\r\n\r\n".encode())
+                await writer.drain()
+                await reader.readuntil(b"\r\n\r\n")
+                return reader, writer
+
+            plain = await sse_client("")
+            only_a = await sse_client("?task_id=task-A")
+            only_b = await sse_client("?task_id=task-B")
+            await asyncio.sleep(0.2)
+
+            for tid in ("task-A", "task-B", "task-A"):
+                await bus.publish(subjects.EVENTS_TEXT_GENERATED,
+                                  to_json_bytes(GeneratedTextMessage(
+                                      original_task_id=tid,
+                                      generated_text=f"text for {tid}",
+                                      timestamp_ms=current_timestamp_ms())))
+
+            async def read_events(reader, n, timeout=10.0):
+                got = []
+                async def pull():
+                    while len(got) < n:
+                        line = await reader.readline()
+                        if line.startswith(b"data: "):
+                            got.append(json.loads(line[6:]))
+                try:
+                    await asyncio.wait_for(pull(), timeout)
+                except asyncio.TimeoutError:
+                    pass
+                return got
+
+            plain_events = await read_events(plain[0], 3)
+            a_events = await read_events(only_a[0], 2)
+            # B expects exactly 1; wait briefly past it to catch leakage
+            b_events = await read_events(only_b[0], 2, timeout=1.5)
+
+            assert [e["original_task_id"] for e in plain_events] == \
+                ["task-A", "task-B", "task-A"]  # unfiltered: sees all
+            assert [e["original_task_id"] for e in a_events] == \
+                ["task-A", "task-A"]
+            assert [e["original_task_id"] for e in b_events] == ["task-B"]
+            for r, w in (plain, only_a, only_b):
+                w.close()
+        finally:
+            await api.stop()
+
+    asyncio.run(scenario())
+
+
 def test_fused_search_skips_large_top_k():
     """top_k above fused_search_max_top_k must bypass the fused probe
     entirely (return None fast, no bus request) — a cold large-k bucket

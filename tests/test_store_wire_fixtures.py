@@ -1,0 +1,265 @@
+"""Wire-level request fixtures for the external Qdrant / Neo4j adapters.
+
+The adapters' behavioral tests (test_qdrant_backend.py / test_neo4j_backend.py)
+run against in-process fakes, which proves the adapter against OUR idea of the
+products. This tier is independent of those fakes: a recording HTTP server
+captures every request the adapters emit — method, path, auth, raw body
+BYTES — and asserts them against fixtures transcribed from the real products'
+public API documentation:
+
+- Qdrant REST API (api.qdrant.tech; parity target: what the reference writes
+  through qdrant-client/gRPC, services/vector_memory_service/src/main.rs:
+  24-119 collection create, :121-228 upsert, :230-456 search):
+    PUT  /collections/{name}                 {"vectors":{"size","distance"}}
+    PUT  /collections/{name}/points?wait=true {"points":[{"id","vector","payload"}]}
+    POST /collections/{name}/points/search   {"vector","limit","with_payload","with_vector"}
+    POST /collections/{name}/points/count    {"exact"}
+  Quirk checks: distance enum is capitalized "Cosine"; point ids must be
+  unsigned ints or UUIDs (arbitrary strings are rejected by real Qdrant).
+- Neo4j HTTP API (/db/{database}/tx/commit, the documented transactional
+  endpoint; parity target: knowledge_graph_service/src/main.rs:23-140):
+    {"statements":[{"statement": cypher, "parameters": {...}}]}
+  with Basic auth, and responses in {"results":[{"columns","data":[{"row"}]}],
+  "errors":[]} shape.
+
+Byte-level: raw request bodies are compared against json.dumps of the
+fixture dicts (field order included), so any serialization drift shows up.
+"""
+
+import base64
+import http.server
+import json
+import re
+import threading
+import uuid
+
+import pytest
+
+from symbiont_tpu.config import GraphStoreConfig, VectorStoreConfig
+from symbiont_tpu.graph.neo4j_backend import Neo4jGraphStore
+from symbiont_tpu.memory.qdrant_backend import QdrantStore
+from symbiont_tpu.schema import TokenizedTextMessage
+from symbiont_tpu.utils.ids import deterministic_point_id
+
+
+class _Recorder:
+    """Records (method, path, headers, body bytes); replies from a canned
+    route table whose response JSONs are transcribed from the API docs."""
+
+    def __init__(self, routes):
+        self.requests = []
+        recorder = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _serve(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n) if n else b""
+                recorder.requests.append(
+                    (self.command, self.path, dict(self.headers), body))
+                for (method, pattern), reply in routes.items():
+                    if method == self.command and re.fullmatch(pattern,
+                                                               self.path):
+                        out = json.dumps(reply).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(out)
+                        return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            do_GET = do_POST = do_PUT = _serve
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+# ------------------------------------------------------------------- qdrant
+
+# response shapes per the Qdrant REST docs
+QDRANT_ROUTES = {
+    ("PUT", r"/collections/[\w-]+"): {"result": True, "status": "ok",
+                                      "time": 0.001},
+    ("PUT", r"/collections/[\w-]+/points\?wait=true"): {
+        "result": {"operation_id": 0, "status": "completed"},
+        "status": "ok", "time": 0.002},
+    ("POST", r"/collections/[\w-]+/points/search"): {
+        "result": [{"id": "b2f5e0c2-0000-4000-8000-000000000001",
+                    "version": 3, "score": 0.93,
+                    "payload": {"sentence_text": "doc-hit"}}],
+        "status": "ok", "time": 0.003},
+    ("POST", r"/collections/[\w-]+/points/count"): {
+        "result": {"count": 42}, "status": "ok", "time": 0.001},
+}
+
+
+@pytest.fixture()
+def qdrant():
+    rec = _Recorder(QDRANT_ROUTES)
+    store = QdrantStore(VectorStoreConfig(
+        dim=768, uri=rec.url, collection="symbiont_document_embeddings"),
+        retries=1, retry_delay_s=0.0)
+    yield rec, store
+    rec.close()
+
+
+def test_qdrant_collection_create_wire_shape(qdrant):
+    """Collection create: 768-dim cosine, the reference's exact geometry
+    (main.rs:20-22,34-42). Distance enum MUST be capitalized 'Cosine' — real
+    Qdrant rejects 'cosine'."""
+    rec, store = qdrant
+    store.ensure_collection()
+    method, path, headers, body = rec.requests[0]
+    assert (method, path) == ("PUT",
+                              "/collections/symbiont_document_embeddings")
+    assert headers["Content-Type"] == "application/json"
+    expected = {"vectors": {"size": 768, "distance": "Cosine"},
+                "on_disk_payload": True}
+    assert body == json.dumps(expected).encode()  # byte-level
+
+
+def test_qdrant_upsert_wire_shape(qdrant):
+    """Upsert: wait=true durability (main.rs:196), one point per sentence
+    with the 6-field payload (main.rs:142-177), ids UUID-formatted (real
+    Qdrant accepts only u64 or UUID ids)."""
+    rec, store = qdrant
+    pid = deterministic_point_id("doc-1", 0)
+    uuid.UUID(pid)  # the real-product id constraint, enforced at test level
+    payload = {"original_document_id": "doc-1", "source_url": "http://x",
+               "sentence_text": "hello world", "sentence_order": 0,
+               "model_name": "minilm", "processed_at_ms": 123}
+    assert store.upsert([(pid, [0.25, -1.0, 0.5], payload)]) == 1
+    method, path, _, body = rec.requests[0]
+    assert method == "PUT"
+    assert path == ("/collections/symbiont_document_embeddings/points"
+                    "?wait=true")
+    expected = {"points": [{"id": pid, "vector": [0.25, -1.0, 0.5],
+                            "payload": payload}]}
+    assert body == json.dumps(expected).encode()  # byte-level
+
+
+def test_qdrant_search_wire_shape(qdrant):
+    """Search: top-k with payload on, vectors off (main.rs:261-286), and the
+    documented {"result": [hits]} response decoded into SearchHits."""
+    rec, store = qdrant
+    hits = store.search([0.5, 0.25, 0.125], top_k=5)
+    method, path, _, body = rec.requests[0]
+    assert method == "POST"
+    assert path == "/collections/symbiont_document_embeddings/points/search"
+    expected = {"vector": [0.5, 0.25, 0.125], "limit": 5,
+                "with_payload": True, "with_vector": False}
+    assert body == json.dumps(expected).encode()  # byte-level
+    assert len(hits) == 1
+    assert hits[0].id == "b2f5e0c2-0000-4000-8000-000000000001"
+    assert hits[0].score == pytest.approx(0.93)
+    assert hits[0].payload == {"sentence_text": "doc-hit"}
+
+
+def test_qdrant_count_wire_shape(qdrant):
+    rec, store = qdrant
+    assert store.count() == 42
+    method, path, _, body = rec.requests[0]
+    assert (method, path) == (
+        "POST", "/collections/symbiont_document_embeddings/points/count")
+    assert body == json.dumps({"exact": True}).encode()  # byte-level
+
+
+# -------------------------------------------------------------------- neo4j
+
+NEO4J_ROUTES = {
+    # documented commit-endpoint response shape
+    ("POST", r"/db/neo4j/tx/commit"): {
+        "results": [{"columns": ["id(d)"], "data": [{"row": [7],
+                                                     "meta": [None]}]}],
+        "errors": []},
+}
+
+
+@pytest.fixture()
+def neo4j():
+    rec = _Recorder(NEO4J_ROUTES)
+    store = Neo4jGraphStore(GraphStoreConfig(
+        uri=rec.url, user="neo4j", password="secret", database="neo4j"),
+        retries=1, retry_delay_s=0.0)
+    yield rec, store
+    rec.close()
+
+
+def test_neo4j_tx_commit_wire_shape(neo4j):
+    """save_tokenized: ONE POST to the documented transactional commit
+    endpoint (single explicit transaction, main.rs:32-134) with Basic auth
+    and {"statements": [{statement, parameters}]} framing."""
+    rec, store = neo4j
+    msg = TokenizedTextMessage(
+        original_id="doc-9", source_url="http://src",
+        sentences=["First sentence.", "  ", "Second one."],
+        tokens=["First", "", "sentence"], timestamp_ms=777)
+    assert store.save_tokenized(msg) == 7
+    assert len(rec.requests) == 1  # one transaction, not N requests
+    method, path, headers, body = rec.requests[0]
+    assert (method, path) == ("POST", "/db/neo4j/tx/commit")
+    assert headers["Content-Type"] == "application/json"
+    assert headers["Authorization"] == \
+        "Basic " + base64.b64encode(b"neo4j:secret").decode()
+    doc = json.loads(body)
+    assert set(doc) == {"statements"}
+    for stmt in doc["statements"]:
+        assert set(stmt) == {"statement", "parameters"}
+    # document MERGE first, with the reference's exact property set
+    s0 = doc["statements"][0]
+    assert "MERGE (d:Document {original_id: $original_id})" in s0["statement"]
+    assert s0["parameters"] == {"original_id": "doc-9",
+                                "source_url": "http://src", "ts": 777}
+    # blank sentence and empty token are skipped (main.rs:71-77,103-109):
+    # 1 doc + 2 sentences + 2 tokens = 5 statements
+    assert len(doc["statements"]) == 5
+    orders = [s["parameters"]["order"] for s in doc["statements"]
+              if "HAS_SENTENCE" in s["statement"]]
+    assert orders == [0, 2]  # original positions survive the skip
+
+
+def test_neo4j_ensure_schema_wire_shape(neo4j):
+    """Schema ensure: unique constraint + text_lc index as separate commits
+    (schema DDL cannot share a transaction with other DDL in one statement
+    list on real Neo4j versions; the adapter sends one commit each)."""
+    rec, store = neo4j
+    store.ensure_schema()
+    assert len(rec.requests) == 2
+    bodies = [json.loads(b) for _, _, _, b in rec.requests]
+    assert "REQUIRE d.original_id IS UNIQUE" in \
+        bodies[0]["statements"][0]["statement"]
+    assert "FOR (t:Token) ON (t.text_lc)" in \
+        bodies[1]["statements"][0]["statement"]
+    for b in bodies:
+        assert b["statements"][0]["parameters"] == {}
+
+
+def test_neo4j_error_response_raises(neo4j):
+    """The documented errors[] array must fail the write loudly — real Neo4j
+    returns HTTP 200 with errors populated, so status-code checking alone
+    would silently drop documents."""
+    rec, store = neo4j
+    rec.server.shutdown()
+    rec2 = _Recorder({("POST", r"/db/neo4j/tx/commit"): {
+        "results": [],
+        "errors": [{"code": "Neo.ClientError.Statement.SyntaxError",
+                    "message": "bad cypher"}]}})
+    store.base = rec2.url
+    msg = TokenizedTextMessage(original_id="d", source_url="u",
+                               sentences=["s"], tokens=["t"], timestamp_ms=1)
+    with pytest.raises(RuntimeError, match="SyntaxError"):
+        store.save_tokenized(msg)
+    rec2.close()
