@@ -274,9 +274,23 @@ def bench_compute_mfu(results: dict, peak: float | None) -> None:
     varied per iteration so XLA cannot hoist the loop body), no host↔device
     transfers in the timed region. This is the chip-side capability a
     locally-attached deployment gets; the end-to-end MFU above additionally
-    pays the tunnel's transfer wall."""
+    pays the tunnel's transfer wall.
+
+    Two geometries: MiniLM-384 (BASELINE.md config #1) and mpnet-768 — the
+    reference's actual default model (preprocessing_service/src/main.rs:305),
+    whose wider matmuls fill the 128×128 MXU far better. FLOPs are derived
+    from the engine's REAL model_cfg, not assumed (a shallower synthetic
+    stand-in would otherwise inflate MFU silently)."""
     if peak is None:
         return
+    _compute_mfu_geometry(results, peak, dim=384, B=1024, S=64,
+                          key_suffix="")
+    _compute_mfu_geometry(results, peak, dim=768, B=512, S=128,
+                          key_suffix="_768")
+
+
+def _compute_mfu_geometry(results: dict, peak: float, dim: int, B: int,
+                          S: int, key_suffix: str, N: int = 20) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -284,12 +298,11 @@ def bench_compute_mfu(results: dict, peak: float | None) -> None:
     from symbiont_tpu.engine.engine import TpuEngine
     from symbiont_tpu.models import bert as bert_mod
 
-    H, I, L = 384, 1536, 6
     eng = TpuEngine(EngineConfig(
-        embedding_dim=H, length_buckets=[64], batch_buckets=[1024],
-        max_batch=1024, dtype="bfloat16", data_parallel=False))
+        embedding_dim=dim, length_buckets=[S], batch_buckets=[B],
+        max_batch=B, dtype="bfloat16", data_parallel=False))
     cfg = eng.model_cfg
-    B, S, N = 1024, 64, 20
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     ids = jnp.ones((B, S), jnp.int32)
     mask = jnp.ones((B, S), jnp.int32)
 
@@ -310,9 +323,10 @@ def bench_compute_mfu(results: dict, peak: float | None) -> None:
         best = min(best, time.time() - t0)
     tokens = N * B * S
     flops = tokens * L * (8 * H * H + 4 * H * I) + N * B * L * 4 * H * S * S
-    results["mfu_compute_only_pct"] = round(100 * flops / best / peak, 2)
-    results["compute_only_emb_per_s"] = round(N * B / best, 1)
-    log(f"compute-only (no transfers, [1024,64] bf16): "
+    results[f"mfu_compute_only{key_suffix}_pct"] = round(
+        100 * flops / best / peak, 2)
+    results[f"compute_only{key_suffix}_emb_per_s"] = round(N * B / best, 1)
+    log(f"compute-only (no transfers, H={H} L={L}, [{B},{S}] bf16): "
         f"{N * B / best:.0f} emb/s, MFU {100 * flops / best / peak:.1f}%")
 
 

@@ -94,11 +94,16 @@ class TpuEngine:
                 log.info("loaded checkpoint from %s", self.config.model_dir)
             else:
                 # synthetic mode: random weights at the configured dim — full
-                # pipeline runs with zero model assets (dev / bench / tests)
+                # pipeline runs with zero model assets (dev / bench / tests).
+                # Depth follows the BASELINE.md checkpoint that dim implies
+                # (384→MiniLM-L6, 768→mpnet-base L12, 1024→e5-large L24) so
+                # synthetic throughput/MFU numbers are honest for the real
+                # model's FLOPs, not a shallower stand-in.
                 d = self.config.embedding_dim
+                layers = {384: 6, 768: 12, 1024: 24}.get(d, 6 if d <= 512 else 12)
                 model_cfg = BertConfig(
                     vocab_size=30000, hidden_size=d,
-                    num_layers=6, num_heads=max(1, d // 64),
+                    num_layers=layers, num_heads=max(1, d // 64),
                     intermediate_size=4 * d, max_position_embeddings=512,
                     dtype=self.config.dtype)
                 params = bert_mod.init_params(jax.random.key(0), model_cfg)
