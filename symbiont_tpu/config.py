@@ -104,6 +104,16 @@ class LmConfig:
     # token streaming (events.text.generated.partial): decode in chunks of
     # this many tokens, emitting a text delta per chunk; 0 disables streaming
     stream_chunk: int = 16
+    # online fine-tune over ingested text (train/online.py): the LM analog of
+    # the Markov backend's continuous learning. Off by default — training
+    # shares the device with serving.
+    ingest_train: bool = False
+    ingest_train_steps: int = 2       # optimizer steps per training pass
+    ingest_train_min_chars: int = 512  # buffer this much text before a pass
+    ingest_train_seq_len: int = 64
+    ingest_train_batch: int = 8
+    ingest_train_lr: float = 1e-4
+    train_state_path: Optional[str] = None  # persist/resume learning
 
     def __post_init__(self) -> None:
         # the streaming decode loop runs whole chunks against a KV cache with

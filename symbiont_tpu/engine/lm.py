@@ -383,6 +383,19 @@ class LmEngine:
                 self.stats["tokens_generated"] += len(all_tokens)
                 self.stats["decode_s"] += decode_s
 
+    def update_params(self, params) -> None:
+        """Swap in new model parameters (online fine-tune sync,
+        train/online.py). Serialized on the engine lock so no decode is
+        mid-flight on the old buffers; an in-progress stream picks up the new
+        params at its next chunk (its KV cache entries from the old params
+        remain valid context — same contract as any incremental fine-tune).
+        The caller must hand over buffers it will not later donate or mutate
+        (OnlineLmTrainer passes a copy)."""
+        import jax
+
+        with self._lock:
+            self.params = jax.device_put(params)
+
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""
         self.generate("warmup", new_bucket or self.config.new_token_buckets[0])

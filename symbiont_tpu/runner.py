@@ -163,12 +163,25 @@ class SymbiontStack:
             lm_stream = (self.lm.generate_stream
                          if self.lm is not None and cfg.lm.stream_chunk > 0
                          else None)
+            lm_trainer = None
+            if self.lm is not None and cfg.lm.ingest_train:
+                from symbiont_tpu.train.online import OnlineLmTrainer
+
+                lm_trainer = OnlineLmTrainer(
+                    self.lm, learning_rate=cfg.lm.ingest_train_lr,
+                    seq_len=cfg.lm.ingest_train_seq_len,
+                    batch_size=cfg.lm.ingest_train_batch,
+                    state_path=cfg.lm.train_state_path)
             self.services.append(
                 TextGeneratorService(self.bus, lm_batcher=lm_batcher,
                                      lm_stream=lm_stream,
                                      train_on_ingest=lm_batcher is None,
                                      state_path=(cfg.text_generator
-                                                 .markov_state_path)))
+                                                 .markov_state_path),
+                                     lm_trainer=lm_trainer,
+                                     lm_train_min_chars=(
+                                         cfg.lm.ingest_train_min_chars),
+                                     lm_train_steps=cfg.lm.ingest_train_steps))
         if on("engine"):
             from symbiont_tpu.services.engine_service import EngineService
 

@@ -44,7 +44,9 @@ class TextGeneratorService(Service):
     name = "text_generator"
 
     def __init__(self, bus, lm_generate=None, lm_batcher=None, lm_stream=None,
-                 train_on_ingest: bool = True, state_path=None):
+                 train_on_ingest: bool = True, state_path=None,
+                 lm_trainer=None, lm_train_min_chars: int = 512,
+                 lm_train_steps: int = 2):
         super().__init__(bus)
         # persistence (SURVEY.md §5.4): restore the learned chain; the
         # reference rebuilds from one constant at every boot (main.rs:169-173)
@@ -67,12 +69,23 @@ class TextGeneratorService(Service):
         # when set, deltas stream out on events.text.generated.partial while
         # decoding; the final full message still rides events.text.generated
         self.train_on_ingest = train_on_ingest
+        # online LM fine-tune (train/online.OnlineLmTrainer | None): the LM
+        # analog of Markov's continuous learning — ingested text buffers
+        # until the threshold, then a few optimizer steps run off the event
+        # loop and the serving engine picks up the updated params
+        self.lm_trainer = lm_trainer
+        self._lm_train_min_chars = lm_train_min_chars
+        self._lm_train_steps = lm_train_steps
+        self._lm_buffer: list = []
+        self._lm_buffer_chars = 0
+        self._lm_train_lock = asyncio.Lock()
+        self._lm_train_task: asyncio.Task | None = None
 
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.TASKS_GENERATION_TEXT,
                                    self._handle_generate,
                                    queue=subjects.QUEUE_TEXT_GENERATOR)
-        if self.train_on_ingest:
+        if self.train_on_ingest or self.lm_trainer is not None:
             # continuous learning from the pipeline (no queue group: every
             # generator replica learns the full stream)
             await self._subscribe_loop(subjects.DATA_RAW_TEXT_DISCOVERED,
@@ -80,14 +93,50 @@ class TextGeneratorService(Service):
 
     async def _handle_train(self, msg: Msg) -> None:
         raw = from_json(RawTextMessage, msg.data)
-        self.markov.train(raw.raw_text)
-        metrics.inc("text_generator.trained_docs")
-        self._dirty = True
-        await self._maybe_save()
+        if self.train_on_ingest:
+            self.markov.train(raw.raw_text)
+            metrics.inc("text_generator.trained_docs")
+            self._dirty = True
+            await self._maybe_save()
+        if self.lm_trainer is not None:
+            self._lm_buffer.append(raw.raw_text)
+            self._lm_buffer_chars += len(raw.raw_text)
+            # fire-and-forget: the handler must NOT await the pass — parked
+            # handler tasks would exhaust the service's handler semaphore and
+            # stall every subscription (incl. generation requests) behind a
+            # multi-second training pass. One background task drains the
+            # buffer in a loop; docs arriving mid-pass buffer for its next
+            # iteration.
+            if (self._lm_buffer_chars >= self._lm_train_min_chars
+                    and not self._lm_train_lock.locked()):
+                self._lm_train_task = asyncio.create_task(
+                    self._lm_train_pass(), name="lm-ingest-train")
+
+    async def _lm_train_pass(self) -> None:
+        """Drain buffered ingest through fine-tune passes, off the event
+        loop, until the buffer is below the threshold."""
+        async with self._lm_train_lock:
+            while self._lm_buffer_chars >= self._lm_train_min_chars:
+                texts, self._lm_buffer, self._lm_buffer_chars = \
+                    self._lm_buffer, [], 0
+                with span("text_generator.lm_train", None, docs=len(texts)):
+                    out = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: self.lm_trainer.train_on_texts(
+                            texts, steps=self._lm_train_steps))
+                metrics.inc("text_generator.lm_train_passes")
+                metrics.inc("text_generator.lm_train_docs", len(texts))
+                loss = (float("nan") if out["loss"] is None
+                        else out["loss"])  # 0.0 is a real, healthy loss
+                log.info("online LM fine-tune: %d docs, %d steps, loss %.4f",
+                         len(texts), out["steps"], loss)
 
     async def stop(self) -> None:
         await super().stop()
         await self._maybe_save(force=True)  # flush unsaved learning
+        if self._lm_train_task is not None and not self._lm_train_task.done():
+            # let an in-flight fine-tune pass finish (it persists its own
+            # state); buffered-but-untrained text is the only loss on stop
+            await self._lm_train_task
 
     # ------------------------------------------------- markov persistence
 

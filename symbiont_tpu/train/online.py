@@ -1,0 +1,170 @@
+"""Online LM fine-tuning over ingested text — the "evolving organism" loop.
+
+The reference's entire learning story is an order-1 Markov chain retrained
+from one hardcoded sentence at every boot (reference:
+services/text_generator_service/src/main.rs:169-174). This framework already
+trains the Markov backend continuously on every ingested document
+(services/text_generator.py); this module gives the decoder-LM backend the
+same property: ingested text accumulates into packed [B, S] token batches
+and periodically takes a few AdamW steps (train/trainer.lm_train_step), after
+which the updated parameters are swapped into the serving LmEngine — so what
+the organism reads measurably changes what it says.
+
+Design constraints honored:
+- ONE static shape: all training batches are [batch_size, seq_len]; tokens
+  are packed into a ring of rows (no per-text padding waste, no recompiles).
+- `lm_train_step` donates its input state, so the trainer owns a private
+  copy of the params from the moment of construction; the serving engine
+  receives a fresh copy at each sync (LmEngine.update_params), never a
+  buffer the next step will donate away.
+- Crash-safe persistence via train/checkpoint.save_train_state (optional):
+  a restarted stack resumes from the accumulated learning instead of
+  reverting to the checkpoint it booted from.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class OnlineLmTrainer:
+    """Owns a TrainState for the LmEngine's model and feeds it ingested text.
+
+    Thread-safe: train_on_texts serializes on an internal lock (training is
+    called from executor threads by the service layer)."""
+
+    def __init__(self, lm, learning_rate: float = 1e-4, seq_len: int = 64,
+                 batch_size: int = 8, state_path: Optional[str] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from symbiont_tpu.train import checkpoint as ckpt
+        from symbiont_tpu.train.trainer import make_lm_train_state
+
+        self.lm = lm
+        self.cfg = lm.model_cfg
+        self.seq_len = int(min(seq_len, self.cfg.max_position_embeddings))
+        self.batch_size = int(batch_size)
+        self.state_path = state_path
+        self._lock = threading.Lock()
+        # token stream carried between passes: text beyond what one pass
+        # consumes is TRAINED LATER, never silently dropped
+        self._stream: list = []
+        self.stats = {"train_steps": 0, "train_docs": 0, "last_loss": None,
+                      "param_syncs": 0, "batches_trained": 0,
+                      "tokens_pending": 0}
+
+        # private copy: lm_train_step donates state, so training must never
+        # share buffers with the serving engine's live params
+        params = jax.tree.map(jnp.copy, lm.params)
+        self.state, self._tx = make_lm_train_state(params, learning_rate)
+        if state_path and ckpt.train_state_exists(state_path):
+            try:
+                self.state, meta = ckpt.load_train_state(state_path, self.state)
+                self.stats["train_steps"] = int(meta.get("steps", 0))
+                log.info("online LM train state restored from %s (step %s)",
+                         state_path, self.stats["train_steps"])
+                self._sync_engine()
+            except ValueError as e:
+                log.warning("online LM train state at %s does not match the "
+                            "current model (%s); starting fresh", state_path, e)
+
+    # ----------------------------------------------------------------- data
+
+    # a single document is capped at this many tokens per encode — bounds the
+    # host memory a pathological page can pin; a crawl-scale article fits
+    _DOC_TOKEN_CAP = 1 << 18
+
+    # one training pass consumes at most this many batches; the remainder of
+    # the token stream carries over to the next pass (bounds pass latency so
+    # one giant ingest burst can't monopolize the device)
+    MAX_BATCHES_PER_PASS = 16
+
+    def _take_batches(self, texts: Sequence[str]):
+        """Tokenize texts (BOS-separated) into the carried token stream,
+        then drain as many full [batch_size, seq_len] batches as available
+        (≤ MAX_BATCHES_PER_PASS). Leftover tokens stay in the stream for the
+        NEXT pass — nothing is dropped. A stream too short for one full
+        batch is cycled to fill it (short corpora still train)."""
+        import jax.numpy as jnp
+
+        tok = self.lm.tokenizer
+        bos = getattr(tok, "bos_id", 0)
+        for t in texts:
+            ids = tok.encode(t, self._DOC_TOKEN_CAP)
+            if ids:
+                self._stream.extend(ids if ids[0] == bos else [bos] + ids)
+        need = self.batch_size * self.seq_len
+        chunks: list = []
+        while len(self._stream) >= need and len(chunks) < self.MAX_BATCHES_PER_PASS:
+            chunks.append(self._stream[:need])
+            del self._stream[:need]
+        if not chunks:
+            if len(self._stream) < 2:  # nothing to learn from
+                return []
+            reps = -(-need // len(self._stream))
+            chunks = [(self._stream * reps)[:need]]
+            self._stream = []
+        out = []
+        for chunk in chunks:
+            ids = jnp.asarray(np.asarray(chunk, np.int32).reshape(
+                self.batch_size, self.seq_len))
+            out.append({"ids": ids, "mask": jnp.ones_like(ids)})
+        self.stats["tokens_pending"] = len(self._stream)
+        return out
+
+    # ---------------------------------------------------------------- train
+
+    def train_on_texts(self, texts: Sequence[str], steps: int = 1) -> dict:
+        """Run `steps` optimizer steps over each drained batch, then swap
+        the updated params into the serving engine. Returns metrics
+        including the last step's loss."""
+        import jax
+
+        from symbiont_tpu.train.trainer import lm_train_step
+
+        with self._lock:
+            batches = self._take_batches(texts)
+            if not batches:
+                return {"loss": None, "steps": 0}
+            loss = None
+            n_steps = 0
+            for batch in batches:
+                for _ in range(max(1, int(steps))):
+                    self.state, metrics = lm_train_step(self.state, batch,
+                                                        self.cfg, self._tx)
+                    loss = metrics["loss"]
+                    n_steps += 1
+            loss = float(jax.block_until_ready(loss))
+            self.stats["train_steps"] += n_steps
+            self.stats["train_docs"] += len(texts)
+            self.stats["batches_trained"] += len(batches)
+            self.stats["last_loss"] = loss
+            self._sync_engine()
+            if self.state_path:
+                self._save()
+        return {"loss": loss, "steps": n_steps, "batches": len(batches)}
+
+    def _sync_engine(self) -> None:
+        """Push a COPY of the trained params to the serving engine — the
+        trainer's own buffers will be donated by the next step."""
+        import jax
+        import jax.numpy as jnp
+
+        self.lm.update_params(jax.tree.map(jnp.copy, self.state.params))
+        self.stats["param_syncs"] += 1
+
+    def _save(self) -> None:
+        from symbiont_tpu.train import checkpoint as ckpt
+
+        try:
+            ckpt.save_train_state(self.state_path, self.state,
+                                  meta={"steps": self.stats["train_steps"]})
+        except OSError:
+            log.exception("online LM train-state save failed; continuing")

