@@ -226,6 +226,43 @@ def _block(layer, x, layer_idx, cache, positions, cfg, kv_valid):
     return x + h, cache
 
 
+def qkv_proj(layer, h: jax.Array, positions: jax.Array, cfg: GPTConfig):
+    """QKV projection + RoPE, no cache — the shared front half of attention
+    for the training-side forwards (parallel/context.py, parallel/pipeline.py).
+    Returns (q [B,S,nh,hd], k [B,S,nkv,hd], v [B,S,nkv,hd])."""
+    B, S, _ = h.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    q = (h @ layer["q"]["kernel"] + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
+    k = (h @ layer["k"]["kernel"] + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
+    v = (h @ layer["v"]["kernel"] + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)
+    if cfg.arch == "llama":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def block_nocache(layer, x: jax.Array, cfg: GPTConfig, attn) -> jax.Array:
+    """Decoder-block scaffolding (norms, residuals, MLP) with a pluggable
+    attention callable `attn(normed_hidden) -> attention output incl. o-proj`.
+    ONE home for the per-arch block math on the cache-free training paths —
+    _block above is its cache-threading twin for decode. Used by the
+    sequence-parallel (parallel/context.py) and pipeline-parallel
+    (parallel/pipeline.py) forwards so they cannot drift from each other."""
+    if cfg.arch == "gpt2":
+        x = x + attn(_ln(x, layer["ln1"], cfg.layer_norm_eps))
+        h = _ln(x, layer["ln2"], cfg.layer_norm_eps)
+        h = h @ layer["mlp"]["in"]["kernel"] + layer["mlp"]["in"]["bias"]
+        h = jax.nn.gelu(h, approximate=True)  # GPT-2 uses gelu_new
+        h = h @ layer["mlp"]["out"]["kernel"] + layer["mlp"]["out"]["bias"]
+        return x + h
+    x = x + attn(_rmsnorm(x, layer["ln1"], cfg.layer_norm_eps))
+    h = _rmsnorm(x, layer["ln2"], cfg.layer_norm_eps)
+    gate = jax.nn.silu(h @ layer["mlp"]["gate"]["kernel"])
+    up = h @ layer["mlp"]["up"]["kernel"]
+    h = (gate * up) @ layer["mlp"]["down"]["kernel"]
+    return x + h
+
+
 def forward(
     params: Params,
     input_ids: jax.Array,  # [B, S]

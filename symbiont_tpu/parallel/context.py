@@ -25,7 +25,13 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from symbiont_tpu.models.gpt import GPTConfig, _ln, _rmsnorm, _rope
+from symbiont_tpu.models.gpt import (
+    GPTConfig,
+    _ln,
+    _rmsnorm,
+    block_nocache,
+    qkv_proj,
+)
 from symbiont_tpu.parallel.ring_attention import ring_attention
 from symbiont_tpu.parallel.ulysses import ulysses_attention
 
@@ -34,17 +40,14 @@ Params = Any
 
 def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str, attn_impl: str):
     """One decoder block with sequence-parallel attention; x: [B, S_loc, H]
-    (local shard), positions: [B, S_loc] global token positions."""
+    (local shard), positions: [B, S_loc] global token positions. Block
+    scaffolding and QKV projection come from models/gpt (block_nocache /
+    qkv_proj) — only the attention schedule is local to this module."""
     B, S, H = x.shape
-    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    nh, nkv = cfg.num_heads, cfg.kv_heads
 
     def attn(h):
-        q = (h @ layer["q"]["kernel"] + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
-        k = (h @ layer["k"]["kernel"] + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
-        v = (h @ layer["v"]["kernel"] + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)
-        if cfg.arch == "llama":
-            q = _rope(q, positions, cfg.rope_theta)
-            k = _rope(k, positions, cfg.rope_theta)
+        q, k, v = qkv_proj(layer, h, positions, cfg)
         if attn_impl == "ulysses":
             # Ulysses re-shards heads over the axis, so K/V must be at full
             # head count first (the all-to-all splits the head dim)
@@ -58,19 +61,7 @@ def _block_sp(layer, x, positions, cfg: GPTConfig, axis: str, attn_impl: str):
             ctx = ring_attention(q, k, v, axis, causal=True).reshape(B, S, H)
         return ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
 
-    if cfg.arch == "gpt2":
-        x = x + attn(_ln(x, layer["ln1"], cfg.layer_norm_eps))
-        h = _ln(x, layer["ln2"], cfg.layer_norm_eps)
-        h = h @ layer["mlp"]["in"]["kernel"] + layer["mlp"]["in"]["bias"]
-        h = jax.nn.gelu(h, approximate=True)
-        h = h @ layer["mlp"]["out"]["kernel"] + layer["mlp"]["out"]["bias"]
-        return x + h
-    x = x + attn(_rmsnorm(x, layer["ln1"], cfg.layer_norm_eps))
-    h = _rmsnorm(x, layer["ln2"], cfg.layer_norm_eps)
-    gate = jax.nn.silu(h @ layer["mlp"]["gate"]["kernel"])
-    up = h @ layer["mlp"]["up"]["kernel"]
-    h = (gate * up) @ layer["mlp"]["down"]["kernel"]
-    return x + h
+    return block_nocache(layer, x, cfg, attn)
 
 
 def gpt_forward_sp(
