@@ -20,10 +20,16 @@ Design:
 - numerics: compute in f32 (scores, softmax, accumulator) regardless of input
   dtype; output cast back to q.dtype. Masked-out positions use large-negative
   finite biases, never -inf, so no NaN can escape `exp`.
-- autodiff: `jax.custom_vjp` whose backward is a dense f32 recompute (exact
-  softmax gradient). Sequences in this system are ≤512 (encoder buckets) or
-  ≤ a few k (LM training), where the dense backward is fine; the forward is
-  the latency-critical path.
+- autodiff: `jax.custom_vjp` whose backward is ALSO fused (two pallas
+  kernels): the forward additionally emits the log-sum-exp rows, and the
+  backward recomputes probability blocks from (q, k, lse) — one kernel
+  accumulates dK/dV (+ the bias gradient) over q blocks, one accumulates dQ
+  over kv blocks. The [B, NH, S, S] probability matrix is never
+  materialized in either direction, so encoder fine-tuning at the 512
+  bucket and LM training at multi-k contexts stay O(S) activation memory.
+  GQA (kv heads < q heads) falls back to a dense f32 recompute backward —
+  that path is prefill-only in this system; long-context LM *training*
+  rides the sequence-parallel schedule (parallel/context.py).
 - fallback: shapes the kernel can't tile (non-divisible or tiny S) route to
   the same dense reference implementation, so callers never need shape
   special-cases.
@@ -52,6 +58,16 @@ _ACC_NEG = -1e30
 _MASK_NEG = -1e9
 
 
+def _dot_prec(*operands):
+    """MXU precision for a kernel dot: Mosaic's DEFAULT decomposes f32 dots
+    into single-pass bf16 (~1% error, observed on-chip), so f32 operands get
+    Precision.HIGHEST (full f32 passes). bf16 operands MUST use the default —
+    Mosaic rejects fp32 contract precision on bf16 inputs ("Bad lhs type")."""
+    if all(o.dtype == jnp.float32 for o in operands):
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
 def _pick_block(s: int, pref: int) -> int:
     """Largest power-of-two block ≤ pref that divides s (0 = no tiling)."""
     b = pref
@@ -62,8 +78,9 @@ def _pick_block(s: int, pref: int) -> int:
     return 0
 
 
-def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-            *, scale: float, causal: bool, block_q: int, block_k: int):
+def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+            acc_scr, *, scale: float, causal: bool, block_q: int,
+            block_k: int):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -76,13 +93,16 @@ def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     def _compute():
         # matmuls run in the input dtype (bf16 → native MXU multiply) with
-        # f32 accumulation via preferred_element_type; upcasting the operands
-        # themselves would force multi-pass f32 MXU matmuls (~3× slower).
+        # f32 accumulation via preferred_element_type. precision=HIGHEST
+        # matters only for f32 operands: Mosaic's default decomposes f32
+        # MXU dots into single-pass bf16 (~1% error, observed on-chip);
+        # HIGHEST buys full f32 passes. bf16 operands are unaffected.
         q = q_ref[0, 0]  # [bq, D]
         k = k_ref[0, 0]  # [bk, D]
         v = v_ref[0, 0]  # [bk, D]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=_dot_prec(q, k)) * scale
         # bias arrives pre-blocked [B, nk, 1, bk] so the BlockSpec index map
         # (not an in-kernel dynamic lane slice, which Mosaic can't tile-prove)
         # selects this kv window; [1, bk] broadcasts over q rows
@@ -101,7 +121,8 @@ def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(v))
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -117,6 +138,11 @@ def _kernel(bias_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     def _finish():
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # log-sum-exp per q row — the residual the fused backward rebuilds
+        # probability blocks from (p = exp(s - lse)). Shaped [bq, 1]: the
+        # trailing singleton keeps the block Mosaic-tileable (sublane dim bq
+        # divisible by 8, lane dim equal to the array's).
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)
 
 
 def _flash_call(q, k, v, bias, causal, scale, block_q, block_k, interpret):
@@ -144,8 +170,14 @@ def _flash_call(q, k, v, bias, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, bk, D),
                          lambda b, h, qi, ki, g=group: (b, h // g, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, NH, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, NH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, NH, Sq, 1), jnp.float32),  # lse
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
             pltpu.VMEM((bq, 128), jnp.float32),  # running normalizer
@@ -174,23 +206,220 @@ def _dense_reference(q, k, v, bias, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p, vf), (p, qf, kf, vf)
 
 
+# ------------------------------------------------------------ fused backward
+
+
+def _bwd_kv_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, dbias_scr,
+                   *, scale: float, causal: bool, block_q: int, block_k: int):
+    """dK/dV (+ per-head dbias) for one kv block, accumulated over q blocks
+    (innermost sequential axis). p is rebuilt from (q, k, lse) — no S×S
+    materialization."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+        dbias_scr[:] = jnp.zeros(dbias_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]  # [bq, D] — kept in input dtype for the dots
+        lse = lse_ref[0, 0]    # [bq, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_dot_prec(q, k)) * scale
+        s = s + bias_ref[0, 0]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _MASK_NEG)
+        p = jnp.exp(s - lse)  # [bq, bk] — exact probs via the saved lse
+        # dv += pᵀ g ; dp = g vᵀ ; ds = p (dp − delta) ; dk += dsᵀ q · scale
+        # (f32-derived p/ds cast DOWN to the input dtype for the dots, like
+        # the forward's p@v — bf16 operands keep single-pass MXU matmuls)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(g))
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_dot_prec(g, v))
+        ds = p * (dp - delta)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q)) * scale
+        dbias_scr[:] = dbias_scr[:] + jnp.broadcast_to(
+            jnp.sum(ds, axis=0, keepdims=True), dbias_scr.shape)
+
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        # written at the scratch's own (8, bk) tile shape — sublane-replicated
+        # rows; the host reads row 0 (keeps the store Mosaic-tileable without
+        # a lane→sublane transpose in-kernel)
+        dbias_ref[0, 0] = dbias_scr[:]
+
+
+def _bwd_q_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                  dq_ref, dq_scr, *, scale: float, causal: bool,
+                  block_q: int, block_k: int):
+    """dQ for one q block, accumulated over kv blocks (innermost)."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]  # input dtype — see _bwd_kv_kernel
+        lse = lse_ref[0, 0]    # [bq, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_dot_prec(q, k)) * scale
+        s = s + bias_ref[0, 0]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _MASK_NEG)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_dot_prec(g, v))
+        ds = p * (dp - delta)
+        # dq += ds @ k · scale — contract ds's kv dim with k's kv dim
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(k)) * scale
+
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, bias, out, lse, g, causal, scale, bq, bk,
+                     interpret):
+    """Fused backward (NH == NKV): two pallas calls, O(S) memory."""
+    B, NH, Sq, D = q.shape
+    Sk = k.shape[2]
+    # delta carries the same [B, NH, Sq, 1] layout as lse (tileable blocks)
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(
+        -1, keepdims=True)
+    bias_blocked = bias.astype(jnp.float32).reshape(B, Sk // bk, 1, bk)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    qspec_j = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, j, 0))
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, i, 0))
+    kspec_j = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    rowspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0))
+    rowspec_j = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, j, 0))
+
+    dk, dv, dbias_h = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B, NH, Sk // bk, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, j: (b, i, 0, 0)),
+            qspec_j, kspec, kspec, qspec_j, rowspec_j, rowspec_j,
+        ],
+        out_specs=[kspec, kspec,
+                   pl.BlockSpec((1, 1, 8, bk), lambda b, h, i, j: (b, h, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((B, NH, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, NH, Sk, D), v.dtype),
+                   jax.ShapeDtypeStruct((B, NH, 8, Sk), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((8, bk), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(bias_blocked, q, k, v, g, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_q_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B, NH, Sq // bq, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, j: (b, j, 0, 0)),
+            qspec, kspec_j, kspec_j, qspec, rowspec, rowspec,
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, NH, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(bias_blocked, q, k, v, g, lse, delta)
+
+    return dq, dk, dv, dbias_h[:, :, 0, :].sum(axis=1).astype(bias.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     if block_q == 0 or block_k == 0:
         out, _ = _dense_reference(q, k, v, bias, causal, scale)
         return out.astype(q.dtype)
-    return _flash_call(q, k, v, bias, causal, scale, block_q, block_k, interpret)
+    return _flash_call(q, k, v, bias, causal, scale, block_q, block_k,
+                       interpret)[0]
 
 
 def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
-    out = _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, bias)
+    if block_q == 0 or block_k == 0:
+        out, _ = _dense_reference(q, k, v, bias, causal, scale)
+        return out.astype(q.dtype), (q, k, v, bias, None, None)
+    out, lse = _flash_call(q, k, v, bias, causal, scale, block_q, block_k,
+                           interpret)
+    if q.shape[1] != k.shape[1]:
+        # GQA routes to the dense-recompute backward, which never reads
+        # out/lse — don't pin them in the autodiff residuals
+        return out, (q, k, v, bias, None, None)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, bias = res
+    q, k, v, bias, out, lse = res
     NH, NKV = q.shape[1], k.shape[1]
     group = NH // NKV
+    if lse is not None and group == 1:
+        return _flash_bwd_fused(q, k, v, bias, out, lse, g, causal, scale,
+                                block_q, block_k, interpret)
+    # dense f32 recompute: the fallback-shape path and GQA (prefill-only in
+    # this system; long-context LM training rides parallel/context.py)
     _, (p, qf, kf, vf) = _dense_reference(q, k, v, bias, causal, scale)
     gf = g.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
