@@ -20,6 +20,10 @@ context    : sequence-parallel decoder LM *training* — the full train-time
 ulysses    : the all-to-all sequence-parallel scheme — trade sequence shards
              for head shards, run dense attention, trade back (same exactness
              contract as ring; pick per workload)
+pipeline   : GPipe pipeline parallelism — layer stages sharded over a 'pipe'
+             axis, microbatch activations flowing via ppermute inside one
+             jitted scan (lm_loss_pp / make_lm_train_step_pp /
+             make_pp_train_state; exact vs the unsharded step)
 
 XLA inserts the collectives (psum/all-gather/ppermute ride ICI); this package
 only defines meshes and shardings — no hand-written NCCL analog (SURVEY.md §2
@@ -50,6 +54,11 @@ from symbiont_tpu.parallel.ulysses import (
     ulysses_attention,
     ulysses_attention_sharded,
 )
+from symbiont_tpu.parallel.pipeline import (
+    lm_loss_pp,
+    make_lm_train_step_pp,
+    make_pp_train_state,
+)
 
 __all__ = [
     "build_mesh",
@@ -66,4 +75,7 @@ __all__ = [
     "ring_attention_sharded",
     "ulysses_attention",
     "ulysses_attention_sharded",
+    "lm_loss_pp",
+    "make_lm_train_step_pp",
+    "make_pp_train_state",
 ]
