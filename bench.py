@@ -215,7 +215,11 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
         toks, _ = gpt_mod.generate(params, ids, mask, key_, cfg,
                                    max_new_tokens=max_new, temperature=0.8,
                                    top_k=40)
-        jax.block_until_ready(toks)
+        # np.asarray (device→host), NOT block_until_ready: through the
+        # network-attached runtime block_until_ready can return before the
+        # remote execution finishes, inflating tok/s by ~400× (observed);
+        # materializing the tokens is the only honest completion barrier
+        np.asarray(toks)
 
     run(1)    # compile (prefill + 1-step scan)
     run(NEW)  # compile the NEW-step scan
@@ -315,11 +319,14 @@ def _compute_mfu_geometry(results: dict, peak: float, dim: int, B: int,
         return jax.lax.scan(body, jnp.float32(0),
                             jnp.arange(N, dtype=jnp.int32))[0]
 
-    jax.block_until_ready(loop(eng.params, ids, mask))
+    # materialize the scalar (d2h) as the completion barrier — see run() in
+    # _bench_decode_geometry for why block_until_ready alone is not enough
+    # through the network-attached runtime
+    np.asarray(loop(eng.params, ids, mask))
     best = float("inf")
     for _ in range(3):
         t0 = time.time()
-        jax.block_until_ready(loop(eng.params, ids, mask))
+        np.asarray(loop(eng.params, ids, mask))
         best = min(best, time.time() - t0)
     tokens = N * B * S
     flops = tokens * L * (8 * H * H + 4 * H * I) + N * B * L * 4 * H * S * S
