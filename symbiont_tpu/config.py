@@ -53,8 +53,10 @@ class EngineConfig:
     embedding_dim: int = 768
     force_cpu: bool = False  # reference: FORCE_CPU env, preprocessing main.rs:307
     dtype: str = "bfloat16"
-    # attention backend: "auto" → pallas flash kernel on TPU, einsum-XLA
-    # elsewhere; "flash"/"xla" force it.
+    # attention backend: "auto" → XLA fused attention (fastest at every
+    # measured encoder bucket on v5e with the bf16 softmax path);
+    # "flash" opts into the pallas kernel (no S² intermediates — the
+    # memory-bound choice); "xla" forces XLA.
     attn_impl: str = "auto"
     # Length buckets replace the reference's pad-everything-to-max policy
     # (reference: embedding_generator.rs:83-91) — §5.7 of SURVEY.md.

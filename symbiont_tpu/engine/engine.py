@@ -134,14 +134,15 @@ class TpuEngine:
         if attn_impl not in ("auto", "flash", "xla"):
             raise ValueError(
                 f"attn_impl must be auto|flash|xla, got {attn_impl!r}")
-        # 'auto' is resolved PER LENGTH BUCKET in _get_executable: measured
-        # on v5e, XLA's fused attention beats the pallas flash kernel by
-        # ~35% at short lengths (S<=128; the kernel's tiling only pays off
-        # once S² memory matters), so flash is reserved for buckets >= 256.
-        self._auto_attn = attn_impl == "auto"
-        self._flash_ok = jax.default_backend() == "tpu"
+        # 'auto' resolves to XLA attention for EVERY encoder bucket: with the
+        # bf16 softmax path in models/bert.py, XLA's fused attention now
+        # beats the pallas flash kernel at all bucket lengths on v5e
+        # (measured compute-only: +36% at S=256, +9% at S=512 — flash won
+        # these buckets only back when the softmax round-tripped f32).
+        # attn_impl='flash' remains an explicit opt-in for memory-bound
+        # cases (no S² intermediates; fused backward for training).
         if attn_impl == "auto":
-            attn_impl = "xla"  # default; long buckets override per-executable
+            attn_impl = "xla"
         if model_cfg.attn_impl != attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
         if cross_cfg is not None and cross_cfg.dtype != self.config.dtype:
@@ -191,13 +192,11 @@ class TpuEngine:
     # ------------------------------------------------------------------ jit
 
     def _attn_cfg(self, cfg, L: int):
-        """Resolve attn_impl='auto' per length bucket: flash only where the
-        pallas kernel's tiling wins (S >= 256 on TPU); XLA's fused attention
-        is ~35% faster at the short buckets (measured on v5e)."""
-        if self._auto_attn and self._flash_ok and L >= 256:
-            import dataclasses
-
-            return dataclasses.replace(cfg, attn_impl="flash")
+        """attn_impl='auto' → XLA at every bucket (see __init__: with bf16
+        softmax, XLA wins all measured encoder lengths on v5e). The per-
+        bucket hook stays so a future chip/length where the kernel wins can
+        re-split the policy without touching call sites."""
+        del L
         return cfg
 
     def _get_executable(self, kind: str, L: int, B: int) -> Callable:
