@@ -198,8 +198,15 @@ def _attn(
     valid = (kv_pos <= q_cache_pos) & (kv_pos < (start + S))
     if kv_valid is not None:
         valid = valid & kv_valid[:, None, None, :]
-    scores = jnp.where(valid, scores.astype(jnp.float32), -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    if x.dtype == jnp.bfloat16:
+        # softmax in bf16, same rationale as models/bert.py attention: the
+        # f32 round-trip doubles the [B, nh, S, T] intermediate's HBM
+        # traffic, and bf16 matmul noise already dominates the rounding
+        scores = jnp.where(valid, scores, jnp.asarray(-1e9, scores.dtype))
+        probs = jax.nn.softmax(scores, axis=-1)
+    else:
+        scores = jnp.where(valid, scores.astype(jnp.float32), -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(x.dtype)).reshape(B, S, H)
     out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
     return out, new_cache

@@ -182,3 +182,4 @@ def test_ragged_batch_prompt_lengths(torch_gpt2):
                             jax.random.key(0), cfg, max_new_tokens=4,
                             temperature=0.0)
     np.testing.assert_array_equal(np.asarray(toks_batch)[1], np.asarray(toks_solo)[0])
+

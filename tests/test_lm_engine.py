@@ -401,3 +401,54 @@ def test_sampling_values_do_not_recompile():
     eng.generate("a", 6, temperature=0.9, top_k=7)  # same top-k bucket (8)
     eng.generate("a", 6, temperature=1.3, top_k=3)
     assert gpt_mod._generate_jit._cache_size() == n
+
+
+@pytest.mark.parametrize("arch,num_kv", [("gpt2", None), ("llama", 2)])
+def test_bf16_close_to_fp32_prefill_and_decode(arch, num_kv):
+    """Production-dtype gate for the decoder (ungated by torch — pure JAX):
+    the bf16 attention path (bf16 softmax) must stay close to fp32 on BOTH
+    shapes it serves: prefill (S>1, fresh cache, padded rows) and the decode
+    step (S=1 against a populated, partially masked cache). Next-token
+    distribution cosine > 0.995 per row."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from symbiont_tpu.models.gpt import (GPTConfig, forward, init_cache,
+                                         init_params)
+
+    cfg32 = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=num_kv, intermediate_size=64,
+                      max_position_embeddings=64, arch=arch, dtype="float32",
+                      tie_word_embeddings=True)
+    cfg16 = dataclasses.replace(cfg32, dtype="bfloat16")
+    params = init_params(jax.random.key(11), cfg32)
+    rng = np.random.default_rng(5)
+    B, S, NEW = 3, 16, 4
+    ids = jnp.asarray(rng.integers(1, 97, (B, S)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    # partially masked cache: row 1's first 5 slots are padding
+    kv_valid = jnp.ones((B, S + NEW), bool).at[1, :5].set(False)
+
+    def cos(a, b):
+        pa = jax.nn.softmax(a, axis=-1)
+        pb = jax.nn.softmax(b, axis=-1)
+        return float(((pa * pb).sum(-1) / (jnp.linalg.norm(pa, axis=-1)
+                     * jnp.linalg.norm(pb, axis=-1))).min())
+
+    outs = {}
+    for name, cfg, dt in (("f32", cfg32, jnp.float32),
+                          ("bf16", cfg16, jnp.bfloat16)):
+        cache = init_cache(cfg, B, S + NEW, dt)
+        lo, cache = forward(params, ids, cache, positions, cfg, kv_valid)
+        cache = cache._replace(length=jnp.asarray(S, jnp.int32))
+        # one decode step against the populated cache
+        tok = jnp.argmax(lo[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        lo1, _ = forward(params, tok, cache,
+                         jnp.full((B, 1), S, jnp.int32), cfg, kv_valid)
+        outs[name] = (lo[:, -1], lo1[:, 0])
+
+    assert cos(outs["f32"][0], outs["bf16"][0]) > 0.995  # prefill
+    assert cos(outs["f32"][1], outs["bf16"][1]) > 0.995  # decode w/ cache
