@@ -104,14 +104,22 @@ class QdrantStore:
                       delay_s=self._retry_delay_s,
                       what=f"qdrant at {self.base}", fatal=(ValueError,))
 
+    # Real Qdrant caps the JSON request body (32MB default); a 768-dim f32
+    # point is ~10KB as JSON text, so bulk upserts must chunk. 512 points ≈
+    # 5MB per request — safely under the cap with headroom for payloads.
+    UPSERT_CHUNK = 512
+
     def upsert(self, points: Sequence[Tuple[str, Sequence[float], dict]]) -> int:
         if not points:
             return 0
-        body = {"points": [{"id": pid, "vector": [float(x) for x in vec],
-                            "payload": payload}
-                           for pid, vec, payload in points]}
-        self._call("PUT", f"/collections/{self.collection}/points?wait=true",
-                   body)
+        for i in range(0, len(points), self.UPSERT_CHUNK):
+            chunk = points[i:i + self.UPSERT_CHUNK]
+            body = {"points": [{"id": pid, "vector": [float(x) for x in vec],
+                                "payload": payload}
+                               for pid, vec, payload in chunk]}
+            self._call("PUT",
+                       f"/collections/{self.collection}/points?wait=true",
+                       body)
         return len(points)
 
     def search(self, query: Sequence[float], top_k: int) -> List[SearchHit]:

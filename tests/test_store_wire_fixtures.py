@@ -151,6 +151,21 @@ def test_qdrant_upsert_wire_shape(qdrant):
     assert body == json.dumps(expected).encode()  # byte-level
 
 
+def test_qdrant_bulk_upsert_chunks_requests(qdrant):
+    """Real Qdrant rejects request bodies over its JSON cap (32MB default),
+    so bulk upserts must split into multiple PUTs — each still wait=true."""
+    rec, store = qdrant
+    n = store.UPSERT_CHUNK * 2 + 17  # forces 3 requests
+    pts = [(deterministic_point_id("bulk", i), [0.0, 1.0, 2.0],
+            {"sentence_order": i}) for i in range(n)]
+    assert store.upsert(pts) == n
+    assert len(rec.requests) == 3
+    sizes = [len(json.loads(b)["points"]) for _, _, _, b in rec.requests]
+    assert sizes == [store.UPSERT_CHUNK, store.UPSERT_CHUNK, 17]
+    for _, path, _, _ in rec.requests:
+        assert path.endswith("/points?wait=true")
+
+
 def test_qdrant_search_wire_shape(qdrant):
     """Search: top-k with payload on, vectors off (main.rs:261-286), and the
     documented {"result": [hits]} response decoded into SearchHits."""
