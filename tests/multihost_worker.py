@@ -8,6 +8,14 @@ sharded train step (docs/DEPLOYMENT.md Topology 3). Nothing here is
 test-double'd: the coordinator service, cross-process device discovery, and
 the XLA collectives the train step's gradient psum lowers to are all real.
 
+Two scenarios, selected by SYMBIONT_MULTIHOST_MODE:
+- "dp" (default): pure data-parallel mesh over all 8 devices; the gradient
+  psum over 'data' crosses the process boundary.
+- "tp": a [4, 2] mesh whose 'tensor' axis PAIRS one device from each
+  process, so every tensor-parallel collective in the train step (activation
+  psums, gradient reductions) physically crosses hosts — the megatron-style
+  sharding proven over DCN, not just ICI.
+
 Protocol (parsed by the parent test): prints one line
     MULTIHOST ok global=<N> local=<n> procs=<P> loss=<float> sum=<int>
 and exits 0; any assertion failure exits nonzero with a traceback.
@@ -41,8 +49,17 @@ def main() -> None:
     assert procs == 2, f"expected 2 processes, got {procs}"
     assert n_global == 2 * n_local, (n_global, n_local)
 
-    # one DP mesh over the WHOLE cluster: both processes' devices
-    mesh = build_mesh([n_global, 1])
+    mode = os.environ.get("SYMBIONT_MULTIHOST_MODE", "dp")
+    if mode == "tp":
+        # tensor axis spans the processes: pair device i of process 0 with
+        # device i of process 1, so TP collectives ride the cross-host link
+        devs = np.asarray(jax.devices()).reshape(procs, n_local).T
+        mesh = jax.sharding.Mesh(devs, ("data", "tensor"))
+        assert all({d.process_index for d in row} == {0, 1}
+                   for row in devs), "each tensor pair must span processes"
+    else:
+        # one DP mesh over the WHOLE cluster: both processes' devices
+        mesh = build_mesh([n_global, 1])
     assert {d.process_index for d in mesh.devices.flat} == {0, 1}, \
         "mesh must span both processes"
 
@@ -51,6 +68,11 @@ def main() -> None:
         intermediate_size=128, max_position_embeddings=32,
         arch="llama", num_kv_heads=2, dtype="float32",
         tie_word_embeddings=True)
+
+    if mode == "tp":
+        _run_tp(mesh, cfg, n_global, n_local, procs)
+        return
+
     tx = _adamw(1e-3)
     rep = NamedSharding(mesh, P())
 
@@ -66,24 +88,86 @@ def main() -> None:
 
     # global batch sharded over 'data': each process materializes only ITS
     # addressable shards; rows therefore physically live on different hosts.
-    B, S = n_global, 16
-    rng = np.random.default_rng(7)  # same seed → same global view everywhere
+    # _make_batch also proves a collective crosses the process boundary (a
+    # global sum of the sharded array must equal the host-known total).
+    batch, total = _make_batch(mesh, cfg, B=n_global)
+
+    # ONE cross-process DP train step (gradient psum over 'data' spans hosts)
+    state, metrics = lm_train_step(state, batch, cfg, tx)
+    loss = float(metrics["loss"].addressable_shards[0].data)
+    assert np.isfinite(loss), loss
+    assert int(state.step.addressable_shards[0].data) == 1
+
+    print(f"MULTIHOST ok global={n_global} local={n_local} procs={procs} "
+          f"loss={loss:.6f} sum={total}", flush=True)
+
+
+def _make_batch(mesh, cfg, B: int, S: int = 16):
+    """Shared batch protocol for both scenarios: same seed → same global
+    view on every process; rows sharded over 'data' so each process
+    materializes only its addressable shards. Returns (batch, global_sum)
+    where global_sum proves a collective crossed the process boundary."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(7)
     full_ids = rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32)
     bs = NamedSharding(mesh, P("data"))
     ids = jax.make_array_from_callback((B, S), bs, lambda idx: full_ids[idx])
     mask = jax.make_array_from_callback(
         (B, S), bs, lambda idx: np.ones((B, S), np.int32)[idx])
-
-    # prove a collective actually crosses the process boundary: a global sum
-    # of the data-sharded array must equal the host-known total
     total = int(jax.jit(jnp.sum)(ids).addressable_shards[0].data)
     assert total == int(full_ids.sum()), (total, int(full_ids.sum()))
+    return {"ids": ids, "mask": mask}, total
 
-    # ONE cross-process DP train step (gradient psum over 'data' spans hosts)
-    state, metrics = lm_train_step(state, {"ids": ids, "mask": mask}, cfg, tx)
-    loss = float(metrics["loss"].addressable_shards[0].data)
+
+def _run_tp(mesh, cfg, n_global: int, n_local: int, procs: int) -> None:
+    """Cross-host tensor parallelism: params megatron-sharded over the
+    'tensor' axis (which pairs devices ACROSS the two processes), then one
+    FULL train step — forward, backward, AdamW update — so every TP
+    collective and the sharded optimizer update cross the process
+    boundary."""
+    from functools import partial
+
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbiont_tpu.models import gpt as gpt_mod
+    from symbiont_tpu.parallel.sharding import gpt_param_sharding
+    from symbiont_tpu.train.trainer import _adamw, lm_loss
+
+    template = jax.eval_shape(lambda k: gpt_mod.init_params(k, cfg),
+                              jax.random.key(0))
+    spec = gpt_param_sharding(mesh, template, arch="llama")
+    out_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), spec,
+                          is_leaf=lambda x: isinstance(x, P))
+    params = jax.jit(lambda k: gpt_mod.init_params(k, cfg),
+                     out_shardings=out_sh)(jax.random.key(0))
+    # q kernels really live split over the cross-host tensor axis
+    assert "tensor" in str(params["layers"][0]["q"]["kernel"].sharding.spec)
+
+    batch, total = _make_batch(mesh, cfg, B=mesh.shape["data"])
+
+    @partial(jax.jit, static_argnums=(2,))
+    def train_step(params, batch, cfg):
+        # optimizer state created under jit so XLA propagates the TP
+        # shardings into mu/nu — the sharded-update path is exercised too
+        tx = _adamw(1e-3)
+        opt_state = tx.init(params)
+        loss, grads = jax.value_and_grad(lm_loss)(params, batch, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates)
+
+    loss, new_params = train_step(params, batch, cfg)
+    loss = float(loss.addressable_shards[0].data)
     assert np.isfinite(loss), loss
-    assert int(state.step.addressable_shards[0].data) == 1
+    # updated params kept the TP sharding through the optimizer update
+    assert "tensor" in str(
+        new_params["layers"][0]["q"]["kernel"].sharding.spec)
 
     print(f"MULTIHOST ok global={n_global} local={n_local} procs={procs} "
           f"loss={loss:.6f} sum={total}", flush=True)

@@ -21,6 +21,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 WORKER = REPO / "tests" / "multihost_worker.py"
 
@@ -31,7 +33,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_train_step():
+@pytest.mark.parametrize("mode", ["dp", "tp"])
+def test_two_process_train_step(mode):
+    """mode='dp': gradient psum over 'data' crosses processes.
+    mode='tp': megatron-sharded params whose 'tensor' axis pairs devices
+    ACROSS the two processes — every TP collective rides the cross-host
+    link (the distributed story beyond batch parallelism)."""
     port = _free_port()
     n_procs, local_devs = 2, 4
 
@@ -47,6 +54,7 @@ def test_two_process_dp_train_step():
             SYMBIONT_COORDINATOR=f"127.0.0.1:{port}",
             SYMBIONT_NUM_PROCESSES=str(n_procs),
             SYMBIONT_PROCESS_ID=str(pid),
+            SYMBIONT_MULTIHOST_MODE=mode,
         )
         return env
 
