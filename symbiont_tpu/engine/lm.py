@@ -315,7 +315,10 @@ class LmEngine:
         (prompt_bucket, chunk) pair, re-invoked with carried device state —
         time-to-first-chunk is prefill + stream_chunk steps instead of the
         full decode. Greedy streaming concatenates to exactly generate()'s
-        output (asserted in tests)."""
+        output in float32 (asserted in tests); under bfloat16 the chunked
+        and full-scan executables may round differently, so greedy outputs
+        can diverge at argmax near-ties (pronounced with random weights,
+        whose logits are nearly uniform — real checkpoints have margins)."""
         import jax
         import jax.numpy as jnp
 

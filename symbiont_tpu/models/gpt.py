@@ -158,14 +158,20 @@ def _attn(
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
-    # write into the static cache at [length : length+S]
+    # write into the static cache at [length : length+S] with ONE
+    # dynamic_update_slice on the stacked [L, B, T, h, d] array. The previous
+    # slice-modify-set form (cache.k[layer_idx] → DUS → .at[layer_idx].set)
+    # round-tripped a full layer slab per layer per step and XLA did not
+    # always fuse it away: decode ms/step grew linearly with cache length
+    # (measured on v5e, TinyLlama geometry: +2.9 ms/step from T=192 → 576).
     start = cache.length
-    k_all = jax.lax.dynamic_update_slice(cache.k[layer_idx], k.astype(cache.k.dtype),
-                                         (0, start, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(cache.v[layer_idx], v.astype(cache.v.dtype),
-                                         (0, start, 0, 0))
-    new_cache = KVCache(cache.k.at[layer_idx].set(k_all),
-                        cache.v.at[layer_idx].set(v_all), cache.length)
+    k_cache = jax.lax.dynamic_update_slice(
+        cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache.v, v.astype(cache.v.dtype)[None], (layer_idx, 0, start, 0, 0))
+    new_cache = KVCache(k_cache, v_cache, cache.length)
+    k_all = k_cache[layer_idx]
+    v_all = v_cache[layer_idx]
 
     if cfg.attn_impl == "flash" and S > 1:
         # Prefill-from-empty: attention over exactly the S fresh tokens (the
@@ -183,21 +189,23 @@ def _attn(
         out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
         return out, new_cache
 
-    if nkv != nh:
-        rep = nh // nkv
-        k_all = jnp.repeat(k_all, rep, axis=2)
-        v_all = jnp.repeat(v_all, rep, axis=2)
-
     T = k_all.shape[1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all.astype(q.dtype)) / math.sqrt(hd)
+    # GQA without jnp.repeat: query heads are grouped onto their kv head in
+    # a 5D einsum instead of materializing K/V at full head count — at
+    # TinyLlama geometry (32/4 heads) the repeat inflated per-step K/V
+    # traffic 8×, and it grew linearly with cache length.
+    group = nh // nkv
+    q5 = q.reshape(B, S, nkv, group, hd)
+    scores = jnp.einsum("bsngd,btnd->bngst", q5,
+                        k_all.astype(q.dtype)) / math.sqrt(hd)
     # causality runs over CACHE indices (where K/V physically live), not
     # logical positions — they differ for padded rows; padding slots are
-    # excluded via kv_valid.
-    kv_pos = jnp.arange(T)[None, None, None, :]
-    q_cache_pos = (start + jnp.arange(S))[None, None, :, None]
+    # excluded via kv_valid. Shapes broadcast over [B, nkv, group, S, T].
+    kv_pos = jnp.arange(T)[None, None, None, None, :]
+    q_cache_pos = (start + jnp.arange(S))[None, None, None, :, None]
     valid = (kv_pos <= q_cache_pos) & (kv_pos < (start + S))
     if kv_valid is not None:
-        valid = valid & kv_valid[:, None, None, :]
+        valid = valid & kv_valid[:, None, None, None, :]
     if x.dtype == jnp.bfloat16:
         # softmax in bf16, same rationale as models/bert.py attention: the
         # f32 round-trip doubles the [B, nh, S, T] intermediate's HBM
@@ -207,7 +215,8 @@ def _attn(
     else:
         scores = jnp.where(valid, scores.astype(jnp.float32), -1e9)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(x.dtype)).reshape(B, S, H)
+    ctx = jnp.einsum("bngst,btnd->bsngd", probs,
+                     v_all.astype(x.dtype)).reshape(B, S, H)
     out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
     return out, new_cache
 

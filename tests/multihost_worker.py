@@ -124,50 +124,63 @@ def _make_batch(mesh, cfg, B: int, S: int = 16):
 
 
 def _run_tp(mesh, cfg, n_global: int, n_local: int, procs: int) -> None:
-    """Cross-host tensor parallelism: params megatron-sharded over the
-    'tensor' axis (which pairs devices ACROSS the two processes), then one
-    FULL train step — forward, backward, AdamW update — so every TP
-    collective and the sharded optimizer update cross the process
-    boundary."""
-    from functools import partial
-
+    """Cross-host tensor parallelism through the PRODUCTION train step:
+    a TrainState born TP-sharded (params megatron-split over the 'tensor'
+    axis that pairs devices ACROSS the two processes, AdamW mu/nu mirroring
+    the param shardings), driven through trainer.lm_train_step — so the
+    exact code a real deployment runs does its forward, backward, and
+    optimizer update across the host boundary."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from symbiont_tpu.models import gpt as gpt_mod
     from symbiont_tpu.parallel.sharding import gpt_param_sharding
-    from symbiont_tpu.train.trainer import _adamw, lm_loss
+    from symbiont_tpu.train.trainer import TrainState, _adamw, lm_train_step
 
+    tx = _adamw(1e-3)
+    rep = NamedSharding(mesh, P())
     template = jax.eval_shape(lambda k: gpt_mod.init_params(k, cfg),
                               jax.random.key(0))
     spec = gpt_param_sharding(mesh, template, arch="llama")
-    out_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), spec,
-                          is_leaf=lambda x: isinstance(x, P))
-    params = jax.jit(lambda k: gpt_mod.init_params(k, cfg),
-                     out_shardings=out_sh)(jax.random.key(0))
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), spec,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    # optimizer-state shardings mirror the params (adam mu/nu share the
+    # param tree structure; counts and other scalars replicate)
+    def opt_sharding(os_shape):
+        if isinstance(os_shape, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(count=rep, mu=param_sh, nu=param_sh)
+        return jax.tree.map(lambda _: rep, os_shape)
+
+    opt_shape = jax.eval_shape(tx.init, template)
+    state_sh = TrainState(param_sh,
+                          tuple(opt_sharding(s) for s in opt_shape), rep)
+
+    def init_state(key):
+        params = gpt_mod.init_params(key, cfg)
+        return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+    state = jax.jit(init_state, out_shardings=state_sh)(jax.random.key(0))
     # q kernels really live split over the cross-host tensor axis
-    assert "tensor" in str(params["layers"][0]["q"]["kernel"].sharding.spec)
+    assert "tensor" in str(
+        state.params["layers"][0]["q"]["kernel"].sharding.spec)
 
     batch, total = _make_batch(mesh, cfg, B=mesh.shape["data"])
 
-    @partial(jax.jit, static_argnums=(2,))
-    def train_step(params, batch, cfg):
-        # optimizer state created under jit so XLA propagates the TP
-        # shardings into mu/nu — the sharded-update path is exercised too
-        tx = _adamw(1e-3)
-        opt_state = tx.init(params)
-        loss, grads = jax.value_and_grad(lm_loss)(params, batch, cfg)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return loss, optax.apply_updates(params, updates)
-
-    loss, new_params = train_step(params, batch, cfg)
-    loss = float(loss.addressable_shards[0].data)
+    # ONE production train step: every TP collective and the sharded AdamW
+    # update cross the process boundary
+    state, metrics = lm_train_step(state, batch, cfg, tx)
+    loss = float(metrics["loss"].addressable_shards[0].data)
     assert np.isfinite(loss), loss
+    gnorm = float(metrics["grad_norm"].addressable_shards[0].data)
+    assert np.isfinite(gnorm) and gnorm > 0, gnorm
+    assert int(state.step.addressable_shards[0].data) == 1
     # updated params kept the TP sharding through the optimizer update
     assert "tensor" in str(
-        new_params["layers"][0]["q"]["kernel"].sharding.spec)
+        state.params["layers"][0]["q"]["kernel"].sharding.spec)
 
     print(f"MULTIHOST ok global={n_global} local={n_local} procs={procs} "
           f"loss={loss:.6f} sum={total}", flush=True)
