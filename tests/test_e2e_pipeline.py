@@ -79,7 +79,9 @@ def _http(method, port, path, body=None, timeout=10):
         return e.code, json.loads(e.read() or b"{}")
 
 
-async def _wait_until(pred, timeout=15.0):
+async def _wait_until(pred, timeout=90.0):
+    # generous default: the first embed compiles its executables, which can
+    # take tens of seconds when the whole suite loads the machine
     t = 0.0
     while t < timeout:
         if pred():
