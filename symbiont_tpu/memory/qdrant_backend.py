@@ -110,6 +110,11 @@ class QdrantStore:
     UPSERT_CHUNK = 512
 
     def upsert(self, points: Sequence[Tuple[str, Sequence[float], dict]]) -> int:
+        """Chunked wait=true upsert. NOT atomic across chunks: a hard failure
+        on chunk i>0 raises after earlier chunks committed (the raised
+        HTTPError/URLError carries `.points_committed` with how many points
+        landed). Safe to retry the WHOLE call: point ids are deterministic,
+        so re-upserting committed chunks is idempotent overwriting."""
         if not points:
             return 0
         for i in range(0, len(points), self.UPSERT_CHUNK):
@@ -117,9 +122,13 @@ class QdrantStore:
             body = {"points": [{"id": pid, "vector": [float(x) for x in vec],
                                 "payload": payload}
                                for pid, vec, payload in chunk]}
-            self._call("PUT",
-                       f"/collections/{self.collection}/points?wait=true",
-                       body)
+            try:
+                self._call("PUT",
+                           f"/collections/{self.collection}/points?wait=true",
+                           body)
+            except Exception as e:
+                e.points_committed = i  # partial-commit marker for callers
+                raise
         return len(points)
 
     def search(self, query: Sequence[float], top_k: int) -> List[SearchHit]:

@@ -46,7 +46,7 @@ class TextGeneratorService(Service):
     def __init__(self, bus, lm_generate=None, lm_batcher=None, lm_stream=None,
                  train_on_ingest: bool = True, state_path=None,
                  lm_trainer=None, lm_train_min_chars: int = 512,
-                 lm_train_steps: int = 2):
+                 lm_train_steps: int = 2, lm_buffer_max_chars: int = 1 << 20):
         super().__init__(bus)
         # persistence (SURVEY.md §5.4): restore the learned chain; the
         # reference rebuilds from one constant at every boot (main.rs:169-173)
@@ -76,6 +76,10 @@ class TextGeneratorService(Service):
         self.lm_trainer = lm_trainer
         self._lm_train_min_chars = lm_train_min_chars
         self._lm_train_steps = lm_train_steps
+        # bounded backlog: if ingest sustainedly outruns device training the
+        # buffer drops OLDEST docs past this budget (counted in metrics)
+        # instead of growing host memory without limit
+        self._lm_buffer_max_chars = lm_buffer_max_chars
         self._lm_buffer: list = []
         self._lm_buffer_chars = 0
         self._lm_train_lock = asyncio.Lock()
@@ -101,6 +105,13 @@ class TextGeneratorService(Service):
         if self.lm_trainer is not None:
             self._lm_buffer.append(raw.raw_text)
             self._lm_buffer_chars += len(raw.raw_text)
+            while (self._lm_buffer_chars > self._lm_buffer_max_chars
+                   and len(self._lm_buffer) > 1):
+                dropped = self._lm_buffer.pop(0)
+                self._lm_buffer_chars -= len(dropped)
+                metrics.inc("text_generator.lm_train_dropped_docs")
+                metrics.inc("text_generator.lm_train_dropped_chars",
+                            len(dropped))
             # fire-and-forget: the handler must NOT await the pass — parked
             # handler tasks would exhaust the service's handler semaphore and
             # stall every subscription (incl. generation requests) behind a
@@ -111,6 +122,19 @@ class TextGeneratorService(Service):
                     and not self._lm_train_lock.locked()):
                 self._lm_train_task = asyncio.create_task(
                     self._lm_train_pass(), name="lm-ingest-train")
+                # fire-and-forget tasks swallow exceptions unless retrieved;
+                # log every pass's failure the moment it happens instead of
+                # staying silent until the threshold next crosses
+                self._lm_train_task.add_done_callback(self._log_train_failure)
+
+    @staticmethod
+    def _log_train_failure(task: "asyncio.Task") -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            log.error("online LM fine-tune pass failed", exc_info=exc)
+            metrics.inc("text_generator.lm_train_failures")
 
     async def _lm_train_pass(self) -> None:
         """Drain buffered ingest through fine-tune passes, off the event
@@ -135,8 +159,13 @@ class TextGeneratorService(Service):
         await self._maybe_save(force=True)  # flush unsaved learning
         if self._lm_train_task is not None and not self._lm_train_task.done():
             # let an in-flight fine-tune pass finish (it persists its own
-            # state); buffered-but-untrained text is the only loss on stop
-            await self._lm_train_task
+            # state); buffered-but-untrained text is the only loss on stop.
+            # A failing pass must not abort shutdown — the done-callback
+            # already logged it with traceback; just swallow here.
+            try:
+                await self._lm_train_task
+            except Exception:
+                pass
 
     # ------------------------------------------------- markov persistence
 

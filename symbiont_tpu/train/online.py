@@ -54,11 +54,12 @@ class OnlineLmTrainer:
         self.state_path = state_path
         self._lock = threading.Lock()
         # token stream carried between passes: text beyond what one pass
-        # consumes is TRAINED LATER, never silently dropped
+        # consumes is trained later, up to MAX_PENDING_BATCHES of backlog
+        # (beyond that, oldest tokens drop and stats["tokens_dropped"] counts)
         self._stream: list = []
         self.stats = {"train_steps": 0, "train_docs": 0, "last_loss": None,
                       "param_syncs": 0, "batches_trained": 0,
-                      "tokens_pending": 0}
+                      "tokens_pending": 0, "tokens_dropped": 0}
 
         # private copy: lm_train_step donates state, so training must never
         # share buffers with the serving engine's live params
@@ -86,12 +87,20 @@ class OnlineLmTrainer:
     # one giant ingest burst can't monopolize the device)
     MAX_BATCHES_PER_PASS = 16
 
+    # the carried stream is bounded too: when ingest sustainedly outruns
+    # training throughput, tokens past this many batches' worth are dropped
+    # OLDEST-first (counted in stats) — recent text wins, host memory stays
+    # flat. MAX_BATCHES_PER_PASS bounds pass latency; this bounds backlog.
+    MAX_PENDING_BATCHES = 64
+
     def _take_batches(self, texts: Sequence[str]):
         """Tokenize texts (BOS-separated) into the carried token stream,
         then drain as many full [batch_size, seq_len] batches as available
         (≤ MAX_BATCHES_PER_PASS). Leftover tokens stay in the stream for the
-        NEXT pass — nothing is dropped. A stream too short for one full
-        batch is cycled to fill it (short corpora still train)."""
+        NEXT pass, bounded at MAX_PENDING_BATCHES batches' worth — past that,
+        oldest tokens drop (counted in stats["tokens_dropped"]). A stream too
+        short for one full batch is cycled to fill it (short corpora still
+        train)."""
         import jax.numpy as jnp
 
         tok = self.lm.tokenizer
@@ -101,6 +110,13 @@ class OnlineLmTrainer:
             if ids:
                 self._stream.extend(ids if ids[0] == bos else [bos] + ids)
         need = self.batch_size * self.seq_len
+        cap = need * self.MAX_PENDING_BATCHES
+        if len(self._stream) > cap:
+            drop = len(self._stream) - cap
+            del self._stream[:drop]  # oldest first: recent context wins
+            self.stats["tokens_dropped"] += drop
+            log.warning("online LM backlog over %d tokens; dropped %d oldest",
+                        cap, drop)
         chunks: list = []
         while len(self._stream) >= need and len(chunks) < self.MAX_BATCHES_PER_PASS:
             chunks.append(self._stream[:need])

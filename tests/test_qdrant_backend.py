@@ -51,6 +51,11 @@ class _FakeQdrant(BaseHTTPRequestHandler):
             self._reply(200, {"result": True, "status": "ok"})
             return
         if len(parts) == 3 and parts[2] == "points":
+            if s.get("fail_upserts_after_requests", -1) == 0:
+                self._reply(500, {"status": {"error": "injected failure"}})
+                return
+            if "fail_upserts_after_requests" in s:
+                s["fail_upserts_after_requests"] -= 1
             col = s["collections"][parts[1]]
             for p in self._body()["points"]:
                 vec = np.asarray(p["vector"], np.float32)
@@ -127,6 +132,27 @@ def test_ensure_upsert_search_count(fake_qdrant):
     assert hits[0].payload["sentence_text"] == "s3"
     assert len(hits) == 2
     assert store.search(vecs[0], 0) == []
+
+
+def test_upsert_partial_commit_marker(fake_qdrant, monkeypatch):
+    """Chunked upsert is not atomic: a failure on chunk i>0 raises with
+    .points_committed = how many points landed before it (documented
+    partial-commit contract; retries are idempotent by id)."""
+    uri, state = fake_qdrant
+    store = QdrantStore(_cfg(uri), retries=2, retry_delay_s=0.05)
+    store.ensure_collection()
+    monkeypatch.setattr(QdrantStore, "UPSERT_CHUNK", 2)
+    rng = np.random.default_rng(1)
+    vecs = rng.normal(size=(5, 8)).astype(np.float32)
+    state["fail_upserts_after_requests"] = 1  # chunk 0 lands, chunk 1 fails
+    with pytest.raises(Exception) as ei:
+        store.upsert([(f"q{i}", vecs[i], {}) for i in range(5)])
+    assert ei.value.points_committed == 2
+    assert state["collections"]["symbiont_test"]["points"].keys() >= {"q0", "q1"}
+    del state["fail_upserts_after_requests"]
+    # whole-call retry overwrites committed points idempotently
+    assert store.upsert([(f"q{i}", vecs[i], {}) for i in range(5)]) == 5
+    assert store.count() == 5
 
 
 def test_connect_retry_then_fail():
