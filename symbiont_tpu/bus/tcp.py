@@ -76,6 +76,14 @@ class TcpBus:
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port)
+        # request-reply latency rides small writes: without TCP_NODELAY,
+        # Nagle + delayed ACK stacks ~40ms per reply hop (the native broker
+        # and C++ clients already set it — client.hpp:71, broker.cpp:398)
+        import socket as _socket
+
+        sock = self._writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         self._read_task = asyncio.create_task(self._read_loop(),
                                               name="symbus-read")
 

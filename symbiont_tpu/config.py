@@ -68,6 +68,12 @@ class EngineConfig:
     flush_deadline_ms: float = 5.0
     data_parallel: bool = True  # shard batches across the mesh 'data' axis
     executable_cache_size: int = 64
+    # Bulk-ingest host pipeline: embed_texts tokenizes this many texts per
+    # chunk on a background thread while the main thread pads/dispatches the
+    # previous chunk (two-deep prep queue) — host prep of chunk N+1 overlaps
+    # device compute + transfers of chunk N. 0 disables chunking (tokenize
+    # everything up front, the pre-r4 behavior).
+    host_prep_chunk: int = 2048
     # Cross-encoder rerank (BASELINE.md config #4: ms-marco-MiniLM-L-6 on
     # top-k hits). cross_model_dir points at a converted checkpoint;
     # rerank_enabled without a dir runs a synthetic cross-encoder (random
@@ -103,6 +109,11 @@ class LmConfig:
     # flush window decode as one batched call (engine/batcher.GenBatcher)
     gen_max_batch: int = 8
     gen_flush_deadline_ms: float = 10.0
+    # continuous batching: a decode session keeps at least this many batch
+    # rows so requests arriving mid-decode can JOIN at chunk boundaries
+    # (BatchSession.admit). Nearly free on TPU — decode steps are bound by
+    # weight reads, which all rows share.
+    session_min_rows: int = 4
     # token streaming (events.text.generated.partial): decode in chunks of
     # this many tokens, emitting a text delta per chunk; 0 disables streaming
     stream_chunk: int = 16

@@ -26,11 +26,16 @@ def choose_bucket(length: int, buckets: Sequence[int]) -> int:
 
 
 def pad_to_bucket(
-    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int
+    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int,
+    dtype=np.int32,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad a list of token-id sequences to [n, bucket] ids + mask (int32)."""
+    """Pad a list of token-id sequences to [n, bucket] ids + mask.
+
+    `dtype` lets callers ship ids in the narrowest dtype the vocab allows
+    (uint16 when vocab ≤ 65535) — halves h2d bytes; the device executable
+    casts back to int32."""
     n = len(seqs)
-    ids = np.full((n, bucket), pad_id, np.int32)
+    ids = np.full((n, bucket), pad_id, dtype)
     mask = np.zeros((n, bucket), np.int32)
     for i, s in enumerate(seqs):
         s = list(s[:bucket])
@@ -53,16 +58,18 @@ def pad_batch_rows(
 
 
 def pad_ids_rows(
-    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int
+    seqs: Sequence[Sequence[int]], bucket: int, pad_id: int,
+    dtype=np.int32,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Pad token-id sequences to [n, bucket] ids + true lengths [n].
 
     The attention mask is NOT materialized on host: the device executable
     rebuilds it as `arange(bucket) < lengths[:, None]`, halving the
     host→device bytes vs shipping an explicit [n, bucket] mask — on a
-    network-attached chip h2d bandwidth is part of the ingest wall."""
+    network-attached chip h2d bandwidth is part of the ingest wall.
+    `dtype` further narrows the wire: uint16 ids when the vocab fits."""
     n = len(seqs)
-    ids = np.full((n, bucket), pad_id, np.int32)
+    ids = np.full((n, bucket), pad_id, dtype)
     lengths = np.zeros((n,), np.int32)
     for i, s in enumerate(seqs):
         s = list(s[:bucket])

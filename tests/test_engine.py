@@ -319,3 +319,79 @@ def test_cold_executable_race_compiles_once():
     # both calls hit one (bucket, batch-bucket) shape → exactly one compile
     assert eng.stats["compiles"] == 1
     assert len(eng._exec_cache) == 1
+
+
+# ------------------------------------------------- ingest host pipeline (r4)
+
+def test_embed_texts_chunked_pipeline_matches_unchunked():
+    """host_prep_chunk splits tokenization into prefetched chunks; results
+    (and their row order) must be identical to the single-pass path."""
+    texts = [f"sentence {i} " + "pad " * (i % 13) for i in range(30)]
+    base = _small_engine().embed_texts(texts)
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[2, 4], max_batch=4, dtype="float32",
+                       data_parallel=False, host_prep_chunk=7)
+    np.testing.assert_allclose(TpuEngine(cfg).embed_texts(texts), base,
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_embed_texts_prefetch_overlaps_dispatch():
+    """Tokenize of chunk N+1 must run CONCURRENTLY with dispatch of chunk N:
+    the gated tokenizer blocks chunk 2's encode until chunk 1 has dispatched,
+    so a serial implementation (encode everything, then dispatch) times out."""
+    import threading
+
+    from symbiont_tpu.engine.tokenizer import HashTokenizer
+
+    dispatched = threading.Event()
+
+    class GatedTok(HashTokenizer):
+        def __init__(self):
+            super().__init__(30000)
+            self.calls = 0
+
+        def encode_batch(self, texts, max_len):
+            self.calls += 1
+            if self.calls == 2:  # chunk 2 rides the prefetch thread
+                assert dispatched.wait(10), \
+                    "chunk-2 tokenize did not overlap chunk-1 dispatch"
+            return super().encode_batch(texts, max_len)
+
+    tok = GatedTok()
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[2, 4], max_batch=4, dtype="float32",
+                       data_parallel=False, host_prep_chunk=4)
+    eng = TpuEngine(cfg, tokenizer=tok)
+    orig = eng._dispatch_embed
+
+    def wrapped(encoded, offset, buckets, pending):
+        orig(encoded, offset, buckets, pending)
+        dispatched.set()
+
+    eng._dispatch_embed = wrapped
+    out = eng.embed_texts([f"t {i} " + "w " * (i % 10) for i in range(10)])
+    assert out.shape == (10, 32)
+    assert tok.calls == 3  # 10 texts / chunk 4
+
+
+def test_ids_ship_narrow_dtype_same_result():
+    """Vocab ≤ 65535 ships uint16 ids over the wire (half the h2d bytes);
+    embeddings must match the int32 wire bit-for-bit in float32."""
+    eng = _small_engine()
+    assert eng._ids_dtype == np.uint16  # synthetic vocab 30000 fits
+    texts = ["alpha beta gamma", "delta " * 5, "x"]
+    narrow = eng.embed_texts(texts)
+    eng32 = _small_engine()
+    eng32._ids_dtype = np.int32
+    np.testing.assert_allclose(eng32.embed_texts(texts), narrow,
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_concat_fetch_groups_match(monkeypatch):
+    """Grouped single-copy fetch (CONCAT_FETCH_MAX) must scatter rows
+    identically to the per-batch path across group boundaries."""
+    texts = [f"g {i} " + "w " * (i % 11) for i in range(26)]
+    base = _small_engine().embed_texts(texts)
+    monkeypatch.setattr(TpuEngine, "CONCAT_FETCH_MAX", 2)
+    np.testing.assert_allclose(_small_engine().embed_texts(texts), base,
+                               atol=1e-4, rtol=1e-3)
