@@ -166,12 +166,19 @@ class _PendingGen:
 
 
 class GenBatcher(_BatcherBase):
-    """Micro-batching for autoregressive generation (the LmEngine analog of
-    MicroBatcher). Requests group by new-token bucket only (an executable is
-    specialized on max_new); per-request temperature/top_k ride as per-row
-    traced vectors inside one shared decode, so mixed-sampling requests
-    still batch together. Per-request overrides default to the engine
-    config."""
+    """Continuous batching for autoregressive generation.
+
+    Requests that arrive within one flush window start a decode SESSION
+    together (LmEngine.start_session); the session decodes in chunks, and at
+    every chunk boundary newly-queued requests JOIN the in-flight decode in
+    free batch rows (row padding from the power-of-two bucket, or rows whose
+    request already finished) — a request that misses the window no longer
+    waits behind the whole decode (VERDICT r3 item 3). Per-request
+    temperature/top_k ride as per-row traced vectors; requests group by
+    new-token bucket; a newcomer is admitted when a slot is free, its budget
+    fits the session's remaining steps, and its prompt fits the session's
+    prompt bucket (LmEngine.BatchSession.can_admit) — otherwise it waits for
+    the next session."""
 
     def __init__(self, lm, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None):
@@ -179,6 +186,7 @@ class GenBatcher(_BatcherBase):
                     else lm.config.gen_flush_deadline_ms) / 1000.0
         super().__init__(max_batch or lm.config.gen_max_batch, deadline)
         self.lm = lm
+        self.stats = {"sessions": 0, "admitted_midflight": 0}
 
     async def generate(self, prompt: str, max_new_tokens: int,
                        temperature: Optional[float] = None,
@@ -200,22 +208,78 @@ class GenBatcher(_BatcherBase):
                 return b
         return self.lm.config.new_token_buckets[-1]
 
+    def _admit_and_step(self, sess, candidates: List):
+        """Executor-side chunk turn: filter + admit what fits, then decode
+        one chunk. Runs OFF the event loop — can_admit tokenizes, admit does
+        a device prefill + merge (compiles on first shape), and step scans a
+        chunk; none of that may stall the loop that feeds the bus. Returns
+        (kept_candidates, admitted [(tag, item)], finished [(tag, text)])."""
+        take: List = []
+        keep: List = []
+        for item in candidates:
+            if (len(take) < sess.capacity()
+                    and sess.can_admit(item.prompt, item.max_new)):
+                take.append(item)
+            else:
+                keep.append(item)
+        admitted: List = []
+        if take:
+            tags = sess.admit([p.prompt for p in take],
+                              [p.max_new for p in take],
+                              temperature=[p.temperature for p in take],
+                              top_k=[p.top_k for p in take])
+            admitted = list(zip(tags, take))
+        return keep, admitted, sess.step()
+
     async def _flush(self, batch: List) -> None:
+        loop = asyncio.get_running_loop()
         groups: dict = {}
         for p in batch:
             groups.setdefault(self._bucket(p.max_new), []).append(p)
         for group in groups.values():
+            # every request that ever joins this session; on session failure
+            # each unresolved future gets the exception (a silently dropped
+            # future would hang its caller forever)
+            participants: List = list(group)
+            by_tag: dict = {}
             try:
-                texts = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda g=group: self.lm.generate_batch(
+                sess = await loop.run_in_executor(
+                    None, lambda g=group: self.lm.start_session(
                         [p.prompt for p in g], [p.max_new for p in g],
                         temperature=[p.temperature for p in g],
                         top_k=[p.top_k for p in g]))
-                for p, text in zip(group, texts):
-                    if not p.future.cancelled():
-                        p.future.set_result(text)
+                self.stats["sessions"] += 1
+                for tag, p in zip((r.tag for r in sess.rows if r is not None),
+                                  group):
+                    by_tag[tag] = p
+                while True:
+                    # snapshot the queue on the loop; hand the blocking work
+                    # (tokenize/prefill/merge/decode) to the executor; then
+                    # re-queue what wasn't admitted
+                    candidates: List = []
+                    if self._queue and sess.capacity() > 0:
+                        candidates = list(self._queue)
+                        self._queue.clear()
+                        self._queued -= sum(self._size(c) for c in candidates)
+                    keep, admitted, finished = await loop.run_in_executor(
+                        None, self._admit_and_step, sess, candidates)
+                    if keep:
+                        # ahead of anything submitted while we decoded:
+                        # preserve arrival order
+                        self._queue[:0] = keep
+                        self._queued += sum(self._size(k) for k in keep)
+                    for tag, p in admitted:
+                        by_tag[tag] = p
+                        participants.append(p)
+                    self.stats["admitted_midflight"] += len(admitted)
+                    for tag, text in finished:
+                        p = by_tag.pop(tag)
+                        if not p.future.cancelled():
+                            p.future.set_result(text)
+                    if sess.done() and not by_tag:
+                        break
             except Exception as e:
-                log.exception("batch generate failed")
-                for p in group:
-                    if not p.future.cancelled():
+                log.exception("batch generate session failed")
+                for p in participants:
+                    if not p.future.done():
                         p.future.set_exception(e)

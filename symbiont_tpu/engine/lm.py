@@ -198,11 +198,13 @@ class LmEngine:
 
     # ------------------------------------------------------------------ gen
 
-    def _prepare_prompts(self, prompts: Sequence[str], max_new: int):
+    def _prepare_prompts(self, prompts: Sequence[str], max_new: int,
+                         min_rows: int = 1):
         """Shared decode preamble: pick the new-token bucket, validate it
         fits, encode prompts (tail-trim to the largest usable prompt bucket,
         BOS fallback for empty), pad to a power-of-two batch bucket so the
-        executable count stays log-bounded. Returns
+        executable count stays log-bounded (≥ min_rows — sessions reserve
+        headroom rows for mid-decode admission). Returns
         (prompt_ids [bb, P], prompt_mask [bb, P], new_bucket)."""
         cfg = self.config
         new_bucket = _round_up(max_new, cfg.new_token_buckets)
@@ -223,6 +225,8 @@ class LmEngine:
             encoded.append(ids)
         B = len(encoded)
         bb = 1 << (B - 1).bit_length() if B > 1 else 1
+        if min_rows > 1:
+            bb = max(bb, 1 << (min_rows - 1).bit_length())
         P = _round_up(max(len(e) for e in encoded), avail)
         pad = getattr(self.tokenizer, "pad_id", 0)
         bos = getattr(self.tokenizer, "bos_id", 0)
@@ -392,6 +396,17 @@ class LmEngine:
                 self.stats["tokens_generated"] += len(all_tokens)
                 self.stats["decode_s"] += decode_s
 
+    # ----------------------------------------------------- continuous batch
+
+    def start_session(self, prompts: Sequence[str],
+                      max_new_tokens: Sequence[int],
+                      temperature=None, top_k=None) -> "BatchSession":
+        """Open a chunked batch decode that new requests can JOIN at chunk
+        boundaries (continuous batching — the GenBatcher upgrade over
+        flush-window-only batching; VERDICT r3 item 3). Drive it with
+        session.step(); admit newcomers with session.admit()."""
+        return BatchSession(self, prompts, max_new_tokens, temperature, top_k)
+
     def update_params(self, params) -> None:
         """Swap in new model parameters (online fine-tune sync,
         train/online.py). Serialized on the engine lock so no decode is
@@ -408,3 +423,201 @@ class LmEngine:
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""
         self.generate("warmup", new_bucket or self.config.new_token_buckets[0])
+
+
+class _SessionRow:
+    __slots__ = ("tag", "want", "tokens")
+
+    def __init__(self, tag: int, want: int):
+        self.tag = tag
+        self.want = want
+        self.tokens: list = []
+
+
+class BatchSession:
+    """An in-flight chunked batch decode that requests can JOIN at chunk
+    boundaries (continuous batching).
+
+    GenBatcher's flush-window batching only merged requests that arrived
+    within one deadline window; everything else serialized behind the whole
+    decode. A session decodes in stream_chunk-step chunks and, between
+    chunks, splices newly-prefilled rows into free slots (row-padding from
+    the power-of-two batch bucket, or rows that already finished) via
+    gpt.merge_rows — an admitted request's output is EXACTLY what a
+    standalone decode would produce (gap cache slots masked, logical
+    positions carried; asserted in tests/test_lm_engine.py).
+
+    Threading: device work runs under the engine lock; host bookkeeping is
+    single-caller (GenBatcher interleaves admit()/step() sequentially).
+    """
+
+    def __init__(self, lm: LmEngine, prompts: Sequence[str],
+                 max_new_tokens: Sequence[int], temperature=None,
+                 top_k=None):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = lm.config
+        self.lm = lm
+        n = len(prompts)
+        if n != len(max_new_tokens):
+            raise ValueError("prompts and max_new_tokens length mismatch")
+        prompt_ids, prompt_mask, self.new_bucket = lm._prepare_prompts(
+            prompts, max(max_new_tokens), min_rows=cfg.session_min_rows)
+        self.bb, self.P = prompt_ids.shape
+        self.chunk = max(1, min(cfg.stream_chunk, self.new_bucket))
+        self._temps = lm._norm_sampling_rows(temperature, cfg.temperature,
+                                             self.bb, n, float)
+        self._ks = lm._norm_sampling_rows(top_k, cfg.top_k, self.bb, n, int)
+        self._eos = int(getattr(lm.tokenizer, "eos_id", -1))
+        self._next_tag = 0
+        self.rows: list = []
+        for w in max_new_tokens:
+            self.rows.append(_SessionRow(self._next_tag,
+                                         min(int(w), self.new_bucket)))
+            self._next_tag += 1
+        self.rows += [None] * (self.bb - n)  # free slots from the row bucket
+        self.steps_done = 0
+        self.decode_s = 0.0
+        with lm._lock:
+            lm._key, self._sub = jax.random.split(lm._key)
+            t0 = time.perf_counter()
+            (self._cache, self._logits, self._kv_valid,
+             prompt_len) = gpt_mod.prefill(
+                lm.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
+                lm.model_cfg, self.new_bucket)
+            self.decode_s += time.perf_counter() - t0
+            lm.stats["sessions"] = lm.stats.get("sessions", 0) + 1
+        self._pos = prompt_len
+        self._done = jnp.zeros((self.bb,), bool)
+
+    # ------------------------------------------------------------ admission
+
+    def capacity(self) -> int:
+        return sum(1 for r in self.rows if r is None)
+
+    def remaining_steps(self) -> int:
+        return self.new_bucket - self.steps_done
+
+    def done(self) -> bool:
+        return all(r is None for r in self.rows) or self.remaining_steps() <= 0
+
+    def can_admit(self, prompt: str, max_new: int) -> bool:
+        """A newcomer joins only if a row slot is free, its budget fits the
+        steps this session still has, and its prompt fits the session's
+        prompt bucket untrimmed (a longer prompt would lose more context
+        than a standalone decode — leave it for the next session)."""
+        if self.capacity() == 0 or int(max_new) > self.remaining_steps():
+            return False
+        return len(self.lm.tokenizer.encode(prompt or "", self.P + 1)) <= self.P
+
+    def admit(self, prompts: Sequence[str], max_new_tokens: Sequence[int],
+              temperature=None, top_k=None) -> list:
+        """Prefill the newcomers and splice them into free rows at the
+        current chunk boundary. Caller pre-filters with can_admit. Returns
+        the tags identifying each admitted request in step() results."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.lm.config
+        free = [i for i, r in enumerate(self.rows) if r is None]
+        k = len(prompts)
+        assert k <= len(free), "admit() beyond capacity()"
+        bb2 = 1 << (k - 1).bit_length() if k > 1 else 1
+        pad = getattr(self.lm.tokenizer, "pad_id", 0)
+        bos = getattr(self.lm.tokenizer, "bos_id", 0)
+        ids = np.full((bb2, self.P), pad, np.int32)
+        mask = np.zeros((bb2, self.P), np.int32)
+        for j, prompt in enumerate(prompts):
+            enc = self.lm.tokenizer.encode(prompt or "", 1 << 30)[-self.P:]
+            if not enc:
+                enc = [bos]
+            ids[j, :len(enc)] = enc
+            mask[j, :len(enc)] = 1
+        for j in range(k, bb2):
+            ids[j, 0] = bos
+            mask[j, 0] = 1
+        temps2 = self.lm._norm_sampling_rows(temperature, cfg.temperature,
+                                             bb2, k, float)
+        ks2 = self.lm._norm_sampling_rows(top_k, cfg.top_k, bb2, k, int)
+        row_map = np.full((self.bb,), -1, np.int32)
+        tags = []
+        for j in range(k):
+            i = free[j]
+            row_map[i] = j
+            self.rows[i] = _SessionRow(self._next_tag,
+                                       min(int(max_new_tokens[j]),
+                                           self.remaining_steps()))
+            tags.append(self._next_tag)
+            self._next_tag += 1
+            self._temps[i] = temps2[j]
+            self._ks[i] = ks2[j]
+        with self.lm._lock:
+            t0 = time.perf_counter()
+            (cache_b, logits_b, kv_valid_b, pos_b) = gpt_mod.prefill(
+                self.lm.params, jnp.asarray(ids), jnp.asarray(mask),
+                self.lm.model_cfg, self.new_bucket)
+            done_b = jnp.zeros((bb2,), bool)
+            (self._cache, self._logits, self._pos, self._done,
+             self._kv_valid) = gpt_mod.merge_rows(
+                self._cache, self._logits, self._pos, self._done,
+                self._kv_valid, cache_b, logits_b, pos_b, done_b, kv_valid_b,
+                jnp.asarray(row_map), prompt_width=self.P)
+            self.decode_s += time.perf_counter() - t0
+            self.lm.stats["admitted"] = self.lm.stats.get("admitted", 0) + k
+        return tags
+
+    # --------------------------------------------------------------- decode
+
+    def step(self) -> list:
+        """Decode one chunk; returns [(tag, text), ...] for every request
+        that finished in it (eos, its own budget, or the session cap)."""
+        import jax
+
+        if self.done():
+            return self._drain_all()
+        chunk = min(self.chunk, self.remaining_steps())
+        with self.lm._lock:
+            t0 = time.perf_counter()
+            self._sub, use = jax.random.split(self._sub)
+            keys = jax.random.split(use, chunk)
+            (self._cache, self._logits, self._pos, self._done, toks,
+             counted) = gpt_mod.decode_chunk(
+                self.lm.params, self._cache, self._logits, self._pos,
+                self._done, self._kv_valid, keys, self.lm.model_cfg,
+                temperature=self._temps, top_k=self._ks, eos_id=self._eos)
+            toks = np.asarray(toks)
+            counted = np.asarray(counted)
+            self.decode_s += time.perf_counter() - t0
+        self.steps_done += chunk
+        finished = []
+        for i, row in enumerate(self.rows):
+            if row is None:
+                continue
+            hit_eos = False
+            for t, c in zip(toks[i], counted[i]):
+                if not c:  # EOS (or a post-EOS slot)
+                    hit_eos = True
+                    break
+                row.tokens.append(int(t))
+                if len(row.tokens) >= row.want:
+                    break
+            if hit_eos or len(row.tokens) >= row.want:
+                finished.append(self._finish(i))
+        if self.remaining_steps() <= 0:
+            finished += self._drain_all()
+        return finished
+
+    def _finish(self, i: int):
+        row = self.rows[i]
+        self.rows[i] = None
+        with self.lm._lock:
+            self.lm.stats["generate_calls"] += 1
+            self.lm.stats["tokens_generated"] += len(row.tokens)
+            self.lm.stats["decode_s"] += self.decode_s
+            self.decode_s = 0.0
+        return (row.tag, self.lm.tokenizer.decode(row.tokens))
+
+    def _drain_all(self) -> list:
+        return [self._finish(i) for i, r in enumerate(self.rows)
+                if r is not None]

@@ -452,6 +452,46 @@ def decode_chunk(params, cache, cur_logits, cur_pos, done, kv_valid, keys,
         t, k, cfg, top_k_bucket=bucket, eos_id=eos_id)
 
 
+@partial(jax.jit, static_argnames=("prompt_width",))
+def merge_rows(cache_a, logits_a, pos_a, done_a, kv_valid_a,
+               cache_b, logits_b, pos_b, done_b, kv_valid_b,
+               row_map, prompt_width: int):
+    """Continuous batching: splice freshly-prefilled rows (state b) into an
+    in-flight chunked decode (state a) at a chunk boundary.
+
+    row_map [B] int32: row_map[i] = j ≥ 0 replaces a's row i with b's row j;
+    -1 keeps a's row. Both states must share the cache layout (same
+    prompt_width bucket and new-token bucket, so T matches). The spliced
+    rows' cache slots [prompt_width, a.length) — the steps a decoded before
+    admission — are masked invalid: the row's own decode continues at cache
+    slot a.length while its logical position carries on from its prompt, so
+    its output is EXACTLY what a standalone decode would produce (the same
+    right-alignment independence generate() guarantees across batchmates).
+
+    One compiled executable per (shapes, prompt_width); the row pattern is
+    traced, so which rows get replaced never recompiles."""
+    B = logits_a.shape[0]
+    T = cache_a.k.shape[2]
+    sel = row_map >= 0
+    j = jnp.clip(row_map, 0, logits_b.shape[0] - 1)
+
+    def pick(a, b, batch_axis=0):
+        take = jnp.take(b, j, axis=batch_axis)
+        shape = [1] * a.ndim
+        shape[batch_axis] = B
+        return jnp.where(sel.reshape(shape), take, a)
+
+    # the gap a decoded while b wasn't there: invalid for spliced rows forever
+    t_idx = jnp.arange(T)
+    gap = (t_idx >= prompt_width) & (t_idx < cache_a.length)
+    kv_b = kv_valid_b & ~gap[None, :]
+    cache = KVCache(pick(cache_a.k, cache_b.k, batch_axis=1),
+                    pick(cache_a.v, cache_b.v, batch_axis=1),
+                    cache_a.length)
+    return (cache, pick(logits_a, logits_b), pick(pos_a, pos_b),
+            pick(done_a, done_b), pick(kv_valid_a, kv_b))
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "top_k_bucket", "eos_id"))
 def _generate_jit(params, prompt_ids, prompt_mask, key, temperature, top_k,

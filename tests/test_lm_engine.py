@@ -160,7 +160,7 @@ def test_gen_batcher_batches_concurrent_requests():
                             gen_flush_deadline_ms=50.0))
     singles = [eng.generate(p, 6, temperature=0.0)
                for p in ["aa", "bb", "cc"]]
-    calls_before = eng.stats["generate_calls"]
+    sessions_before = eng.stats.get("sessions", 0)
 
     async def scenario():
         b = GenBatcher(eng)
@@ -174,7 +174,8 @@ def test_gen_batcher_batches_concurrent_requests():
 
     results = asyncio.run(scenario())
     assert results == singles
-    assert eng.stats["generate_calls"] == calls_before + 1  # one batch
+    # one decode SESSION served all three (flush-window batching)
+    assert eng.stats["sessions"] == sessions_before + 1
 
 
 def test_generate_stream_greedy_matches_generate():
@@ -340,7 +341,7 @@ def test_gen_batcher_mixed_sampling_shares_one_decode():
                             temperature=0.0, top_k=40, gen_max_batch=4,
                             gen_flush_deadline_ms=50.0))
     greedy_single = eng.generate("aa", 6, temperature=0.0)
-    calls_before = eng.stats["generate_calls"]
+    sessions_before = eng.stats.get("sessions", 0)
 
     async def scenario():
         b = GenBatcher(eng)
@@ -356,8 +357,8 @@ def test_gen_batcher_mixed_sampling_shares_one_decode():
     default, explicit, sampled = asyncio.run(scenario())
     assert default == explicit == greedy_single  # greedy rows unperturbed
     assert isinstance(sampled, str)
-    # mixed sampling params share ONE decode call
-    assert eng.stats["generate_calls"] == calls_before + 1
+    # mixed sampling params share ONE decode session
+    assert eng.stats["sessions"] == sessions_before + 1
 
 
 def test_generate_top_k_beyond_vocab_is_safe():
@@ -452,3 +453,154 @@ def test_bf16_close_to_fp32_prefill_and_decode(arch, num_kv):
 
     assert cos(outs["f32"][0], outs["bf16"][0]) > 0.995  # prefill
     assert cos(outs["f32"][1], outs["bf16"][1]) > 0.995  # decode w/ cache
+
+
+# -------------------------------------------- continuous batching (round 4)
+
+def test_session_matches_generate_batch():
+    """A session with no admissions decodes exactly generate_batch's output
+    (chunked scan == full scan in float32, greedy)."""
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[16],
+                            stream_chunk=4, temperature=0.0))
+    prompts, wants = ["hello", "wider prompt"], [10, 16]
+    base = eng.generate_batch(prompts, wants, temperature=0.0)
+    sess = eng.start_session(prompts, wants, temperature=0.0)
+    out = {}
+    while not sess.done() or any(r is not None for r in sess.rows):
+        finished = sess.step()
+        out.update(finished)
+        if not finished and sess.done():
+            break
+    assert [out[0], out[1]] == base
+
+
+def test_session_admit_matches_standalone():
+    """THE continuous-batching correctness property: a request admitted at a
+    chunk boundary of an in-flight decode produces EXACTLY its standalone
+    output — the gap cache slots are masked and its logical positions carry
+    on from its own prompt (gpt.merge_rows)."""
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[32],
+                            stream_chunk=4, temperature=0.0))
+    solo_a = eng.generate("hello", 20, temperature=0.0)
+    solo_b = eng.generate("world!", 12, temperature=0.0)
+
+    sess = eng.start_session(["hello"], [20], temperature=0.0)
+    out = {}
+    out.update(sess.step())  # chunk 1 decodes with A alone
+    assert sess.capacity() >= 1 and sess.can_admit("world!", 12)
+    (tag_b,) = sess.admit(["world!"], [12], temperature=[0.0], top_k=[0])
+    assert tag_b not in out
+    for _ in range(64):
+        out.update(sess.step())
+        if all(r is None for r in sess.rows):
+            break
+    assert out[0] == solo_a
+    assert out[tag_b] == solo_b
+    assert eng.stats["admitted"] == 1
+
+
+def test_session_budget_and_capacity_gates():
+    """can_admit refuses when the budget outruns the session's remaining
+    steps, when no row is free, or when the prompt overflows the bucket."""
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[8],
+                            stream_chunk=4, temperature=0.0))
+    sess = eng.start_session(["a"], [8], temperature=0.0)
+    assert sess.capacity() == 3  # session_min_rows=4 reserves headroom rows
+    sess.step()  # 4 of 8 steps spent
+    assert not sess.can_admit("b", 8)        # budget > remaining steps
+    assert sess.can_admit("b", 4)
+    assert not sess.can_admit("x" * 50, 4)   # prompt overflows the P bucket
+
+    eng1 = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                             num_heads=2, intermediate_size=64,
+                             max_positions=128, dtype="float32",
+                             prompt_buckets=[8], new_token_buckets=[8],
+                             stream_chunk=4, temperature=0.0,
+                             session_min_rows=1))
+    sess2 = eng1.start_session(["a"], [8], temperature=0.0)  # bb == 1: full
+    assert sess2.capacity() == 0
+    assert not sess2.can_admit("b", 1)
+
+
+def test_gen_batcher_admits_midflight():
+    """A request submitted while a session decodes joins it at a chunk
+    boundary instead of waiting for the whole decode — and still equals its
+    standalone output."""
+    import threading
+
+    from symbiont_tpu.engine import lm as lm_mod
+    from symbiont_tpu.engine.batcher import GenBatcher
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=128, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[32],
+                            stream_chunk=4, temperature=0.0,
+                            gen_max_batch=4, gen_flush_deadline_ms=5.0))
+    solo_a = eng.generate("aa", 24, temperature=0.0)
+    solo_b = eng.generate("bb", 8, temperature=0.0)
+
+    gate = threading.Event()
+    orig_step = lm_mod.BatchSession.step
+
+    def gated_step(self):
+        assert gate.wait(20), "test gate never opened"
+        return orig_step(self)
+
+    lm_mod.BatchSession.step = gated_step
+    try:
+        async def scenario():
+            b = GenBatcher(eng)
+            await b.start()
+            try:
+                t1 = asyncio.ensure_future(b.generate("aa", 24))
+                await asyncio.sleep(0.1)   # t1's session is starting/gated
+                t2 = asyncio.ensure_future(b.generate("bb", 8))
+                await asyncio.sleep(0)     # t2 lands in the live queue
+                gate.set()
+                return await asyncio.gather(t1, t2), b.stats
+            finally:
+                await b.close()
+
+        (ra, rb), stats = asyncio.run(scenario())
+    finally:
+        lm_mod.BatchSession.step = orig_step
+    assert ra == solo_a
+    assert rb == solo_b
+    assert stats["admitted_midflight"] == 1
+    assert stats["sessions"] == 1  # t2 never started its own session
+
+
+def test_gen_batcher_start_failure_fails_all_futures():
+    """A session that cannot start (e.g. budget overflows the position
+    space) must FAIL every waiting future — not leave callers hanging."""
+    from symbiont_tpu.engine.batcher import GenBatcher
+
+    eng = LmEngine(LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                            num_heads=2, intermediate_size=64,
+                            max_positions=8, dtype="float32",
+                            prompt_buckets=[8], new_token_buckets=[16],
+                            temperature=0.0, gen_max_batch=4,
+                            gen_flush_deadline_ms=5.0))
+
+    async def scenario():
+        b = GenBatcher(eng)
+        await b.start()
+        try:
+            futs = [b.generate("hi", 16), b.generate("yo", 16)]
+            results = await asyncio.wait_for(
+                asyncio.gather(*futs, return_exceptions=True), 15)
+            assert all(isinstance(r, ValueError) for r in results), results
+        finally:
+            await b.close()
+
+    asyncio.run(scenario())
