@@ -19,12 +19,38 @@ import bench  # noqa: E402
 
 def _doc_and_archive():
     doc = (REPO / "docs" / "PERF.md").read_text()
-    m = re.search(r"Rendered from `(BENCH_r\d+\.json)`", doc)
+    m = re.search(r"Rendered from `(BENCH_(?:r\d+|LATEST)\.json)`", doc)
     assert m, "PERF.md must name its source archive in the header"
     name = m.group(1)
     archive = REPO / name
     assert archive.exists(), f"named archive {name} missing from repo root"
     return doc, archive, name
+
+
+def test_perf_doc_not_stale():
+    """The doc must render from the NEWEST measurement present (VERDICT r3
+    weak #2: the doc sat on a favorable old round with the suite green).
+    Naming an old BENCH_rNN while a newer round's archive exists fails;
+    BENCH_LATEST.json (written by every full `python bench.py` run) must be
+    at least as recent as the newest driver archive."""
+    _, archive, name = _doc_and_archive()
+    rounds = list(REPO.glob("BENCH_r[0-9]*.json"))
+    if not rounds:
+        return
+    newest = max(rounds,
+                 key=lambda p: int(re.search(r"r(\d+)", p.name).group(1)))
+    if name.startswith("BENCH_r"):
+        assert name == newest.name, (
+            f"docs/PERF.md renders {name} but {newest.name} exists — "
+            f"regenerate: python bench.py --render-doc {newest.name} "
+            f"> docs/PERF.md (or run a full bench)")
+    else:
+        latest = bench.load_archive(archive)
+        newest_parsed = bench.load_archive(newest)
+        assert (latest.get("ts", 0) >= newest_parsed.get("ts", 0)
+                or latest == newest_parsed), (
+            f"BENCH_LATEST.json is older than {newest.name} — rerun "
+            f"python bench.py (full) to refresh the doc")
 
 
 def test_perf_doc_matches_named_archive_exactly():
