@@ -7,22 +7,27 @@
 // extractions and fetch failures are dropped with a warning
 // (scrape_and_publish, main.rs:15-84).
 //
-// The fetcher is a raw-socket HTTP/1.1 client (the toolchain image ships no
-// libcurl/OpenSSL headers): plain http:// is fetched natively with redirect
-// following; https:// URLs are reported as unsupported by this worker — route
-// TLS targets to the Python perception service, or terminate TLS at a proxy
-// (SYMBIONT_HTTP_PROXY) the same way the reference delegates TLS to reqwest.
+// The fetcher is a raw-socket HTTP/1.1 client; https:// is served by TLS
+// over dlopen(libssl) (tls_client.hpp — the image ships OpenSSL runtime
+// libraries but no headers, so the API slice is declared by hand). Parity:
+// the reference scrapes https via reqwest's TLS (main.rs:89-94). When no
+// libssl runtime exists, https falls back to a forward proxy
+// (SYMBIONT_HTTP_PROXY) or the Python perception service, with a clear
+// error naming both options.
 //
-// Usage: perception [SYMBIONT_BUS_URL=...]
+// Usage: perception [SYMBIONT_BUS_URL=...] [SYMBIONT_TLS_CA_FILE=...]
+//        [SYMBIONT_TLS_INSECURE=1] [SYMBIONT_HTTP_PROXY=...]
 
 #include <fcntl.h>
 
 #include <cstring>
+#include <memory>
 #include <string>
 
 #include "../../generated/cpp/symbiont_schema.hpp"
 #include "common.hpp"
 #include "html_extract.hpp"
+#include "tls_client.hpp"
 
 namespace {
 
@@ -32,6 +37,7 @@ struct Url {
   std::string host;
   int port = 80;
   std::string path = "/";
+  bool tls = false;
 };
 
 // Host/port/path extraction for either scheme (used for the Host header in
@@ -42,9 +48,11 @@ bool parse_any_url(const std::string& url, Url& out, std::string& err) {
   if (url.rfind("http://", 0) == 0) {
     rest = url.substr(7);
     default_port = 80;
+    out.tls = false;
   } else if (url.rfind("https://", 0) == 0) {
     rest = url.substr(8);
     default_port = 443;
+    out.tls = true;
   } else {
     err = "unsupported scheme";
     return false;
@@ -69,12 +77,16 @@ bool parse_any_url(const std::string& url, Url& out, std::string& err) {
 
 bool parse_http_url(const std::string& url, Url& out, std::string& err) {
   if (url.rfind("https://", 0) == 0) {
-    err = "https is not supported by the native fetcher (no TLS runtime); "
-          "set SYMBIONT_HTTP_PROXY or use the Python perception service";
-    return false;
+    std::string why;
+    if (!symbiont::tls::available(&why)) {
+      err = "https needs a TLS runtime and none was found (" + why +
+            "); set SYMBIONT_HTTP_PROXY or use the Python perception service";
+      return false;
+    }
+    return parse_any_url(url, out, err);
   }
   if (url.rfind("http://", 0) != 0) {
-    err = "unsupported scheme (need http://)";
+    err = "unsupported scheme (need http:// or https://)";
     return false;
   }
   return parse_any_url(url, out, err);
@@ -150,6 +162,22 @@ std::string http_get(const std::string& url, const std::string& user_agent,
     ~FdGuard() { ::close(fd); }
   } guard{fd};
 
+  // TLS ops run on the blocking socket; SO_RCVTIMEO/SO_SNDTIMEO bound the
+  // handshake and every read with what's left of the scrape budget
+  std::unique_ptr<symbiont::tls::Conn> tls_conn;
+  if (u.tls) {
+    int rem = remaining();
+    if (rem <= 0) throw std::runtime_error("scrape timeout");
+    rem = rem < 1 ? 1 : rem;  // a {0,0} timeval would mean NO timeout
+    struct timeval tv {rem / 1000, (rem % 1000) * 1000};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    bool insecure = symbiont::env_or("SYMBIONT_TLS_INSECURE", "") == "1";
+    tls_conn = std::make_unique<symbiont::tls::Conn>(
+        fd, u.host, /*verify=*/!insecure,
+        symbiont::env_or("SYMBIONT_TLS_CA_FILE", ""));
+  }
+
   std::string path_or_url = proxy.empty() ? u.path : target_url;
   Url host_of;
   if (!proxy.empty() && !parse_any_url(target_url, host_of, err))
@@ -158,27 +186,39 @@ std::string http_get(const std::string& url, const std::string& user_agent,
   std::string req = "GET " + path_or_url + " HTTP/1.1\r\nHost: " + hu.host +
                     "\r\nUser-Agent: " + user_agent +
                     "\r\nAccept: text/html\r\nConnection: close\r\n\r\n";
-  size_t off = 0;
-  while (off < req.size()) {
-    ssize_t n = ::send(fd, req.data() + off, req.size() - off, 0);
-    if (n <= 0) throw std::runtime_error("send failed");
-    off += (size_t)n;
+  if (tls_conn) {
+    tls_conn->write_all(req.data(), req.size());
+  } else {
+    size_t off = 0;
+    while (off < req.size()) {
+      ssize_t n = ::send(fd, req.data() + off, req.size() - off, 0);
+      if (n <= 0) throw std::runtime_error("send failed");
+      off += (size_t)n;
+    }
   }
 
   std::string buf;
   char chunk[65536];
   for (;;) {
-    struct pollfd p {fd, POLLIN, 0};
     int wait = remaining();
     if (wait <= 0) throw std::runtime_error("scrape timeout");
-    int prc = ::poll(&p, 1, wait);
-    if (prc == 0) throw std::runtime_error("scrape timeout");
-    if (prc < 0) {
-      if (errno == EINTR) continue;
-      throw std::runtime_error("poll failed");
+    ssize_t n;
+    if (tls_conn) {
+      // budget re-armed per read: a slow trickle can't stretch past it
+      struct timeval tv {wait / 1000, (wait % 1000) * 1000};
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      n = tls_conn->read(chunk, sizeof(chunk));
+    } else {
+      struct pollfd p {fd, POLLIN, 0};
+      int prc = ::poll(&p, 1, wait);
+      if (prc == 0) throw std::runtime_error("scrape timeout");
+      if (prc < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("poll failed");
+      }
+      n = ::recv(fd, chunk, sizeof(chunk), 0);
+      if (n < 0) throw std::runtime_error("recv failed");
     }
-    ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
-    if (n < 0) throw std::runtime_error("recv failed");
     if (n == 0) break;
     buf.append(chunk, (size_t)n);
     if (buf.size() > 32 * 1024 * 1024) throw std::runtime_error("response too large");
@@ -209,8 +249,8 @@ std::string http_get(const std::string& url, const std::string& user_agent,
     if (redirects_left <= 0) throw std::runtime_error("too many redirects");
     std::string loc = header_value("Location");
     if (loc.empty()) throw std::runtime_error("redirect without Location");
-    if (loc.rfind("http", 0) != 0) {  // relative redirect
-      loc = "http://" + hu.host +
+    if (loc.rfind("http", 0) != 0) {  // relative redirect keeps the scheme
+      loc = std::string(hu.tls ? "https://" : "http://") + hu.host +
             (hu.port != 80 && hu.port != 443 ? ":" + std::to_string(hu.port) : "") +
             (loc[0] == '/' ? loc : "/" + loc);
     }
@@ -219,19 +259,37 @@ std::string http_get(const std::string& url, const std::string& user_agent,
   if (status < 200 || status >= 300)
     throw std::runtime_error("http status " + std::to_string(status));
 
+  // Truncation guards: a mid-transfer FIN (network failure, or the
+  // injected-close attack close_notify exists to prevent — TLS reads map
+  // OpenSSL 3's "unexpected eof" to EOF, see tls_client.hpp) must never
+  // publish a partial page as complete. Chunked framing requires the
+  // terminating 0-chunk; Content-Length bodies must be complete.
   if (symbiont::html::ascii_lower(header_value("Transfer-Encoding"))
           .find("chunked") != std::string::npos) {
     std::string decoded;
     size_t i = 0;
-    while (i < body.size()) {
+    for (;;) {
       auto eol = body.find("\r\n", i);
-      if (eol == std::string::npos) break;
+      if (eol == std::string::npos)
+        throw std::runtime_error("truncated chunked body");
       long len = std::strtol(body.c_str() + i, nullptr, 16);
-      if (len <= 0) break;
+      if (len < 0) throw std::runtime_error("bad chunk length");
+      if (len == 0) return decoded;  // proper terminator seen
+      if (eol + 2 + (size_t)len > body.size())
+        throw std::runtime_error("truncated chunked body");
       decoded.append(body, eol + 2, (size_t)len);
       i = eol + 2 + (size_t)len + 2;
+      if (i > body.size())
+        throw std::runtime_error("truncated chunked body");
     }
-    return decoded;
+  }
+  std::string cl = header_value("Content-Length");
+  if (!cl.empty()) {
+    size_t want = (size_t)std::strtoull(cl.c_str(), nullptr, 10);
+    if (body.size() < want)
+      throw std::runtime_error(
+          "truncated body: " + std::to_string(body.size()) + " of " + cl);
+    body.resize(want);  // ignore trailing bytes past the declared length
   }
   return body;
 }

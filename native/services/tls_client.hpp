@@ -1,0 +1,245 @@
+// tls_client.hpp — TLS for the native perception fetcher via dlopen(libssl).
+//
+// The build image ships OpenSSL *runtime* libraries but no headers, so the
+// needed slice of the libssl/libcrypto API is declared by hand and resolved
+// with dlsym at first use. If no usable libssl is present the runtime reports
+// unavailable and the caller falls back to proxy mode — the worker still
+// builds and runs everywhere. Parity target: the reference scrapes https via
+// reqwest's native TLS (reference: services/perception_service/src/main.rs:89-94).
+//
+// Verification defaults to ON (system CA paths + hostname check);
+//   SYMBIONT_TLS_CA_FILE=<pem>   adds/overrides the trust anchor (tests use a
+//                                self-signed listener),
+//   SYMBIONT_TLS_INSECURE=1      disables verification entirely.
+
+#pragma once
+
+#include <dlfcn.h>
+
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+
+namespace symbiont {
+namespace tls {
+
+// Opaque OpenSSL types — only ever handled through pointers.
+struct SSL_CTX;
+struct SSL;
+struct SSL_METHOD;
+struct X509_VERIFY_PARAM;
+
+class Runtime {
+ public:
+  // nullptr when no usable libssl could be loaded (error in `why`).
+  static Runtime* get(std::string* why = nullptr) {
+    static Runtime* inst = load(&load_error());
+    if (!inst && why) *why = load_error();
+    return inst;
+  }
+
+  const SSL_METHOD* (*TLS_client_method)() = nullptr;
+  SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*) = nullptr;
+  void (*SSL_CTX_free)(SSL_CTX*) = nullptr;
+  void (*SSL_CTX_set_verify)(SSL_CTX*, int, void*) = nullptr;
+  int (*SSL_CTX_set_default_verify_paths)(SSL_CTX*) = nullptr;
+  int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*) = nullptr;
+  SSL* (*SSL_new)(SSL_CTX*) = nullptr;
+  void (*SSL_free)(SSL*) = nullptr;
+  int (*SSL_set_fd)(SSL*, int) = nullptr;
+  int (*SSL_connect)(SSL*) = nullptr;
+  int (*SSL_read)(SSL*, void*, int) = nullptr;
+  int (*SSL_write)(SSL*, const void*, int) = nullptr;
+  int (*SSL_shutdown)(SSL*) = nullptr;
+  int (*SSL_get_error)(const SSL*, int) = nullptr;
+  long (*SSL_ctrl)(SSL*, int, long, void*) = nullptr;
+  X509_VERIFY_PARAM* (*SSL_get0_param)(SSL*) = nullptr;
+  int (*X509_VERIFY_PARAM_set1_host)(X509_VERIFY_PARAM*, const char*, size_t) = nullptr;
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(X509_VERIFY_PARAM*, const char*) = nullptr;
+  unsigned long (*ERR_get_error)() = nullptr;
+  void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
+
+  std::string last_error() const {
+    if (!ERR_get_error) return "unknown TLS error";
+    unsigned long code = ERR_get_error();
+    if (code == 0) return "unknown TLS error";
+    char buf[256] = {0};
+    ERR_error_string_n(code, buf, sizeof(buf));
+    return buf;
+  }
+
+ private:
+  static std::string& load_error() {
+    static std::string err;
+    return err;
+  }
+
+  static Runtime* load(std::string* err) {
+    // RTLD_GLOBAL so libssl's own libcrypto dependency satisfies the ERR_*
+    // symbols too (they live in libcrypto).
+    void* h = nullptr;
+    for (const char* name : {"libssl.so.3", "libssl.so.1.1", "libssl.so"}) {
+      h = ::dlopen(name, RTLD_NOW | RTLD_GLOBAL);
+      if (h) break;
+    }
+    if (!h) {
+      *err = "no libssl runtime found (dlopen failed)";
+      return nullptr;
+    }
+    auto* rt = new Runtime();
+    auto sym = [&](const char* n) { return ::dlsym(h, n); };
+    bool ok = true;
+    auto req = [&](auto& fn, const char* n) {
+      fn = reinterpret_cast<std::remove_reference_t<decltype(fn)>>(sym(n));
+      if (!fn) ok = false;
+    };
+    req(rt->TLS_client_method, "TLS_client_method");
+    req(rt->SSL_CTX_new, "SSL_CTX_new");
+    req(rt->SSL_CTX_free, "SSL_CTX_free");
+    req(rt->SSL_CTX_set_verify, "SSL_CTX_set_verify");
+    req(rt->SSL_CTX_set_default_verify_paths, "SSL_CTX_set_default_verify_paths");
+    req(rt->SSL_CTX_load_verify_locations, "SSL_CTX_load_verify_locations");
+    req(rt->SSL_new, "SSL_new");
+    req(rt->SSL_free, "SSL_free");
+    req(rt->SSL_set_fd, "SSL_set_fd");
+    req(rt->SSL_connect, "SSL_connect");
+    req(rt->SSL_read, "SSL_read");
+    req(rt->SSL_write, "SSL_write");
+    req(rt->SSL_shutdown, "SSL_shutdown");
+    req(rt->SSL_get_error, "SSL_get_error");
+    req(rt->SSL_ctrl, "SSL_ctrl");
+    req(rt->SSL_get0_param, "SSL_get0_param");
+    req(rt->X509_VERIFY_PARAM_set1_host, "X509_VERIFY_PARAM_set1_host");
+    req(rt->X509_VERIFY_PARAM_set1_ip_asc, "X509_VERIFY_PARAM_set1_ip_asc");
+    // ERR_* come from libcrypto; resolve via the default namespace (pulled
+    // in by RTLD_GLOBAL above). Optional: errors degrade to "unknown".
+    rt->ERR_get_error =
+        reinterpret_cast<unsigned long (*)()>(::dlsym(RTLD_DEFAULT, "ERR_get_error"));
+    rt->ERR_error_string_n = reinterpret_cast<void (*)(unsigned long, char*, size_t)>(
+        ::dlsym(RTLD_DEFAULT, "ERR_error_string_n"));
+    if (!ok) {
+      *err = "libssl loaded but required symbols missing";
+      delete rt;
+      return nullptr;
+    }
+    return rt;
+  }
+};
+
+// One TLS connection over an already-connected blocking socket. The socket's
+// SO_RCVTIMEO/SO_SNDTIMEO (set by the caller from its deadline budget) bound
+// every handshake/read/write.
+class Conn {
+ public:
+  // Throws std::runtime_error on handshake/verification failure.
+  Conn(int fd, const std::string& host, bool verify, const std::string& ca_file)
+      : rt_(Runtime::get()) {
+    if (!rt_) throw std::runtime_error("TLS runtime unavailable");
+    ctx_ = rt_->SSL_CTX_new(rt_->TLS_client_method());
+    if (!ctx_) throw std::runtime_error("SSL_CTX_new failed");
+    if (verify) {
+      if (!ca_file.empty()) {
+        if (rt_->SSL_CTX_load_verify_locations(ctx_, ca_file.c_str(), nullptr) != 1) {
+          std::string e = rt_->last_error();
+          rt_->SSL_CTX_free(ctx_);
+          throw std::runtime_error("cannot load CA file " + ca_file + ": " + e);
+        }
+      } else {
+        rt_->SSL_CTX_set_default_verify_paths(ctx_);
+      }
+      rt_->SSL_CTX_set_verify(ctx_, 1 /*SSL_VERIFY_PEER*/, nullptr);
+    }
+    ssl_ = rt_->SSL_new(ctx_);
+    if (!ssl_) {
+      rt_->SSL_CTX_free(ctx_);
+      throw std::runtime_error("SSL_new failed");
+    }
+    // SNI (SSL_set_tlsext_host_name is a macro over SSL_ctrl):
+    // SSL_CTRL_SET_TLSEXT_HOSTNAME=55, TLSEXT_NAMETYPE_host_name=0
+    bool is_ip = host.find_first_not_of("0123456789.") == std::string::npos ||
+                 host.find(':') != std::string::npos;  // v4 / v6 literal
+    if (!is_ip) rt_->SSL_ctrl(ssl_, 55, 0, const_cast<char*>(host.c_str()));
+    if (verify) {
+      // IP literals check against IP SANs (set1_host would compare
+      // DNS-IDs). A failed binding must THROW, never silently degrade to
+      // chain-only verification; a digits-and-dots host set1_ip_asc can't
+      // parse (e.g. trailing dot) falls back to the DNS-ID check.
+      auto* param = rt_->SSL_get0_param(ssl_);
+      int bound = 0;
+      if (is_ip) bound = rt_->X509_VERIFY_PARAM_set1_ip_asc(param, host.c_str());
+      if (!bound)
+        bound = rt_->X509_VERIFY_PARAM_set1_host(param, host.c_str(), 0);
+      if (!bound) {
+        cleanup();
+        throw std::runtime_error("cannot bind peer name " + host +
+                                 " for certificate verification");
+      }
+    }
+    rt_->SSL_set_fd(ssl_, fd);
+    if (rt_->SSL_connect(ssl_) != 1) {
+      std::string e = rt_->last_error();
+      cleanup();
+      throw std::runtime_error("TLS handshake with " + host + " failed: " + e);
+    }
+  }
+
+  ~Conn() {
+    if (ssl_) rt_->SSL_shutdown(ssl_);
+    cleanup();
+  }
+
+  // >0 bytes, 0 on orderly close, throws on error/timeout.
+  int read(char* buf, int n) {
+    int r = rt_->SSL_read(ssl_, buf, n);
+    if (r > 0) return r;
+    int err = rt_->SSL_get_error(ssl_, r);
+    if (err == 6 /*SSL_ERROR_ZERO_RETURN*/) return 0;
+    if (err == 5 /*SSL_ERROR_SYSCALL*/ && r == 0) return 0;  // abrupt EOF
+    if (err == 1 /*SSL_ERROR_SSL*/) {
+      // OpenSSL 3 reports a peer close without close_notify as a protocol
+      // error; many servers (incl. Python's http.server) close abruptly
+      // after Connection: close. Treat exactly that case as EOF. The
+      // CALLER must enforce body framing (Content-Length / chunked
+      // terminator — perception.cpp's http_get throws on truncation), so
+      // an injected FIN cannot pass a partial body off as complete; only
+      // close-delimited bodies with no framing remain unknowable, same as
+      // every pragmatic client (curl's default).
+      std::string e = rt_->last_error();
+      if (e.find("unexpected eof") != std::string::npos) return 0;
+      throw std::runtime_error("TLS read failed: " + e);
+    }
+    throw std::runtime_error("TLS read failed (ssl err " + std::to_string(err) + ")");
+  }
+
+  void write_all(const char* buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      int w = rt_->SSL_write(ssl_, buf + off, (int)(n - off));
+      if (w <= 0) throw std::runtime_error("TLS write failed");
+      off += (size_t)w;
+    }
+  }
+
+  Conn(const Conn&) = delete;
+  Conn& operator=(const Conn&) = delete;
+
+ private:
+  void cleanup() {
+    if (ssl_) rt_->SSL_free(ssl_);
+    if (ctx_) rt_->SSL_CTX_free(ctx_);
+    ssl_ = nullptr;
+    ctx_ = nullptr;
+  }
+
+  Runtime* rt_;
+  SSL_CTX* ctx_ = nullptr;
+  SSL* ssl_ = nullptr;
+};
+
+inline bool available(std::string* why = nullptr) {
+  return Runtime::get(why) != nullptr;
+}
+
+}  // namespace tls
+}  // namespace symbiont

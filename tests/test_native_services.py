@@ -344,15 +344,117 @@ def test_native_perception_scrape(broker):
                 assert "TPU & XLA" in raw.raw_text
                 assert "junk" not in raw.raw_text and "drop" not in raw.raw_text
 
-            # https is refused with a warning, not a crash
-            task = PerceiveUrlTask(url="https://example.com/x")
-            await bus.publish(subjects.TASKS_PERCEIVE_URL, to_json_bytes(task))
-            assert await sub.next(1.0) is None
             await bus.close()
         finally:
-            err = stop_worker(proc)
+            stop_worker(proc)
             httpd.shutdown()
-            assert "https is not supported" in err
+
+    asyncio.run(scenario())
+
+
+def _make_tls_server(handler_cls, tmp_path):
+    """TLS listener on 127.0.0.1 with an ephemeral self-signed cert (IP SAN),
+    plus the PEM path a client must trust. Offline: cert minted locally."""
+    import datetime
+    import http.server
+    import ipaddress
+    import ssl
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "symbiont-test")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+                critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_pem = tmp_path / "cert.pem"
+    key_pem = tmp_path / "key.pem"
+    cert_pem.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_pem.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption()))
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), handler_cls)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(cert_pem), str(key_pem))
+    httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    return httpd, str(cert_pem)
+
+
+def test_native_perception_https_tls(broker, tmp_path):
+    """The native worker scrapes an https page end-to-end: TLS via
+    dlopen(libssl) with SNI + certificate verification against
+    SYMBIONT_TLS_CA_FILE (reference scrapes https through reqwest's TLS,
+    perception_service/src/main.rs:89-94). An untrusted listener (no CA
+    configured) must FAIL verification and publish nothing."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = FIXTURE_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd, ca_file = _make_tls_server(Handler, tmp_path)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    async def scenario():
+        from symbiont_tpu.schema import PerceiveUrlTask
+        from symbiont_tpu.services.html_extract import extract_main_text
+
+        url = f"https://127.0.0.1:{port}/page.html"
+
+        # trusted: full https scrape lands on the bus
+        proc = spawn_worker("perception", broker,
+                            {"SYMBIONT_TLS_CA_FILE": ca_file})
+        try:
+            await _wait_ready(proc)
+            bus = await _tcp_bus(broker)
+            sub = await bus.subscribe(subjects.DATA_RAW_TEXT_DISCOVERED)
+            await bus.publish(subjects.TASKS_PERCEIVE_URL,
+                              to_json_bytes(PerceiveUrlTask(url=url)))
+            msg = await sub.next(15.0)
+            assert msg is not None, "no raw text from the https scrape"
+            raw = from_json(RawTextMessage, msg.data)
+            assert raw.source_url == url
+            assert raw.raw_text == extract_main_text(FIXTURE_HTML)
+        finally:
+            # stop BEFORE spawning the untrusted worker: both share the
+            # q.perception queue group, and the broker's round-robin could
+            # otherwise hand the negative-path task to this trusted one
+            stop_worker(proc)
+
+        try:
+            # untrusted CA: verification must fail, nothing published
+            proc2 = spawn_worker("perception", broker)
+            await _wait_ready(proc2)
+            await bus.publish(subjects.TASKS_PERCEIVE_URL,
+                              to_json_bytes(PerceiveUrlTask(url=url)))
+            assert await sub.next(2.0) is None
+            err2 = stop_worker(proc2)
+            assert "scrape failed" in err2 and "TLS" in err2, err2
+            await bus.close()
+        finally:
+            httpd.shutdown()
 
     asyncio.run(scenario())
 
