@@ -777,7 +777,10 @@ network tunnel. Synthetic weights — throughput is weight-value independent,
 but it means **semantic quality is unvalidated in this sandbox**: no egress,
 so the gated golden tier against a real pretrained checkpoint
 (`tests/test_real_assets.py`, `SYMBIONT_MODEL_DIR`) has never executed here —
-run it where a fetched snapshot exists (see `scripts/fetch_model.py`).
+run it where a fetched snapshot exists (`scripts/fetch_model.py`), then check
+in golden vectors (`scripts/make_goldens.py` → `tests/test_golden_vectors.py`)
+so torch-free hosts re-validate semantic fidelity offline; the flow itself is
+proven in-suite on a transformers-serialized synthetic checkpoint.
 Reproduce with `python bench.py`: it prints ONE JSON line whose fields carry
 **every number in the table below** (the driver archives that line as
 `BENCH_r{{N}}.json` each round — the archived line is authoritative; tunnel

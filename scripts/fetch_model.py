@@ -18,6 +18,10 @@ The gated test tier validates a fetched snapshot end-to-end:
 
     SYMBIONT_MODEL_DIR=models/minilm python -m pytest tests/test_real_assets.py -q
 
+Then emit golden vectors (scripts/make_goldens.py) and check them in, so
+torch-free hosts can re-validate the JAX path against transformers outputs
+forever after (tests/test_golden_vectors.py).
+
 BASELINE.md model set: sentence-transformers/all-MiniLM-L6-v2 (config #1),
 BAAI/bge-base-en-v1.5 (#2), intfloat/e5-large-v2 (#3),
 cross-encoder/ms-marco-MiniLM-L-6-v2 (#4, use --pooler when converting),

@@ -90,6 +90,11 @@ def model_dir(tmp_path_factory) -> Path:
         max_position_embeddings=64)
     model = transformers.BertModel(cfg).eval()
     model.save_pretrained(d, safe_serialization=True)
+    # AutoTokenizer (used by scripts/make_goldens.py) needs the class hint
+    (d / "tokenizer_config.json").write_text(
+        '{"tokenizer_class": "BertTokenizerFast", "pad_token": "[PAD]", '
+        '"cls_token": "[CLS]", "sep_token": "[SEP]", "unk_token": "[UNK]", '
+        '"mask_token": "[MASK]"}')
     return d
 
 
@@ -232,6 +237,30 @@ def test_export_hf_bert_preserves_position_offset(model_dir, tmp_path):
     assert hf_cfg["pad_token_id"] == 1
     _, cfg2 = load_bert_model(out)
     assert cfg2.position_offset == 2
+
+
+def test_make_goldens_roundtrip(model_dir, tmp_path):
+    """The offline-golden flow (scripts/make_goldens.py →
+    tests/test_golden_vectors.py), proven end-to-end on the real-format
+    checkpoint above — so the checked-in-golden path is known-working
+    before a real snapshot ever lands (VERDICT r3 item 8 fallback)."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "goldens.npz"
+    subprocess.run(
+        [sys.executable, str(Path(__file__).parent.parent / "scripts" /
+                             "make_goldens.py"), str(model_dir),
+         "--out", str(out)],
+        check=True, capture_output=True)
+    g = np.load(out, allow_pickle=False)
+    eng = TpuEngine(EngineConfig(model_dir=str(model_dir), dtype="float32",
+                                 data_parallel=False))
+    ours = eng.embed_texts([str(t) for t in g["texts"]])
+    ref = g["embeddings"]
+    cos = (ours * ref).sum(-1) / (
+        np.linalg.norm(ours, axis=-1) * np.linalg.norm(ref, axis=-1))
+    assert cos.min() > 0.999, cos
 
 
 # --------------------------------------------------------- gated real tier
