@@ -39,9 +39,18 @@ log = logging.getLogger(__name__)
 class _BatcherBase:
     """Queue + wake + deadline-flush loop shared by the embed and generation
     batchers. Subclasses define `_size(item)` (how much of max_batch an item
-    consumes) and `_flush(batch)` (resolve every item's future)."""
+    consumes) and `_flush(batch)` (resolve every item's future).
 
-    def __init__(self, max_batch: int, deadline_s: float):
+    `max_inflight_flushes` > 1 lets the loop start flush N+1 while flush N's
+    results are still materializing — on a network-attached device a flush
+    tail is ~an RTT of pure waiting, so overlapping flushes keeps the chip
+    fed (the engine's entry points are thread-safe by design; see
+    engine.py's concurrency contract). Generation keeps it at 1: decode
+    sessions admit newcomers at chunk boundaries instead, and two sessions
+    would only contend on the LM lock."""
+
+    def __init__(self, max_batch: int, deadline_s: float,
+                 max_inflight_flushes: int = 1):
         self.max_batch = max_batch
         self.deadline_s = deadline_s
         self._queue: List = []
@@ -49,6 +58,8 @@ class _BatcherBase:
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        self._inflight = asyncio.Semaphore(max_inflight_flushes)
+        self._flushes: set = set()
 
     async def start(self) -> None:
         if self._task is None:
@@ -61,6 +72,8 @@ class _BatcherBase:
         if self._task is not None:
             await self._task
             self._task = None
+        if self._flushes:
+            await asyncio.gather(*self._flushes, return_exceptions=True)
 
     def _submit(self, item) -> None:
         if self._closed:
@@ -96,7 +109,22 @@ class _BatcherBase:
                                            self.deadline_s)
                 except asyncio.TimeoutError:
                     pass
-            await self._flush(self._take_chunk())
+            await self._inflight.acquire()
+            chunk = self._take_chunk()
+            if not chunk:
+                # an in-flight session's chunk-boundary admission can drain
+                # the queue while we waited on the semaphore
+                self._inflight.release()
+                continue
+            t = asyncio.create_task(self._flush_release(chunk))
+            self._flushes.add(t)
+            t.add_done_callback(self._flushes.discard)
+
+    async def _flush_release(self, batch: List) -> None:
+        try:
+            await self._flush(batch)
+        finally:
+            self._inflight.release()
 
     async def _sleep_until_full(self) -> None:
         while self._queued < self.max_batch and not self._closed:
@@ -120,10 +148,12 @@ class _Pending:
 
 class MicroBatcher(_BatcherBase):
     def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
-                 flush_deadline_ms: Optional[float] = None):
+                 flush_deadline_ms: Optional[float] = None,
+                 max_inflight_flushes: int = 2):
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
                     else engine.config.flush_deadline_ms) / 1000.0
-        super().__init__(max_batch or engine.config.max_batch, deadline)
+        super().__init__(max_batch or engine.config.max_batch, deadline,
+                         max_inflight_flushes=max_inflight_flushes)
         self.engine = engine
 
     async def embed(self, texts: Sequence[str]) -> np.ndarray:

@@ -395,3 +395,40 @@ def test_concat_fetch_groups_match(monkeypatch):
     monkeypatch.setattr(TpuEngine, "CONCAT_FETCH_MAX", 2)
     np.testing.assert_allclose(_small_engine().embed_texts(texts), base,
                                atol=1e-4, rtol=1e-3)
+
+
+def test_micro_batcher_overlapping_flushes():
+    """max_inflight_flushes=2: a flush stuck materializing (on a remote
+    device that tail is ~an RTT of waiting) must not block the next flush
+    from dispatching — and the stuck flush still resolves correctly."""
+    import asyncio
+    import threading
+
+    from symbiont_tpu.engine.batcher import MicroBatcher
+
+    gate = threading.Event()
+
+    class StubEngine:
+        class config:
+            max_batch = 2
+            flush_deadline_ms = 1.0
+
+        def embed_texts(self, texts):
+            if texts[0] == "slow":
+                assert gate.wait(10), "slow flush never released"
+            return np.full((len(texts), 4), float(len(texts)), np.float32)
+
+    async def scenario():
+        b = MicroBatcher(StubEngine())
+        await b.start()
+        slow = asyncio.ensure_future(b.embed(["slow"]))
+        await asyncio.sleep(0.1)  # slow flush is in its executor, gated
+        fast = await asyncio.wait_for(b.embed(["fast", "fast2"]), 5)
+        assert fast.shape == (2, 4) and fast[0, 0] == 2.0
+        assert not slow.done()  # proves the second flush overlapped it
+        gate.set()
+        out = await asyncio.wait_for(slow, 5)
+        assert out.shape == (1, 4)
+        await b.close()
+
+    asyncio.run(scenario())
