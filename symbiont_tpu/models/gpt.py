@@ -150,6 +150,12 @@ def _attn(
     B, S, H = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
+    # Deliberately THREE projections, not a fused [H, (nh+2nkv)·hd] matmul:
+    # fused qkv won an isolated microbenchmark (+23%) but LOST in the real
+    # decode loop on v5e (batch-64 GPT-2: ~19.8k → ~15.6k tok/s, measured
+    # with the fusion both in-body and pre-computed outside the scan) — the
+    # post-matmul slicing into q/k/v interacts badly with the cache-write /
+    # attention layout. Re-test on new hardware before "optimizing" this.
     q = (x @ layer["q"]["kernel"] + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
     k = (x @ layer["k"]["kernel"] + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
     v = (x @ layer["v"]["kernel"] + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)

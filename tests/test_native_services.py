@@ -949,3 +949,77 @@ def test_text_generator_lm_backend(broker):
             await engine_bus.close()
 
     asyncio.run(scenario())
+
+
+def test_native_pipeline_survives_replica_kill(broker):
+    """Fault injection at stack level (SURVEY.md §5.3): SIGKILL a durable
+    preprocessing replica while it holds unacked deliveries mid-embed; every
+    document must still land — redelivered to the surviving replica after
+    ack_wait — and land exactly once (deterministic point ids make the
+    inevitable redelivery-after-publish overlap idempotent). The reference
+    silently loses any in-flight document on a worker crash (SURVEY.md §5.3:
+    core NATS, at-most-once)."""
+    import tempfile
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.schema import RawTextMessage
+        from symbiont_tpu.services.engine_service import EngineService
+
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], max_batch=8,
+                                     dtype="float32", data_parallel=False))
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, engine=eng, vector_store=store)
+            await svc.start()
+            env = {"SYMBIONT_BUS_DURABLE": "1",
+                   "SYMBIONT_BUS_DURABLE_ACK_WAIT_MS": "1000"}
+            pa = spawn_worker("preprocessing", broker, env)
+            pb = spawn_worker("preprocessing", broker, env)
+            vm = spawn_worker("vector_memory", broker, env)
+            try:
+                for p in (pa, pb, vm):
+                    await _wait_ready(p, b"ready (durable)")
+                bus = await _tcp_bus(broker)
+                docs, sents = 12, 3
+                for i in range(docs):
+                    text = ". ".join(f"Sentence {i} {j} about tensors"
+                                     for j in range(sents)) + "."
+                    await bus.publish(
+                        subjects.DATA_RAW_TEXT_DISCOVERED,
+                        to_json_bytes(RawTextMessage(
+                            id=f"doc-{i}", source_url=f"http://u/{i}",
+                            raw_text=text,
+                            timestamp_ms=current_timestamp_ms())))
+                await asyncio.sleep(0.02)  # deliveries in flight, unacked
+                expected = docs * sents
+                count_at_kill = store.count()
+                pa.kill()  # SIGKILL: no ack, no goodbye
+                # the fault window must actually contain unfinished work, or
+                # this test would go green without exercising redelivery
+                assert count_at_kill < expected, (
+                    f"pipeline drained before the kill ({count_at_kill}); "
+                    f"fault window missed — raise docs or shrink the sleep")
+                for _ in range(300):
+                    if store.count() >= expected:
+                        break
+                    await asyncio.sleep(0.1)
+                assert store.count() == expected, (
+                    f"lost work after replica kill: {store.count()}/{expected}")
+                # past further ack windows: redeliveries must stay idempotent
+                await asyncio.sleep(2.0)
+                assert store.count() == expected
+                await bus.close()
+            finally:
+                pa.kill()  # idempotent if already dead
+                stop_worker(pa)
+                stop_worker(pb)
+                stop_worker(vm)
+                await svc.stop()
+                await engine_bus.close()
+
+    asyncio.run(scenario())
