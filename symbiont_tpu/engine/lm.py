@@ -135,10 +135,19 @@ class IncrementalDecoder:
 class LmEngine:
     """Owns LM params + decode executables. Thread-safe, single device owner
     (same stance as TpuEngine — SURVEY.md §5.2's fix for the reference's
-    concurrent-forward hazard)."""
+    concurrent-forward hazard).
+
+    Tensor-parallel serving: pass a mesh with a 'tensor' axis > 1 and the
+    params shard megatron-style across it (parallel/sharding.py) — decode
+    then serves models larger than one chip's HBM, with GSPMD inserting the
+    TP collectives into the same jitted decode the single-chip path runs
+    (SURVEY.md §2: "TP optional, implemented" — now for serving, not just
+    training). Requires num_heads, kv_heads, and intermediate_size divisible
+    by the tensor axis."""
 
     def __init__(self, config: Optional[LmConfig] = None, params=None,
-                 model_cfg: Optional[GPTConfig] = None, tokenizer=None):
+                 model_cfg: Optional[GPTConfig] = None, tokenizer=None,
+                 mesh=None):
         import dataclasses
 
         import jax
@@ -180,7 +189,19 @@ class LmEngine:
         if model_cfg.attn_impl != attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
         self.model_cfg = model_cfg
-        self.params = jax.device_put(params)
+        self.mesh = None
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            tp = mesh.shape["tensor"]
+            for name, val in (("num_heads", model_cfg.num_heads),
+                              ("kv_heads", model_cfg.kv_heads),
+                              ("intermediate_size", model_cfg.intermediate_size)):
+                if val % tp:
+                    raise ValueError(
+                        f"TP decode needs {name} ({val}) divisible by the "
+                        f"tensor axis ({tp})")
+            self.mesh = mesh
+            log.info("LM params sharded for TP decode over tensor=%d", tp)
+        self.params = self._place_params(params)
 
         if tokenizer is None:
             tokenizer = ByteTokenizer()
@@ -195,6 +216,23 @@ class LmEngine:
         self._lock = threading.Lock()
         self.stats = {"generate_calls": 0, "tokens_generated": 0,
                       "decode_s": 0.0}
+
+    def _place_params(self, params):
+        """ONE home for parameter placement: megatron-sharded over the mesh's
+        'tensor' axis when TP serving is on, plain device_put otherwise.
+        Used by __init__ and every online-fine-tune sync (update_params)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.device_put(params)
+        from symbiont_tpu.parallel.sharding import (
+            gpt_param_sharding,
+            shard_params,
+        )
+
+        return shard_params(
+            self.mesh, params,
+            gpt_param_sharding(self.mesh, params, arch=self.model_cfg.arch))
 
     # ------------------------------------------------------------------ gen
 
@@ -415,10 +453,8 @@ class LmEngine:
         remain valid context — same contract as any incremental fine-tune).
         The caller must hand over buffers it will not later donate or mutate
         (OnlineLmTrainer passes a copy)."""
-        import jax
-
         with self._lock:
-            self.params = jax.device_put(params)
+            self.params = self._place_params(params)
 
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""

@@ -64,17 +64,25 @@ def _gpt_layer_spec(arch: str) -> dict:
 
 
 def gpt_param_sharding(mesh: Mesh, params: Params, arch: str = "gpt2") -> Params:
-    """PartitionSpec tree for decoder LM params (megatron-style TP)."""
+    """PartitionSpec tree for decoder LM params (megatron-style TP).
+
+    The vocab dim shards only when it divides the tensor axis; otherwise the
+    embedding/head replicate (correct either way — vocab sharding is a
+    memory optimization, and odd vocabs like the 257-entry byte tokenizer
+    must still serve)."""
     layer_spec = _gpt_layer_spec(arch)
+    tp = mesh.shape.get("tensor", 1)
+    vocab_divides = params["wte"].shape[0] % tp == 0
     spec: dict = {
-        "wte": P("tensor", None),  # vocab-sharded embedding
+        "wte": P("tensor", None) if vocab_divides else P(),
         "layers": [layer_spec for _ in params["layers"]],
         "ln_f": {k: P() for k in params["ln_f"]},
     }
     if "wpe" in params:
         spec["wpe"] = P()
     if "lm_head" in params:
-        spec["lm_head"] = {"kernel": P(None, "tensor")}
+        spec["lm_head"] = {"kernel": P(None, "tensor") if vocab_divides
+                           else P()}
     return spec
 
 

@@ -126,7 +126,9 @@ class SymbiontStack:
             from symbiont_tpu.engine.batcher import GenBatcher
             from symbiont_tpu.engine.lm import LmEngine
 
-            self.lm = LmEngine(cfg.lm)
+            # a mesh with tensor>1 shards the LM megatron-style for TP
+            # decode (models larger than one chip); else single-device
+            self.lm = LmEngine(cfg.lm, mesh=self._mesh)
             # one generation micro-batcher shared by the bus surface and the
             # engine plane: concurrent requests decode as one batch. Stored
             # on self BEFORE anything else can raise, so stop() always
