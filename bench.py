@@ -320,17 +320,22 @@ def bench_compute_mfu(results: dict, peak: float | None) -> None:
     locally-attached deployment gets; the end-to-end MFU above additionally
     pays the tunnel's transfer wall.
 
-    Two geometries: MiniLM-384 (BASELINE.md config #1) and mpnet-768 — the
-    reference's actual default model (preprocessing_service/src/main.rs:305),
-    whose wider matmuls fill the 128×128 MXU far better. FLOPs are derived
-    from the engine's REAL model_cfg, not assumed (a shallower synthetic
-    stand-in would otherwise inflate MFU silently)."""
+    Three geometries spanning the BASELINE.md model set: MiniLM-384
+    (config #1), mpnet-768 — the reference's actual default model
+    (preprocessing_service/src/main.rs:305) — and e5-large-1024 (config #3,
+    the largest encoder); wider matmuls fill the 128×128 MXU progressively
+    better. FLOPs are derived from the engine's REAL model_cfg, not assumed
+    (a shallower synthetic stand-in would otherwise inflate MFU silently)."""
     if peak is None:
         return
     _compute_mfu_geometry(results, peak, dim=384, B=1024, S=64,
                           key_suffix="")
     _compute_mfu_geometry(results, peak, dim=768, B=512, S=128,
                           key_suffix="_768")
+    # BASELINE.md config #3: e5-large geometry (1024-d, 24 layers) — the
+    # largest encoder in the capability set; completes the model-set sweep
+    _compute_mfu_geometry(results, peak, dim=1024, B=256, S=128,
+                          key_suffix="_1024", N=8)
 
 
 def _compute_mfu_geometry(results: dict, peak: float, dim: int, B: int,
@@ -703,6 +708,16 @@ def render_doc(r: dict, source_name: str) -> str:
             ("`compute_only_768_emb_per_s`",
              "compute-only throughput at 768 geometry",
              f"{f['compute_only_768_emb_per_s']} emb/s"),
+        ]
+    if "mfu_compute_only_1024_pct" in f:
+        rows += [
+            ("`mfu_compute_only_1024_pct`",
+             "compute-only MFU, e5-large geometry (1024-d, 24 layers — "
+             "BASELINE.md config #3)",
+             f"**{f['mfu_compute_only_1024_pct']} %**"),
+            ("`compute_only_1024_emb_per_s`",
+             "compute-only throughput at e5-large geometry",
+             f"{f['compute_only_1024_emb_per_s']} emb/s"),
         ]
     rows += [
         ("`search_split_p50_ms` / `p95`",
