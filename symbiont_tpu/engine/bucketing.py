@@ -84,6 +84,8 @@ def pad_batch_rows_ids(
     """Row-pad (ids, lengths) up to batch_bucket; padding rows get length 0
     (their pooled output is discarded). Returns real row count."""
     n = ids.shape[0]
+    assert n <= batch_bucket, (
+        f"batch of {n} rows exceeds its batch bucket {batch_bucket}")
     if n == batch_bucket:
         return ids, lengths, n
     pad_rows = batch_bucket - n

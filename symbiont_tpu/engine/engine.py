@@ -315,6 +315,18 @@ class TpuEngine:
                          for a in arrays)
         return tuple(jnp.asarray(a) for a in arrays)
 
+    @property
+    def _plan_cap(self) -> int:
+        """Rows per planned batch: max_batch clamped to the LARGEST batch
+        bucket. A plan chunk bigger than every bucket has no executable
+        shape to run in — found by the engine-restart chaos test, where a
+        redelivery surge flushed max_batch-sized work through buckets
+        smaller than it. Clamping (rather than rounding shapes up) keeps
+        the executable set exactly |length_buckets|×|batch_buckets| —
+        warmup coverage and the recompile-storm bound stay intact; a surge
+        simply splits into top-bucket batches."""
+        return min(self.config.max_batch, self.config.batch_buckets[-1])
+
     def _batch_bucket(self, n: int) -> int:
         b = choose_bucket(n, self.config.batch_buckets)
         if self._n_data > 1:
@@ -342,7 +354,7 @@ class TpuEngine:
         chunk-local indices back to the caller's rows."""
         lengths = [len(e) for e in encoded]
         for bucket, indices in plan_batches(lengths, buckets,
-                                            self.config.max_batch):
+                                            self._plan_cap):
             seqs = [encoded[i] for i in indices]
             ids, lens = pad_ids_rows(seqs, bucket, self.tokenizer.pad_id,
                                      dtype=self._ids_dtype)
@@ -470,7 +482,7 @@ class TpuEngine:
         pending = []
         with maybe_profile("engine.rerank"):
             for bucket, indices in plan_batches(lengths, buckets,
-                                                self.config.max_batch):
+                                                self._plan_cap):
                 ids, lens = pad_ids_rows([pairs[i][0] for i in indices],
                                          bucket, self.tokenizer.pad_id,
                                          dtype=self._ids_dtype)

@@ -432,3 +432,24 @@ def test_micro_batcher_overlapping_flushes():
         await b.close()
 
     asyncio.run(scenario())
+
+
+def test_max_batch_beyond_largest_batch_bucket():
+    """max_batch larger than the top batch bucket must clamp the batch
+    PLAN at the top bucket (no executable exists for a bigger shape; an
+    unclamped plan underflowed row padding) — regression found by the
+    engine-restart chaos test, where a redelivery surge flushed a
+    max_batch-sized chunk through buckets smaller than it. Clamping keeps
+    the executable set exactly |length_buckets|×|batch_buckets|."""
+    cfg = EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                       batch_buckets=[2, 4], max_batch=8, dtype="float32",
+                       data_parallel=False)
+    eng = TpuEngine(cfg)
+    assert eng._plan_cap == 4
+    texts = [f"surge doc {i} with words" for i in range(8)]
+    out = eng.embed_texts(texts)
+    assert out.shape == (8, 32)
+    # no shape outside the configured bucket grid was compiled
+    assert all(B in (2, 4) for (_, _, B) in eng._exec_cache)
+    solo = np.stack([eng.embed_texts([t])[0] for t in texts])
+    np.testing.assert_allclose(out, solo, atol=1e-4, rtol=1e-3)

@@ -1023,3 +1023,102 @@ def test_native_pipeline_survives_replica_kill(broker):
                 await engine_bus.close()
 
     asyncio.run(scenario())
+
+
+def test_native_pipeline_survives_engine_restart(broker):
+    """The OTHER half of the two-plane failure semantics (SURVEY.md §7 hard
+    part 6): the ENGINE plane drops abruptly (TCP connection severed with
+    embed hops potentially in flight) and more documents arrive during the
+    outage; durable pipeline workers keep every delivery unacked (their
+    engine.embed hops fail or time out), and redelivery after ack_wait
+    completes ALL documents once a fresh engine plane re-registers — none
+    lost, none duplicated. Engine restart never restarts the workers."""
+    import tempfile
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.schema import RawTextMessage
+        from symbiont_tpu.services.engine_service import EngineService
+
+        def mk_engine():
+            return TpuEngine(EngineConfig(
+                embedding_dim=32, length_buckets=[8, 16], batch_buckets=[2, 4],
+                max_batch=8, dtype="float32", data_parallel=False))
+
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, engine=mk_engine(),
+                                vector_store=store)
+            await svc.start()
+            # max_deliver sized for the outage: attempts churn every
+            # ~ack_wait while the plane is down (plus first-embed compiles
+            # after restart), and a dead-lettered doc would read as data
+            # loss — the production default (5) assumes transient blips,
+            # not a deliberately long outage window
+            env = {"SYMBIONT_BUS_DURABLE": "1",
+                   "SYMBIONT_BUS_DURABLE_ACK_WAIT_MS": "800",
+                   "SYMBIONT_BUS_DURABLE_MAX_DELIVER": "50",
+                   "SYMBIONT_ENGINE_TIMEOUT_MS": "700"}
+            pre = spawn_worker("preprocessing", broker, env)
+            vm = spawn_worker("vector_memory", broker, env)
+            try:
+                await _wait_ready(pre, b"ready (durable)")
+                await _wait_ready(vm, b"ready (durable)")
+                bus = await _tcp_bus(broker)
+                docs, sents = 4, 3
+
+                def publish_doc(i: int):
+                    text = ". ".join(f"Outage doc {i} s{j} about chips"
+                                     for j in range(sents)) + "."
+                    return bus.publish(
+                        subjects.DATA_RAW_TEXT_DISCOVERED,
+                        to_json_bytes(RawTextMessage(
+                            id=f"odoc-{i}", source_url=f"http://o/{i}",
+                            raw_text=text,
+                            timestamp_ms=current_timestamp_ms())))
+
+                # half the docs arrive, then the engine plane's connection
+                # is severed ABRUPTLY (no graceful stop: in-flight embed
+                # hops get no reply); the rest arrive during the outage
+                for i in range(docs // 2):
+                    await publish_doc(i)
+                await asyncio.sleep(0.02)
+                await engine_bus.close()  # abrupt: drops subscriptions
+                await svc.stop()
+                # measured AFTER stop() drained in-flight upsert handlers:
+                # anything still mid-handler at the cut lands before this
+                count_at_cut = store.count()
+                for i in range(docs // 2, docs):
+                    await publish_doc(i)
+                # workers churn failures against the dead plane; anything
+                # not upserted before the cut stays pending, nothing new lands
+                await asyncio.sleep(1.5)
+                assert store.count() == count_at_cut
+
+                # engine plane comes BACK (fresh process-equivalent: new
+                # engine, new bus connection; the store is the durable truth)
+                engine_bus2 = await _tcp_bus(broker)
+                svc2 = EngineService(engine_bus2, engine=mk_engine(),
+                                     vector_store=store)
+                await svc2.start()
+                expected = docs * sents
+                for _ in range(400):
+                    if store.count() >= expected:
+                        break
+                    await asyncio.sleep(0.1)
+                assert store.count() == expected, (
+                    f"work lost across engine restart: "
+                    f"{store.count()}/{expected}")
+                await asyncio.sleep(1.5)  # further redeliveries: idempotent
+                assert store.count() == expected
+                await bus.close()
+                await svc2.stop()
+                await engine_bus2.close()
+            finally:
+                stop_worker(pre)
+                stop_worker(vm)
+
+    asyncio.run(scenario())
