@@ -226,7 +226,7 @@ def bench_tinyllama_decode(results: dict) -> None:
 
 def _bench_decode_geometry(label: str, key: str, results: dict,
                            cfg_kw: dict) -> None:
-    """Decode tok/s at batch 8 (+ TTFT), then the batch 32/64 sweep —
+    """Decode tok/s at batch 8 (+ TTFT), then the batch 32/64/128 sweep —
     decode is HBM-bandwidth-bound on weight reads, so aggregate tok/s
     scales with batch until the KV-cache traffic catches up (VERDICT r3
     item 3: measure past batch 8)."""
@@ -252,7 +252,7 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
         # materializing the tokens is the only honest completion barrier
         np.asarray(toks)
 
-    for B in (8, 32, 64):
+    for B in (8, 32, 64, 128):
         ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, P)), jnp.int32)
         mask = jnp.ones((B, P), jnp.int32)
         suffix = "" if B == 8 else f"_b{B}"
@@ -749,7 +749,7 @@ def render_doc(r: dict, source_name: str) -> str:
     ]
     for gkey, glabel in (("gpt2_124m", "GPT-2 124M"),
                          ("tinyllama_1b", "TinyLlama 1.1B")):
-        for b in (32, 64):
+        for b in (32, 64, 128):
             if f"{gkey}_tok_per_s_b{b}" in f:
                 rows.append((
                     f"`{gkey}_tok_per_s_b{b}`",
@@ -795,9 +795,11 @@ routing.
 - Search: engine-plane fused p50 {f['search_fused_p50_ms']} ms vs
   full-stack p50 **{f['e2e_search_p50_ms']} ms** — the C++ gateway probes
   the fused `engine.query.search` hop, so the whole native stack (HTTP
-  parse, bus round-trips, JSON) adds only ~1–3 ms on top of the one device
-  round-trip. The reference-parity 2-hop fallback costs two device
-  round-trips instead (`search_split_p50_ms` = {f['search_split_p50_ms']} ms).
+  parse, bus round-trips, JSON) adds single-digit milliseconds on top of
+  the one device round-trip; the two p50s come from different query sweeps
+  on a jittery link, so their small delta can land either side of zero.
+  The reference-parity 2-hop fallback costs two device round-trips instead
+  (`search_split_p50_ms` = {f['search_split_p50_ms']} ms).
 - Ingest: engine-plane bulk {f['ingest_10k_emb_per_s']} emb/s →
   full-stack **{f['e2e_ingest_emb_per_s']} emb/s** through per-document
   scrape→split→embed request-reply hops
