@@ -272,8 +272,13 @@ std::string http_get(const std::string& url, const std::string& user_agent,
       auto eol = body.find("\r\n", i);
       if (eol == std::string::npos)
         throw std::runtime_error("truncated chunked body");
-      long len = std::strtol(body.c_str() + i, nullptr, 16);
-      if (len < 0) throw std::runtime_error("bad chunk length");
+      char* endp = nullptr;
+      long len = std::strtol(body.c_str() + i, &endp, 16);
+      // strtol returns 0 for garbage too; require at least one hex digit so a
+      // malformed chunk-size line can't masquerade as the 0-terminator and
+      // pass off a corrupted body as complete (ADVICE r4)
+      if (endp == body.c_str() + i || len < 0)
+        throw std::runtime_error("bad chunk length");
       if (len == 0) return decoded;  // proper terminator seen
       if (eol + 2 + (size_t)len > body.size())
         throw std::runtime_error("truncated chunked body");
@@ -290,6 +295,12 @@ std::string http_get(const std::string& url, const std::string& user_agent,
       throw std::runtime_error(
           "truncated body: " + std::to_string(body.size()) + " of " + cl);
     body.resize(want);  // ignore trailing bytes past the declared length
+  } else if (u.tls) {
+    // close-delimited https body: no framing means an injected FIN is
+    // indistinguishable from a complete page — surface it (ADVICE r4)
+    symbiont::logline("WARN", SERVICE,
+                      "https body has neither Content-Length nor chunked "
+                      "framing; completeness unverifiable: " + target_url);
   }
   return body;
 }

@@ -60,14 +60,19 @@ class Runtime {
   unsigned long (*ERR_get_error)() = nullptr;
   void (*ERR_error_string_n)(unsigned long, char*, size_t) = nullptr;
 
-  std::string last_error() const {
-    if (!ERR_get_error) return "unknown TLS error";
-    unsigned long code = ERR_get_error();
-    if (code == 0) return "unknown TLS error";
+  // Pops the queue head; 0 when empty/unavailable.
+  unsigned long last_error_code() const {
+    return ERR_get_error ? ERR_get_error() : 0;
+  }
+
+  std::string error_string(unsigned long code) const {
+    if (code == 0 || !ERR_error_string_n) return "unknown TLS error";
     char buf[256] = {0};
     ERR_error_string_n(code, buf, sizeof(buf));
     return buf;
   }
+
+  std::string last_error() const { return error_string(last_error_code()); }
 
  private:
   static std::string& load_error() {
@@ -205,7 +210,21 @@ class Conn {
       // an injected FIN cannot pass a partial body off as complete; only
       // close-delimited bodies with no framing remain unknowable, same as
       // every pragmatic client (curl's default).
-      std::string e = rt_->last_error();
+      unsigned long code = rt_->last_error_code();
+      // Primary check is the stable numeric reason — OpenSSL 3's
+      // SSL_R_UNEXPECTED_EOF_WHILE_READING (294) raised by ERR_LIB_SSL
+      // (20): ERR_GET_REASON for a non-system error is code & 0x7FFFFF and
+      // ERR_GET_LIB is (code >> 23) & 0xFF (the 1.1-era 0xFFF mask doesn't
+      // apply: 1.1 reports this case as SSL_ERROR_SYSCALL, handled above).
+      // Requiring the lib id keeps a non-SSL error whose reason bits happen
+      // to equal 294 from masquerading as a clean EOF. The message-text
+      // match stays only as a fallback for builds whose numbering differs
+      // (ADVICE r4: text is not a stable API).
+      bool system_err = (code & 0x80000000UL) != 0;
+      if (!system_err && ((code >> 23) & 0xFFUL) == 20UL
+          && (code & 0x7FFFFFUL) == 294UL)
+        return 0;
+      std::string e = rt_->error_string(code);
       if (e.find("unexpected eof") != std::string::npos) return 0;
       throw std::runtime_error("TLS read failed: " + e);
     }

@@ -352,6 +352,86 @@ def test_native_perception_scrape(broker):
     asyncio.run(scenario())
 
 
+def test_native_perception_chunked_framing(broker):
+    """Chunked transfer decoding: a well-formed chunked body decodes and
+    publishes; a malformed chunk-size line must be treated as truncation —
+    NOT as the 0-terminator — so a corrupted body is never passed off as a
+    complete page (ADVICE r4: strtol returns 0 for garbage)."""
+    import threading
+
+    html = FIXTURE_HTML.encode()
+    mid = len(html) // 2
+    head = ("HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n"
+            "Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n").encode()
+
+    def chunk(b: bytes) -> bytes:
+        return f"{len(b):x}\r\n".encode() + b + b"\r\n"
+
+    responses = {
+        # two chunks + proper terminator → decodes to the full fixture
+        "/ok": head + chunk(html[:mid]) + chunk(html[mid:]) + b"0\r\n\r\n",
+        # extractable first chunk, then a garbage size line and FIN: the old
+        # decoder read strtol("zz")==0 as the terminator and published the
+        # truncated page; it must throw instead
+        "/bad": head + chunk(html[:mid]) + b"zz\r\n",
+    }
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    raw_port = srv.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                req = b""
+                while b"\r\n\r\n" not in req:
+                    d = conn.recv(4096)
+                    if not d:
+                        break
+                    req += d
+                parts = req.split(b" ")
+                path = parts[1].decode() if len(parts) > 1 else "/"
+                conn.sendall(responses.get(
+                    path, b"HTTP/1.1 404 nf\r\nContent-Length: 0\r\n\r\n"))
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    async def scenario():
+        proc = spawn_worker("perception", broker)
+        try:
+            await _wait_ready(proc)
+            bus = await _tcp_bus(broker)
+            sub = await bus.subscribe(subjects.DATA_RAW_TEXT_DISCOVERED)
+
+            from symbiont_tpu.schema import PerceiveUrlTask
+            from symbiont_tpu.services.html_extract import extract_main_text
+
+            bad_url = f"http://127.0.0.1:{raw_port}/bad"
+            ok_url = f"http://127.0.0.1:{raw_port}/ok"
+            # bad first, then ok: the worker handles tasks in order, so the
+            # FIRST published message proves whether /bad leaked a partial
+            for url in (bad_url, ok_url):
+                await bus.publish(subjects.TASKS_PERCEIVE_URL, to_json_bytes(
+                    PerceiveUrlTask(url=url)))
+            msg = await sub.next(20.0)
+            assert msg is not None, "no raw text published"
+            raw = from_json(RawTextMessage, msg.data)
+            assert raw.source_url == ok_url, \
+                "malformed chunked body was published as complete"
+            assert raw.raw_text == extract_main_text(FIXTURE_HTML)
+            await bus.close()
+        finally:
+            stop_worker(proc)
+            srv.close()
+
+    asyncio.run(scenario())
+
+
 def _make_tls_server(handler_cls, tmp_path):
     """TLS listener on 127.0.0.1 with an ephemeral self-signed cert (IP SAN),
     plus the PEM path a client must trust. Offline: cert minted locally."""
