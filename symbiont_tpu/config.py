@@ -99,6 +99,12 @@ class LmConfig:
     max_positions: int = 2048
     dtype: str = "bfloat16"
     attn_impl: str = "auto"
+    # tensor-parallel serving decode over the stack mesh's 'tensor' axis.
+    # "auto" shards when the head/ffn counts divide the axis and falls back
+    # to single-device placement (with a warning) when they don't — a mesh
+    # whose tensor axis exists for the encoder/training must not brick LM
+    # boot. "on" makes non-divisibility a hard error; "off" never shards.
+    tensor_parallel: str = "auto"
     # static-shape buckets: one decode executable per (prompt, new) pair
     prompt_buckets: List[int] = field(default_factory=lambda: [16, 64, 256, 1024])
     new_token_buckets: List[int] = field(default_factory=lambda: [16, 64, 128, 256, 1024])
@@ -129,6 +135,10 @@ class LmConfig:
     train_state_path: Optional[str] = None  # persist/resume learning
 
     def __post_init__(self) -> None:
+        if self.tensor_parallel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"tensor_parallel must be auto|on|off, "
+                f"got {self.tensor_parallel!r}")
         # the streaming decode loop runs whole chunks against a KV cache with
         # exactly new_bucket decode slots — a non-dividing chunk would scan
         # past the cache and rely on dynamic_update_slice clamp semantics
