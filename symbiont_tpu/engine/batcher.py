@@ -158,11 +158,14 @@ class _Pending:
 class MicroBatcher(_BatcherBase):
     def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None,
-                 max_inflight_flushes: int = 2):
+                 max_inflight_flushes: Optional[int] = None):
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
                     else engine.config.flush_deadline_ms) / 1000.0
         super().__init__(max_batch or engine.config.max_batch, deadline,
-                         max_inflight_flushes=max_inflight_flushes)
+                         max_inflight_flushes=(
+                             max_inflight_flushes
+                             if max_inflight_flushes is not None
+                             else engine.config.max_inflight_flushes))
         self.engine = engine
 
     async def embed(self, texts: Sequence[str]) -> np.ndarray:
@@ -271,6 +274,12 @@ class GenBatcher(_BatcherBase):
             participants: List = list(group)
             by_tag: dict = {}
             prep_fut = None  # in-flight prepare: (future, take-items)
+            # requests this session can NEVER admit (prompt over its prompt
+            # bucket, or budget over its monotonically-shrinking remaining
+            # steps): parked here until the session ends instead of
+            # re-queued, or every chunk boundary would re-steal and
+            # re-tokenize them (can_admit encodes the full prompt)
+            deferred: List = []
             try:
                 sess = await loop.run_in_executor(
                     None, lambda g=group: self.lm.start_session(
@@ -317,15 +326,15 @@ class GenBatcher(_BatcherBase):
                                 tags = None
                             if tags is None:
                                 continue
-                            rejected: List = []
                             for tag, p in zip(tags, take):
                                 if tag is None:
-                                    rejected.append(p)
+                                    # splice rejection is permanent for this
+                                    # session too (budget vs remaining)
+                                    deferred.append(p)
                                 else:
                                     by_tag[tag] = p
                                     participants.append(p)
                                     self.stats["admitted_midflight"] += 1
-                            self._requeue(rejected)
                     if sess.done() and not by_tag:
                         break
                     # 2) steal the queue and start preparing newcomers —
@@ -347,7 +356,7 @@ class GenBatcher(_BatcherBase):
                                 if not p.future.done():
                                     p.future.set_exception(e)
                             take, keep = [], []
-                        self._requeue(keep)
+                        deferred.extend(keep)
                         if take:
                             prep_fut = (loop.run_in_executor(
                                 None, self._do_prepare, sess, take), take)
@@ -366,6 +375,10 @@ class GenBatcher(_BatcherBase):
                 for p in participants:
                     if not p.future.done():
                         p.future.set_exception(e)
+            finally:
+                # deferred items never joined this session — hand them to
+                # the next one (front of queue: preserve arrival order)
+                self._requeue(deferred)
 
     def _filter_candidates(self, sess, candidates: List):
         """Executor-side: split candidates into (take, keep). can_admit

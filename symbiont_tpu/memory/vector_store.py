@@ -89,32 +89,45 @@ class VectorStore:
 
     def upsert(self, points: Sequence[Tuple[str, Sequence[float], dict]]) -> int:
         """Insert or overwrite points; ack only after the WAL write+flush
-        (the reference's wait=true durability, main.rs:196). Returns count."""
+        (the reference's wait=true durability, main.rs:196). Returns count.
+
+        Normalization is one vectorized pass over the whole batch — the
+        per-point numpy calls (asarray + norm per row) were ~1 s of CPU per
+        3k-point ingest wave on the one-core host (measured r5)."""
         if not points:
             return 0
         with self._lock:
+            try:
+                batch = np.asarray([vec for _, vec, _ in points], np.float32)
+            except (ValueError, TypeError):
+                batch = None  # ragged input: report the offending row below
+            if batch is None or batch.ndim != 2 or batch.shape[1] != self.dim:
+                for _, vec, _ in points:
+                    v = np.asarray(vec, np.float32)
+                    if v.shape != (self.dim,):
+                        raise ValueError(
+                            f"vector dim {v.shape} != collection dim {self.dim}")
+                raise ValueError(f"vectors must be [n, {self.dim}]")
+            norms = np.linalg.norm(batch, axis=1, keepdims=True)
+            batch = np.divide(batch, norms, out=batch.copy(),
+                              where=norms > 0)
             rows = []
             new_pos: Dict[str, int] = {}  # ids first seen in THIS call — a
             # duplicate id within one batch (e.g. WAL replay of an update)
             # must overwrite, not append twice
-            for pid, vec, payload in points:
-                v = np.asarray(vec, np.float32)
-                if v.shape != (self.dim,):
-                    raise ValueError(f"vector dim {v.shape} != collection dim {self.dim}")
-                norm = float(np.linalg.norm(v))
-                v = v / norm if norm > 0 else v
+            for j, (pid, _, payload) in enumerate(points):
                 if pid in self._id_to_row:
                     r = self._id_to_row[pid]
-                    self._vectors[r] = v
+                    self._vectors[r] = batch[j]
                     self._payloads[r] = dict(payload)
                     self._dirty = True
                 elif pid in new_pos:
-                    rows[new_pos[pid]] = (pid, v, dict(payload))
+                    rows[new_pos[pid]] = (pid, j, dict(payload))
                 else:
                     new_pos[pid] = len(rows)
-                    rows.append((pid, v, dict(payload)))
+                    rows.append((pid, j, dict(payload)))
             if rows:
-                new_vecs = np.stack([v for _, v, _ in rows])
+                new_vecs = batch[[j for _, j, _ in rows]]
                 base = len(self._ids)
                 self._vectors = (np.concatenate([self._vectors, new_vecs])
                                  if len(self._vectors) else new_vecs)
@@ -295,10 +308,20 @@ class VectorStore:
             return
         if self._wal_file is None:
             self._wal_file = open(path, "a", encoding="utf-8")
+        # vectors ride as base64 f32 (internal durability format, not wire
+        # schema): json-serializing 384 floats per point was the single
+        # hottest CPU term of a bulk-ingest wave (measured r5). load()
+        # accepts both this and the pre-r5 "vector" float-list records.
+        import base64
+
+        lines = []
         for pid, vec, payload in points:
-            rec = {"id": pid, "vector": np.asarray(vec, np.float32).tolist(),
+            rec = {"id": pid,
+                   "vector_b64": base64.b64encode(
+                       np.asarray(vec, np.float32).tobytes()).decode("ascii"),
                    "payload": payload}
-            self._wal_file.write(json.dumps(rec, ensure_ascii=False) + "\n")
+            lines.append(json.dumps(rec, ensure_ascii=False))
+        self._wal_file.write("\n".join(lines) + "\n")
         self._wal_file.flush()
         os.fsync(self._wal_file.fileno())
 
@@ -341,8 +364,16 @@ class VectorStore:
                             continue
                         try:
                             rec = json.loads(line)
-                            replay.append((rec["id"], rec["vector"], rec["payload"]))
-                        except (json.JSONDecodeError, KeyError):
+                            if "vector_b64" in rec:
+                                import base64
+
+                                vec = np.frombuffer(
+                                    base64.b64decode(rec["vector_b64"]),
+                                    dtype=np.float32)
+                            else:  # pre-r5 float-list records
+                                vec = rec["vector"]
+                            replay.append((rec["id"], vec, rec["payload"]))
+                        except (json.JSONDecodeError, KeyError, ValueError):
                             log.warning("skipping corrupt WAL line")
                 if replay:
                     # replay through upsert minus re-logging

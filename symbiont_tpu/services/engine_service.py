@@ -181,6 +181,22 @@ class EngineService(Service):
             if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
                 raise ValueError("texts must be a list of strings")
             vecs = await self.batcher.embed(texts)
+            if req.get("encoding") == "b64":
+                # compact reply for bulk callers (the C++ preprocessing
+                # shell): f32 little-endian rows base64'd is ~4.3 bytes per
+                # float vs ~10 digits of JSON — and skips the per-float
+                # Python float() / repr() round-trip entirely
+                import base64
+
+                import numpy as _np
+
+                arr = _np.ascontiguousarray(_np.asarray(vecs, _np.float32))
+                if arr.ndim == 1:  # zero texts edge: keep the 2-D contract
+                    arr = arr.reshape(0, 0)
+                return {"vectors_b64": base64.b64encode(arr.tobytes()).decode(
+                            "ascii"),
+                        "count": int(arr.shape[0]), "dim": int(arr.shape[1]),
+                        "model_name": self.engine.config.model_name}
             return {"vectors": [[float(x) for x in v] for v in vecs],
                     "model_name": self.engine.config.model_name}
         await self._handle(msg, "embed.batch", op)
@@ -228,8 +244,32 @@ class EngineService(Service):
 
     async def _vec_upsert(self, msg: Msg) -> None:
         async def op(req: dict) -> dict:
-            points = [(p["id"], p["vector"], p.get("payload", {}))
-                      for p in req["points"]]
+            if "vectors_b64" in req:
+                # compact form from the C++ vector_memory shell: all vectors
+                # in one base64 f32 block (framework-internal plane; the
+                # data.text.with_embeddings wire schema is untouched)
+                import base64
+
+                import numpy as _np
+
+                dim = int(req["dim"])
+                flat = _np.frombuffer(base64.b64decode(req["vectors_b64"]),
+                                      dtype=_np.float32)
+                ids = req["ids"]
+                if dim <= 0 or flat.size != len(ids) * dim:
+                    raise ValueError(
+                        f"vectors_b64 holds {flat.size} floats for "
+                        f"{len(ids)} ids of dim {dim}")
+                rows = flat.reshape(len(ids), dim)
+                payloads = req.get("payloads") or [{}] * len(ids)
+                if len(payloads) != len(ids):
+                    # zip would silently truncate and drop points
+                    raise ValueError(
+                        f"{len(payloads)} payloads for {len(ids)} ids")
+                points = list(zip(ids, rows, payloads))
+            else:
+                points = [(p["id"], p["vector"], p.get("payload", {}))
+                          for p in req["points"]]
             n = await self._run_blocking(self.vector_store.upsert, points)
             if self._fused_enabled() and (
                     self._warm_failed or await self._run_blocking(

@@ -42,8 +42,7 @@ def _free_port() -> int:
 from tests.conftest import NATIVE_MAKE_TARGET, native_bin
 
 
-@pytest.fixture(scope="module")
-def broker():
+def _spawn_broker():
     subprocess.run(["make", "-C", str(REPO / "native"), NATIVE_MAKE_TARGET],
                    check=True, capture_output=True)
     port = _free_port()
@@ -59,6 +58,25 @@ def broker():
     else:
         proc.kill()
         raise RuntimeError("broker did not start")
+    return proc, port
+
+
+@pytest.fixture(scope="module")
+def broker():
+    proc, port = _spawn_broker()
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture()
+def fresh_broker():
+    """Function-scoped broker for DURABLE tests: once any worker creates the
+    'pipeline' stream, the broker captures every later message on its
+    subjects — a shared broker would replay unrelated tests' pipeline
+    traffic into a durable test's consumer groups (observed: +18 points
+    from an earlier test's docs)."""
+    proc, port = _spawn_broker()
     yield port
     proc.terminate()
     proc.wait(timeout=5)
@@ -924,7 +942,8 @@ def test_native_knowledge_graph(broker):
     asyncio.run(scenario())
 
 
-def test_native_knowledge_graph_durable_ack(broker):
+def test_native_knowledge_graph_durable_ack(fresh_broker):
+    broker = fresh_broker
     """Durable mode: the KG worker filter-subscribes to only its subject and
     acks after commit — a successful save must NOT redeliver, and foreign
     pipeline subjects must never reach its parse loop."""
@@ -1031,7 +1050,93 @@ def test_text_generator_lm_backend(broker):
     asyncio.run(scenario())
 
 
-def test_native_pipeline_survives_replica_kill(broker):
+def test_native_preprocessing_coalesces_docs(broker):
+    """The pipelined feed (VERDICT r4 next-1): one replica coalesces multiple
+    pending documents' sentences into fewer engine.embed.batch hops, and —
+    the critical invariant — every doc still gets exactly ITS vectors in
+    sentence order (offset bookkeeping across the coalesced reply). Each
+    published embedding must match embedding that sentence directly."""
+    import tempfile
+
+    import numpy as np
+
+    async def scenario():
+        from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+        from symbiont_tpu.engine.engine import TpuEngine
+        from symbiont_tpu.memory.vector_store import VectorStore
+        from symbiont_tpu.schema import RawTextMessage, TextWithEmbeddingsMessage
+        from symbiont_tpu.services.engine_service import EngineService
+        from symbiont_tpu.utils.telemetry import metrics
+
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4, 32], max_batch=64,
+                                     dtype="float32", data_parallel=False))
+        with tempfile.TemporaryDirectory() as td:
+            store = VectorStore(VectorStoreConfig(dim=32, data_dir=td))
+            engine_bus = await _tcp_bus(broker)
+            svc = EngineService(engine_bus, engine=eng, vector_store=store)
+            await svc.start()
+            # max_inflight=1 forces docs 2..n to queue behind doc 1's hop and
+            # ride ONE coalesced request when it completes
+            pre = spawn_worker("preprocessing", broker,
+                               {"SYMBIONT_PREPROC_MAX_INFLIGHT": "1"})
+            try:
+                await _wait_ready(pre)
+                bus = await _tcp_bus(broker)
+                sub_emb = await bus.subscribe(subjects.DATA_TEXT_WITH_EMBEDDINGS)
+                calls_before = metrics.snapshot()["counters"].get(
+                    "engine.embed.batch", 0)
+
+                docs = []
+                for i in range(6):
+                    # distinct sentence counts stress the offset arithmetic
+                    n_sents = 2 + (i % 3)
+                    text = ". ".join(f"Doc {i} sentence {j} about tensors"
+                                     for j in range(n_sents)) + "."
+                    docs.append(RawTextMessage(
+                        id=f"co-doc-{i}", source_url=f"http://co/{i}",
+                        raw_text=text, timestamp_ms=current_timestamp_ms()))
+                for d in docs:
+                    await bus.publish(subjects.DATA_RAW_TEXT_DISCOVERED,
+                                      to_json_bytes(d))
+
+                got = {}
+                for _ in range(len(docs)):
+                    m = await sub_emb.next(60.0)
+                    assert m is not None, f"only {len(got)}/{len(docs)} docs"
+                    out = from_json(TextWithEmbeddingsMessage, m.data)
+                    got[out.original_id] = out
+                assert set(got) == {d.id for d in docs}
+
+                calls_after = metrics.snapshot()["counters"].get(
+                    "engine.embed.batch", 0)
+                assert calls_after - calls_before < len(docs), (
+                    "no coalescing: one embed hop per doc "
+                    f"({calls_after - calls_before} hops for {len(docs)} docs)")
+
+                # alignment: every published vector == embedding that exact
+                # sentence directly (b64 engine hop is exact f32; the only
+                # lossy leg is the C++ float→JSON dump of the publish)
+                for d in docs:
+                    out = got[d.id]
+                    sents = [se.sentence_text for se in out.embeddings_data]
+                    direct = eng.embed_texts(sents)
+                    for se, want in zip(out.embeddings_data, direct):
+                        assert np.allclose(se.embedding, want, atol=1e-4), (
+                            f"vector mismatch for {d.id}: {se.sentence_text!r}")
+                await bus.close()
+            finally:
+                err = stop_worker(pre)
+                await svc.stop()
+                await engine_bus.close()
+                assert "WARN" not in (err.split("ready", 1)[1]
+                                      if "ready" in err else err), err
+
+    asyncio.run(scenario())
+
+
+def test_native_pipeline_survives_replica_kill(fresh_broker):
+    broker = fresh_broker
     """Fault injection at stack level (SURVEY.md §5.3): SIGKILL a durable
     preprocessing replica while it holds unacked deliveries mid-embed; every
     document must still land — redelivered to the surviving replica after
@@ -1065,7 +1170,10 @@ def test_native_pipeline_survives_replica_kill(broker):
                 for p in (pa, pb, vm):
                     await _wait_ready(p, b"ready (durable)")
                 bus = await _tcp_bus(broker)
-                docs, sents = 12, 3
+                # enough docs that the pipelined workers (r5: coalesced,
+                # multiple requests in flight) cannot drain them inside the
+                # kill window — the count_at_kill guard below verifies
+                docs, sents = 48, 3
                 for i in range(docs):
                     text = ". ".join(f"Sentence {i} {j} about tensors"
                                      for j in range(sents)) + "."
@@ -1075,7 +1183,7 @@ def test_native_pipeline_survives_replica_kill(broker):
                             id=f"doc-{i}", source_url=f"http://u/{i}",
                             raw_text=text,
                             timestamp_ms=current_timestamp_ms())))
-                await asyncio.sleep(0.02)  # deliveries in flight, unacked
+                await asyncio.sleep(0.01)  # deliveries in flight, unacked
                 expected = docs * sents
                 count_at_kill = store.count()
                 pa.kill()  # SIGKILL: no ack, no goodbye
@@ -1105,7 +1213,8 @@ def test_native_pipeline_survives_replica_kill(broker):
     asyncio.run(scenario())
 
 
-def test_native_pipeline_survives_engine_restart(broker):
+def test_native_pipeline_survives_engine_restart(fresh_broker):
+    broker = fresh_broker
     """The OTHER half of the two-plane failure semantics (SURVEY.md §7 hard
     part 6): the ENGINE plane drops abruptly (TCP connection severed with
     embed hops potentially in flight) and more documents arrive during the
