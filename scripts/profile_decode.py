@@ -21,10 +21,13 @@ Usage: python scripts/profile_decode.py [--quick]
 from __future__ import annotations
 
 import json
+import pathlib
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 GEOMETRIES = {
     "tinyllama_1b": dict(vocab_size=32000, hidden_size=2048, num_layers=22,
@@ -103,8 +106,8 @@ def main() -> None:
 
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-        for NEW in ([128] if quick else [128, 896]):
-            for B in (8, 32, 128):
+        for NEW in (128, 896):
+            for B in ((8, 128) if quick else (8, 32, 128)):
                 row = {"geometry": name, "batch": B, "prompt": P, "new": NEW,
                        "param_bytes": pbytes}
                 # KV bytes READ per step: full padded cache, both k and v

@@ -345,7 +345,7 @@ class GenBatcher(_BatcherBase):
                         self._queue.clear()
                         self._queued -= sum(self._size(c) for c in candidates)
                         try:
-                            take, keep = await loop.run_in_executor(
+                            take, retry, defer = await loop.run_in_executor(
                                 None, self._filter_candidates, sess,
                                 candidates)
                         except Exception as e:
@@ -355,8 +355,12 @@ class GenBatcher(_BatcherBase):
                             for p in candidates:
                                 if not p.future.done():
                                     p.future.set_exception(e)
-                            take, keep = [], []
-                        deferred.extend(keep)
+                            take, retry, defer = [], [], []
+                        # transiently rejected (batch full) go straight back:
+                        # a row may free at the next chunk boundary and they
+                        # must not wait out the whole session
+                        self._requeue(retry)
+                        deferred.extend(defer)
                         if take:
                             prep_fut = (loop.run_in_executor(
                                 None, self._do_prepare, sess, take), take)
@@ -402,15 +406,18 @@ class GenBatcher(_BatcherBase):
             # session's remaining chunks
             margin = min(8, max(1, sess.remaining_steps() // (2 * sess.chunk)))
         take: List = []
-        keep: List = []
+        retry: List = []   # transient rejection: no free row RIGHT NOW
+        defer: List = []   # permanent for this session: budget/prompt
         for item in candidates:
-            if (len(take) < sess.capacity()
-                    and sess.can_admit(item.prompt, item.max_new,
-                                       lookahead_chunks=margin)):
+            if len(take) >= sess.capacity():
+                # rows free as requests finish — retry next chunk boundary
+                retry.append(item)
+            elif sess.can_admit(item.prompt, item.max_new,
+                                lookahead_chunks=margin):
                 take.append(item)
             else:
-                keep.append(item)
-        return take, keep
+                defer.append(item)
+        return take, retry, defer
 
     def _do_prepare(self, sess, take: List):
         """Executor-side admission phase 1: prefill the newcomers WITHOUT

@@ -245,9 +245,22 @@ class LmEngine:
     def _place_params(self, params):
         """ONE home for parameter placement: megatron-sharded over the mesh's
         'tensor' axis when TP serving is on, plain device_put otherwise.
-        Used by __init__ and every online-fine-tune sync (update_params)."""
-        import jax
+        Used by __init__ and every online-fine-tune sync (update_params).
 
+        Params are cast to the model dtype AT REST: decode already computes
+        in model dtype (forward casts at trace time), so storing f32 only
+        doubled HBM residency (TinyLlama: 4.1 GB vs 2.1 GB) and made every
+        chunked-decode call re-convert the full parameter set (the fused
+        generate hoists the convert once per call; a chunk loop pays it per
+        chunk)."""
+        import jax
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(self.model_cfg.dtype)
+        params = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a, params)
         if self.mesh is None:
             return jax.device_put(params)
         from symbiont_tpu.parallel.sharding import (
@@ -405,11 +418,12 @@ class LmEngine:
         # (prefill, each decode_chunk) and NEVER across a yield — a stalled
         # SSE consumer must not starve concurrent generate()/generate_batch()
         # callers waiting on the same lock. This is safe because the KV cache
-        # is owned by this generator frame: decode_chunk is functional
-        # (params read-only, cache carried in and out as a value), so other
-        # engine calls interleaving between chunks can't observe or mutate
-        # this stream's state. The stream stays consumer-paced: nothing
-        # decodes while the consumer is parked between deltas.
+        # is owned by this generator frame: decode_chunk consumes the carry
+        # (cache/logits/pos/done are DONATED and reassigned each chunk;
+        # params read-only), so other engine calls interleaving between
+        # chunks can't observe or mutate this stream's state. The stream
+        # stays consumer-paced: nothing decodes while the consumer is
+        # parked between deltas.
         decode_s = 0.0
         with self._lock:
             # timers start inside the lock: decode_s counts this stream's own

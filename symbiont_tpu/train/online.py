@@ -62,8 +62,14 @@ class OnlineLmTrainer:
                       "tokens_pending": 0, "tokens_dropped": 0}
 
         # private copy: lm_train_step donates state, so training must never
-        # share buffers with the serving engine's live params
-        params = jax.tree.map(jnp.copy, lm.params)
+        # share buffers with the serving engine's live params. Master
+        # weights train in f32 regardless of the serving dtype — the engine
+        # stores params at model dtype (bf16) since r5, and optimizing bf16
+        # masters directly would lose update precision.
+        params = jax.tree.map(
+            lambda a: (jnp.array(a, dtype=jnp.float32, copy=True)
+                       if jnp.issubdtype(a.dtype, jnp.floating)
+                       else jnp.copy(a)), lm.params)
         self.state, self._tx = make_lm_train_state(params, learning_rate)
         if state_path and ckpt.train_state_exists(state_path):
             try:
