@@ -75,6 +75,17 @@ class _FakeQdrant(BaseHTTPRequestHandler):
         self._reply(200, {"result": {"config": {"params": {
             "vectors": {"size": col["dim"], "distance": "Cosine"}}}}})
 
+    def do_DELETE(self):
+        s = self.server.fake_store
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 2 and parts[0] == "collections":
+            if s["collections"].pop(parts[1], None) is not None:
+                self._reply(200, {"result": True, "status": "ok"})
+            else:
+                self._reply(404, {"status": {"error": "no collection"}})
+            return
+        self._reply(404, {"status": {"error": "not found"}})
+
     def do_POST(self):
         s = self.server.fake_store
         parts = self.path.strip("/").split("/")
