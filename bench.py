@@ -229,15 +229,28 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
     """Decode tok/s at batch 8 (+ TTFT), then the batch 32/64/128 sweep —
     decode is HBM-bandwidth-bound on weight reads, so aggregate tok/s
     scales with batch until the KV-cache traffic catches up (VERDICT r3
-    item 3: measure past batch 8)."""
+    item 3: measure past batch 8).
+
+    Each batch point also records ms/step and the achieved HBM
+    bandwidth-utilization (weights + full-cache KV reads per step over the
+    measured per-step time, against the chip's MEASURED pure-stream ceiling
+    — see docs/PERF.md's decode roofline section), so a
+    regression-from-roofline is visible in the archive (VERDICT r4 weak 3)."""
     import jax
     import jax.numpy as jnp
 
     from symbiont_tpu.models import gpt as gpt_mod
 
     cfg = gpt_mod.GPTConfig(dtype="bfloat16", **cfg_kw)
-    params = gpt_mod.init_params(jax.random.key(0), cfg)
+    # store weights AT model dtype: f32-at-rest doubled HBM residency and
+    # (on the chunked serving path) re-paid a full convert every chunk
+    params = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        gpt_mod.init_params(jax.random.key(0), cfg))
     params = jax.device_put(params)
+    param_bytes = sum(a.size * a.dtype.itemsize
+                      for a in jax.tree.leaves(params))
     rng = np.random.default_rng(2)
     P, NEW = 64, 128
     key_ = jax.random.key(0)
@@ -256,16 +269,18 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
         ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, P)), jnp.int32)
         mask = jnp.ones((B, P), jnp.int32)
         suffix = "" if B == 8 else f"_b{B}"
-        if B == 8:
-            run(B, ids, mask, 1)  # compile prefill + the 1-step scan (TTFT)
+        run(B, ids, mask, 1)    # compile prefill + the 1-step scan
         run(B, ids, mask, NEW)  # compile the NEW-step scan
+        # prefill + 1 step + dispatch/RTT, measured per batch: subtracted
+        # below so ms/step (and the HBM-roofline fields derived from it)
+        # reflect DECODE steps only, not the prompt forward (TTFT at B=8)
+        dt1 = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            run(B, ids, mask, 1)
+            dt1 = min(dt1, time.time() - t0)
         if B == 8:
-            ttft = float("inf")
-            for _ in range(3):
-                t0 = time.time()
-                run(B, ids, mask, 1)
-                ttft = min(ttft, time.time() - t0)
-            results[f"{key}_ttft_ms"] = round(ttft * 1000, 1)
+            results[f"{key}_ttft_ms"] = round(dt1 * 1000, 1)
         dt = float("inf")
         for _ in range(3):
             t0 = time.time()
@@ -274,9 +289,24 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
         results[f"{key}_tok_per_s{suffix}"] = round(B * NEW / dt, 1)
         if B == 8:
             results[f"{key}_tok_per_s_stream"] = round(NEW / dt, 1)
+        # roofline context: bytes the chip must stream per decode step
+        # (weights once — shared by all rows — plus the full padded KV
+        # cache both k and v) over the measured per-step time, vs the
+        # MEASURED sustainable stream bandwidth of this chip (581 GB/s on
+        # a pure reduce-sum of 3 GB; the 819 GB/s paper number is not
+        # reachable by any kernel we measured)
+        ms_step = (dt - dt1) / (NEW - 1) * 1000
+        kv_bytes = (2 * cfg.num_layers * B * (P + NEW) * cfg.kv_heads
+                    * cfg.head_dim * 2)
+        gbps = (param_bytes + kv_bytes) / (ms_step / 1000) / 1e9
+        results[f"{key}_ms_per_step{suffix}"] = round(ms_step, 2)
+        results[f"{key}_hbm_gbps{suffix}"] = round(gbps, 1)
+        results[f"{key}_hbm_util_vs_measured_pct{suffix}"] = round(
+            100 * gbps / 581.0, 1)
         log(f"lm decode ({label} geometry, bf16, batch {B}, prompt {P}, "
             f"{NEW} new): {B * NEW / dt:.0f} tokens/s/chip "
-            f"({NEW / dt:.0f} tok/s/stream)"
+            f"({NEW / dt:.0f} tok/s/stream, {ms_step:.2f} ms/step, "
+            f"{gbps:.0f} GB/s = {100 * gbps / 581.0:.0f}% of measured peak)"
             + (f", TTFT {results[f'{key}_ttft_ms']:.0f}ms" if B == 8 else ""))
 
 
@@ -368,18 +398,27 @@ def _compute_mfu_geometry(results: dict, peak: float, dim: int, B: int,
     # _bench_decode_geometry for why block_until_ready alone is not enough
     # through the network-attached runtime
     np.asarray(loop(eng.params, ids, mask))
-    best = float("inf")
-    for _ in range(3):
+    # median-of-5 WITH min/max: these are the A/B-able primary metrics
+    # (device-bound; measured spread ±1-2% vs the tunnel metrics' 2.5×),
+    # so the archive must carry the evidence of that stability
+    samples = []
+    for _ in range(5):
         t0 = time.time()
         np.asarray(loop(eng.params, ids, mask))
-        best = min(best, time.time() - t0)
+        samples.append(time.time() - t0)
+    dt, dt_lo, dt_hi = med_min_max(samples)  # of times; invert for rates
     tokens = N * B * S
     flops = tokens * L * (8 * H * H + 4 * H * I) + N * B * L * 4 * H * S * S
     results[f"mfu_compute_only{key_suffix}_pct"] = round(
-        100 * flops / best / peak, 2)
-    results[f"compute_only{key_suffix}_emb_per_s"] = round(N * B / best, 1)
+        100 * flops / dt / peak, 2)
+    results[f"mfu_compute_only{key_suffix}_pct_min"] = round(
+        100 * flops / dt_hi / peak, 2)
+    results[f"mfu_compute_only{key_suffix}_pct_max"] = round(
+        100 * flops / dt_lo / peak, 2)
+    results[f"compute_only{key_suffix}_emb_per_s"] = round(N * B / dt, 1)
     log(f"compute-only (no transfers, H={H} L={L}, [{B},{S}] bf16): "
-        f"{N * B / best:.0f} emb/s, MFU {100 * flops / best / peak:.1f}%")
+        f"{N * B / dt:.0f} emb/s, MFU {100 * flops / dt / peak:.1f}% "
+        f"[{100 * flops / dt_hi / peak:.1f}–{100 * flops / dt_lo / peak:.1f}]")
 
 
 # ------------------------------------------------------------ full-stack e2e
@@ -609,6 +648,123 @@ def bench_e2e(results: dict) -> None:
         log(f"e2e search (HTTP /api/search/semantic, 10 warm + 100 timed): "
             f"p50 {p50:.1f}ms [{p50_lo:.1f}–{p50_hi:.1f}], "
             f"p95 {results['e2e_search_p95_ms']:.1f}ms")
+
+        # ---- full-stack generation: POST /api/generate-text → bus →
+        # continuous-batching LM → SSE out of the C++ gateway (VERDICT r4
+        # next-8; reference SSE path: api_service/src/main.rs:190-270)
+        import threading
+        import uuid as _uuid
+
+        from symbiont_tpu.config import LmConfig
+        from symbiont_tpu.engine.batcher import GenBatcher
+        from symbiont_tpu.engine.lm import LmEngine
+        from symbiont_tpu.services.text_generator import TextGeneratorService
+
+        lm = LmEngine(LmConfig(
+            enabled=True, arch="gpt2", hidden_size=768, num_layers=12,
+            num_heads=12, intermediate_size=3072, max_positions=512,
+            dtype="bfloat16", prompt_buckets=[64], new_token_buckets=[64],
+            stream_chunk=16, gen_max_batch=16))
+        gen_batcher = GenBatcher(lm)
+        await gen_batcher.start()
+        tg_bus = TcpBus("127.0.0.1", bport)
+        await tg_bus.connect()
+        tg = TextGeneratorService(tg_bus, lm_batcher=gen_batcher,
+                                  lm_stream=lm.generate_stream,
+                                  train_on_ingest=False)
+        await tg.start()
+
+        sse_events: list = []  # (wall-time, parsed event dict)
+        sse_stop = threading.Event()
+
+        def sse_listen():
+            conn = http_client.HTTPConnection("127.0.0.1", api_port,
+                                              timeout=300)
+            conn.request("GET", "/api/events")
+            r = conn.getresponse()
+            while not sse_stop.is_set():
+                line = r.readline()
+                if not line:
+                    break
+                if line.startswith(b"data:"):
+                    try:
+                        sse_events.append(
+                            (time.time(), _json.loads(line[5:].strip())))
+                    except ValueError:
+                        pass
+
+        sse_thread = threading.Thread(target=sse_listen, daemon=True)
+        sse_thread.start()
+        await asyncio.sleep(0.3)  # SSE registered before the first event
+
+        N_GEN, GEN_TOKENS = 16, 64
+        prompt = "the tensor processing unit likes large matrix multiplies "
+
+        def post_gen(stream=False):
+            tid = str(_uuid.uuid4())
+            body = {"task_id": tid, "prompt": prompt,
+                    "max_length": GEN_TOKENS}
+            if stream:
+                body["stream"] = True
+            status, _ = http("POST", "/api/generate-text", body)
+            assert status == 200, status
+            return tid
+
+        def finals(ids):
+            return {e["original_task_id"]: (t, e) for t, e in sse_events
+                    if e.get("generated_text") is not None
+                    and e.get("original_task_id") in ids}
+
+        async def gen_wave(n):
+            t0 = time.time()
+            ids = {await loop.run_in_executor(None, post_gen)
+                   for _ in range(n)}
+            deadline = time.time() + 180
+            while time.time() < deadline and len(finals(ids)) < n:
+                await asyncio.sleep(0.05)
+            done = finals(ids)
+            assert len(done) == n, f"only {len(done)}/{n} generations"
+            toks = sum(len(e["generated_text"].encode())
+                       for _, e in done.values())
+            return toks, max(t for t, _ in done.values()) - t0
+
+        await gen_wave(N_GEN)  # warm: compiles session + admission shapes
+        toks, dt_gen = await gen_wave(N_GEN)
+        results["e2e_gen_clients"] = N_GEN
+        results["e2e_gen_tok_per_s"] = round(toks / dt_gen, 1)
+        log(f"e2e generation ({N_GEN} concurrent clients, {GEN_TOKENS} new "
+            f"tokens each, continuous batcher): {toks} tokens in "
+            f"{dt_gen:.2f}s → {toks / dt_gen:.0f} tok/s through the gateway")
+
+        # streaming first-delta latency (stream=true rides the per-request
+        # chunked decode; deltas ride events.text.generated.partial → SSE)
+        warm_tid = post_gen(stream=True)  # warm the streaming executables
+        deadline = time.time() + 120     # first compile can take tens of s
+        while time.time() < deadline and not finals({warm_tid}):
+            await asyncio.sleep(0.1)
+        deltas = []
+        for _ in range(3):
+            t0 = time.time()
+            tid = await loop.run_in_executor(None, post_gen, True)
+            deadline = time.time() + 60
+            first = None
+            while time.time() < deadline and first is None:
+                for t, e in sse_events:
+                    if (e.get("original_task_id") == tid
+                            and e.get("text_delta")):
+                        first = t - t0
+                        break
+                await asyncio.sleep(0.01)
+            assert first is not None, "no streaming delta arrived"
+            deltas.append(first * 1000)
+        results["e2e_first_delta_ms"] = round(sorted(deltas)[1], 1)
+        log(f"e2e streaming: first SSE text delta "
+            f"{results['e2e_first_delta_ms']:.0f}ms (median of 3, full "
+            f"HTTP→bus→decode→SSE path)")
+        sse_stop.set()
+        await tg.stop()
+        await gen_batcher.close()
+        await tg_bus.close()
         await svc.stop()
         await bus.close()
 
@@ -626,8 +782,12 @@ def bench_e2e(results: dict) -> None:
                 batch_buckets=[1, 8, 32, 128, 512], max_batch=512,
                 dtype="bfloat16", data_parallel=False,
                 host_prep_chunk=256, max_inflight_flushes=4))
+            # capacity covers the whole 9.4k-point corpus: crossing a
+            # capacity block MID-RUN would invalidate the warmed fused
+            # executables and send the timed searches down the 2-hop
+            # fallback (observed: p50 110 ms → 365 ms)
             store = VectorStore(VectorStoreConfig(dim=384, data_dir=td,
-                                                  shard_capacity=8192))
+                                                  shard_capacity=16384))
             asyncio.run(drive(store, eng))
     except Exception:
         import traceback
@@ -669,6 +829,14 @@ def render_doc(r: dict, source_name: str) -> str:
     hand-copied values from an unarchived run, with transposed TTFT rows).
     tests/test_perf_doc.py re-renders from the named archive and asserts the
     committed file matches byte-for-byte."""
+    legacy = "tunnel_emb_per_s" not in r
+    if legacy:
+        # pre-r5 archive: `value` WAS the tunnel-bound number
+        r = dict(r)
+        r["tunnel_emb_per_s"] = r["value"]
+        for suf in ("min", "max", "samples"):
+            if f"value_{suf}" in r:
+                r[f"tunnel_emb_per_s_{suf}"] = r[f"value_{suf}"]
     f = {k: _fmt(v) for k, v in r.items() if isinstance(v, (int, float))}
 
     def rng(base: str) -> str:
@@ -677,102 +845,68 @@ def render_doc(r: dict, source_name: str) -> str:
         lo, hi = f.get(f"{base}_min"), f.get(f"{base}_max")
         return f" [{lo}–{hi}]" if lo is not None else ""
 
-    primary = f"**{f['value']} emb/s/chip**"
-    if "value_min" in f:
-        primary += (f" — median of {f['value_samples']} runs "
-                    f"[{f['value_min']}–{f['value_max']}]")
+    # --- tier 1: device-bound primaries (A/B-able round over round) -------
+    primary_caption = (
+        "LEGACY pre-r5 archive: `value` was the TUNNEL-BOUND embedding "
+        "throughput then (not A/B-able — see the tunnel tier below)"
+        if legacy else
+        "compute-only MiniLM-384 embedding throughput, device-resident "
+        "batches — DEVICE-BOUND (measured spread ±1-2%; the A/B anchor)")
     rows = [
-        ("`value` (primary)",
-         "MiniLM-L6 geometry embedding, bf16, 2k mixed-length corpus",
-         primary),
-        ("`vs_baseline`",
-         f"÷ reference policy (`ref_policy_emb_per_s` = {f['ref_policy_emb_per_s']})",
-         f"**{f['vs_baseline']}×**"),
-        ("`ingest_10k_emb_per_s`",
-         "10k-corpus bulk ingest (one embed_texts call)",
-         f"{f['ingest_10k_emb_per_s']} emb/s"),
-        ("`upsert_10k_points_per_s`",
-         f"10k-point WAL-durable upsert (`upsert_10k_s` {f['upsert_10k_s']} s)",
-         f"{f['upsert_10k_points_per_s']} points/s"),
-        ("`mfu_pct`",
-         "useful-FLOPs MFU of the primary run (real tokens, real lengths)",
-         f"{f['mfu_pct']} %"),
-        ("`hw_util_incl_padding_pct`",
-         "same run, counting all padded compute (length buckets AND "
-         "batch-row padding) the chip executed",
-         f"{f['hw_util_incl_padding_pct']} %"),
+        ("`value` (primary)", primary_caption,
+         f"**{f['value']} emb/s/chip**"),
         ("`mfu_compute_only_pct`",
          "compute-only MFU, MiniLM-384 geometry, no transfers (see below)",
-         f"**{f['mfu_compute_only_pct']} %**"),
-        ("`compute_only_emb_per_s`",
-         "compute-only throughput ([1024, 64] bf16 batches)",
-         f"{f['compute_only_emb_per_s']} emb/s"),
+         f"**{f['mfu_compute_only_pct']}"
+         f"{rng('mfu_compute_only_pct')} %**"),
     ]
     if "mfu_compute_only_768_pct" in f:
         rows += [
             ("`mfu_compute_only_768_pct`",
              "compute-only MFU, mpnet-768 geometry (the reference's default "
              "model, preprocessing_service/src/main.rs:305)",
-             f"**{f['mfu_compute_only_768_pct']} %**"),
-            ("`compute_only_768_emb_per_s`",
-             "compute-only throughput at 768 geometry",
-             f"{f['compute_only_768_emb_per_s']} emb/s"),
+             f"**{f['mfu_compute_only_768_pct']}"
+             f"{rng('mfu_compute_only_768_pct')} %** "
+             f"({f['compute_only_768_emb_per_s']} emb/s)"),
         ]
     if "mfu_compute_only_1024_pct" in f:
         rows += [
             ("`mfu_compute_only_1024_pct`",
              "compute-only MFU, e5-large geometry (1024-d, 24 layers — "
              "BASELINE.md config #3)",
-             f"**{f['mfu_compute_only_1024_pct']} %**"),
-            ("`compute_only_1024_emb_per_s`",
-             "compute-only throughput at e5-large geometry",
-             f"{f['compute_only_1024_emb_per_s']} emb/s"),
+             f"**{f['mfu_compute_only_1024_pct']}"
+             f"{rng('mfu_compute_only_1024_pct')} %** "
+             f"({f['compute_only_1024_emb_per_s']} emb/s)"),
         ]
     rows += [
-        ("`search_split_p50_ms` / `p95`",
-         "split embed→search, 10k corpus, top-5",
-         f"{f['search_split_p50_ms']}{rng('search_split_p50_ms')} / "
-         f"{f['search_split_p95_ms']} ms"),
-        ("`search_fused_p50_ms` / `p95`",
-         "FUSED single-program path, same query set",
-         f"**{f['search_fused_p50_ms']}{rng('search_fused_p50_ms')} / "
-         f"{f['search_fused_p95_ms']} ms**"),
-        ("`rerank_pairs_per_s`",
-         f"cross-encoder rerank, 256 pairs pad-128 (`rerank_hop_ms` "
-         f"{f['rerank_hop_ms']})",
-         f"{f['rerank_pairs_per_s']} pairs/s"),
         ("`gpt2_124m_tok_per_s`",
-         "GPT-2 124M geometry decode, bf16, batch 8",
+         "GPT-2 124M geometry decode, bf16, batch 8 "
+         f"(TTFT {f['gpt2_124m_ttft_ms']} ms)",
          f"**{f['gpt2_124m_tok_per_s']} tok/s/chip** "
          f"({f['gpt2_124m_tok_per_s_stream']}/stream)"),
-        ("`gpt2_124m_ttft_ms`",
-         "prefill(64) + first decode step, warm",
-         f"{f['gpt2_124m_ttft_ms']} ms"),
         ("`tinyllama_1b_tok_per_s`",
-         "TinyLlama 1.1B geometry (GQA 32/4) decode, batch 8",
+         "TinyLlama 1.1B geometry (GQA 32/4) decode, batch 8 "
+         f"(TTFT {f['tinyllama_1b_ttft_ms']} ms)",
          f"**{f['tinyllama_1b_tok_per_s']} tok/s/chip** "
          f"({f['tinyllama_1b_tok_per_s_stream']}/stream)"),
-        ("`tinyllama_1b_ttft_ms`",
-         "same, time-to-first-token",
-         f"{f['tinyllama_1b_ttft_ms']} ms"),
     ]
     for gkey, glabel in (("gpt2_124m", "GPT-2 124M"),
                          ("tinyllama_1b", "TinyLlama 1.1B")):
         for b in (32, 64, 128):
             if f"{gkey}_tok_per_s_b{b}" in f:
+                util = f.get(f"{gkey}_hbm_util_vs_measured_pct_b{b}")
+                extra = (f"; {f[f'{gkey}_ms_per_step_b{b}']} ms/step, "
+                         f"{util}% of measured HBM peak" if util else "")
                 rows.append((
                     f"`{gkey}_tok_per_s_b{b}`",
-                    f"{glabel} decode at batch {b} (decode is weight-read "
-                    f"bound — aggregate tok/s scales with batch)",
+                    f"{glabel} decode at batch {b}{extra}",
                     f"**{f[f'{gkey}_tok_per_s_b{b}']} tok/s/chip**"))
     rows += [
         ("`stream_first_delta_ms`",
-         "streaming: first SSE text delta (chunk 16)",
+         "streaming: first SSE text delta (chunk 16, engine-plane)",
          f"{f['stream_first_delta_ms']} ms"),
-        ("`stream_total_128_s`",
-         "streaming: full 128-token stream",
-         f"{f['stream_total_128_s']} s"),
     ]
+    # --- tier 2: full-stack (what a user of the running stack sees) ------
     if "e2e_search_p50_ms" in f:
         rows += [
             ("`e2e_search_p50_ms` / `p95`",
@@ -784,14 +918,81 @@ def render_doc(r: dict, source_name: str) -> str:
             ("`e2e_ingest_emb_per_s`",
              f"FULL-STACK ingest: HTTP submit-url → C++ perception scrape → "
              f"C++ preprocessing ({f.get('e2e_preproc_replicas', '4')} "
-             f"queue-group replicas) → engine embed → upsert; "
+             f"pipelined queue-group replicas, coalesced embed hops) → "
+             f"engine embed → coalesced upsert; "
              f"{f['e2e_ingest_sentences']} sentences in "
              f"{f['e2e_ingest_s']} s",
              f"**{f['e2e_ingest_emb_per_s']} emb/s**"),
         ]
+    if "e2e_gen_tok_per_s" in f:
+        rows += [
+            ("`e2e_gen_tok_per_s`",
+             f"FULL-STACK generation: {f.get('e2e_gen_clients', '16')} "
+             f"concurrent clients POST /api/generate-text → bus → "
+             f"continuous-batching LM (GPT-2 geometry) → SSE out of the C++ "
+             f"gateway (reference SSE path: api_service/src/main.rs:190-270)",
+             f"**{f['e2e_gen_tok_per_s']} tok/s**"),
+            ("`e2e_first_delta_ms`",
+             "FULL-STACK streaming: POST stream=true → first SSE text delta "
+             "through gateway + bus + chunked decode",
+             f"{f['e2e_first_delta_ms']} ms"),
+        ]
+    # --- tier 3: tunnel-bound (informational; carries its spread) --------
+    tunnel = f"{f['tunnel_emb_per_s']}"
+    if "tunnel_emb_per_s_min" in f:
+        tunnel += (f" [{f['tunnel_emb_per_s_min']}–"
+                   f"{f['tunnel_emb_per_s_max']}] (median of "
+                   f"{f['tunnel_emb_per_s_samples']})")
+    rows += [
+        ("`tunnel_emb_per_s`",
+         "TUNNEL-BOUND: 2k mixed-length corpus through host↔device "
+         "transfers on this link (archived r1–r4 history varies 2.5× at "
+         "zero code change — never A/B this across rounds)",
+         f"{tunnel} emb/s"),
+        ("`vs_baseline`",
+         f"tunnel policy ratio ÷ reference policy "
+         f"(`ref_policy_emb_per_s` = {f['ref_policy_emb_per_s']}; both "
+         f"sides measured in the same minutes, so link drift largely "
+         f"cancels)",
+         f"**{f['vs_baseline']}×**"),
+        ("`ingest_10k_emb_per_s`",
+         "10k-corpus bulk ingest (one embed_texts call, tunnel-bound)",
+         f"{f['ingest_10k_emb_per_s']} emb/s"),
+        ("`upsert_10k_points_per_s`",
+         f"10k-point WAL-durable upsert (`upsert_10k_s` {f['upsert_10k_s']} s)",
+         f"{f['upsert_10k_points_per_s']} points/s"),
+        ("`mfu_pct`",
+         "useful-FLOPs MFU of the tunnel run (real tokens, real lengths)",
+         f"{f['mfu_pct']} %"),
+        ("`hw_util_incl_padding_pct`",
+         "same run, counting all padded compute the chip executed",
+         f"{f['hw_util_incl_padding_pct']} %"),
+        ("`search_split_p50_ms` / `p95`",
+         "split embed→search, 10k corpus, top-5 (tunnel: 2 device RTTs)",
+         f"{f['search_split_p50_ms']}{rng('search_split_p50_ms')} / "
+         f"{f['search_split_p95_ms']} ms"),
+        ("`search_fused_p50_ms` / `p95`",
+         "FUSED single-program path, same query set (1 device RTT)",
+         f"**{f['search_fused_p50_ms']}{rng('search_fused_p50_ms')} / "
+         f"{f['search_fused_p95_ms']} ms**"),
+        ("`rerank_pairs_per_s`",
+         f"cross-encoder rerank, 256 pairs pad-128 (`rerank_hop_ms` "
+         f"{f['rerank_hop_ms']})",
+         f"{f['rerank_pairs_per_s']} pairs/s"),
+    ]
     table = "\n".join(f"| {a} | {b} | {c} |" for a, b, c in rows)
     e2e_section = ""
     if "e2e_search_p50_ms" in f:
+        gen_bullet = ""
+        if "e2e_gen_tok_per_s" in f:
+            gen_bullet = (
+                f"- Generation: {f.get('e2e_gen_clients', '16')} concurrent "
+                f"clients through the gateway sustain "
+                f"**{f['e2e_gen_tok_per_s']} tok/s** on one continuous-"
+                f"batching decode session; a stream=true request's first "
+                f"SSE text delta lands in {f['e2e_first_delta_ms']} ms "
+                f"(HTTP → bus → prefill + one 16-token chunk → partial "
+                f"event → SSE fan-out).\n")
         e2e_section = f"""## The full-stack tier (what a user of the running stack sees)
 
 `e2e_*` numbers boot the REAL stack — native symbus broker, C++ api_gateway,
@@ -799,7 +1000,9 @@ C++ perception/preprocessing/vector_memory workers, TPU engine plane — and
 drive it over HTTP (`bench_e2e` in bench.py). The delta to the engine-plane
 numbers is everything the reference's users also pay: HTTP parse, two bus
 round-trips, JSON (de)serialization of 384-float embeddings, queue-group
-routing.
+routing. Note: this whole stack shares ONE host core in this sandbox, so
+host-side costs that would vanish on a normal multi-core box are visible
+here.
 
 - Search: engine-plane fused p50 {f['search_fused_p50_ms']} ms vs
   full-stack p50 **{f['e2e_search_p50_ms']} ms** — the C++ gateway probes
@@ -809,17 +1012,18 @@ routing.
   on a jittery link, so their small delta can land either side of zero.
   The reference-parity 2-hop fallback costs two device round-trips instead
   (`search_split_p50_ms` = {f['search_split_p50_ms']} ms).
-- Ingest: engine-plane bulk {f['ingest_10k_emb_per_s']} emb/s →
-  full-stack **{f['e2e_ingest_emb_per_s']} emb/s** through per-document
-  scrape→split→embed request-reply hops
-  ({f.get('e2e_preproc_replicas', '4')} preprocessing replicas on the
-  queue group; the engine micro-batcher aggregates their concurrent embed
-  calls). Each replica is a synchronous one-doc-at-a-time worker whose
-  embed hop pays a device round-trip, so on this tunnel the rate is
-  RTT-bound — the lever is replica count (more in-flight docs → bigger
-  aggregated device batches), and on a locally-attached chip the same
-  stack runs the hop in ~ms.
-
+- Ingest: full-stack **{f['e2e_ingest_emb_per_s']} emb/s** steady-state
+  (the r4→r5 rework took this from 353: the worker shells are now
+  pipelined event loops that coalesce multiple documents per engine hop,
+  vectors cross the engine plane as base64 f32 blocks, and f32→JSON text
+  formatting uses ryu). The remaining gap to the engine-plane bulk number
+  ({f['ingest_10k_emb_per_s']} emb/s, one in-process call) is the measured
+  floor of this environment: every engine request-reply hop costs ~100 ms
+  of tunnel RTT regardless of batch size (512-row flushes amortize it to
+  ~0.2 ms/sentence), and the one shared host core runs every JSON/bus/HTTP
+  byte of 15 processes. On a locally-attached multi-core deployment both
+  terms collapse.
+{gen_bullet}
 """
     mfu768 = ""
     if "mfu_compute_only_768_pct" in f:
@@ -835,19 +1039,30 @@ Regenerate with `python bench.py --render-doc {source_name} > docs/PERF.md`;
 `tests/test_perf_doc.py` asserts this file matches that archive exactly.
 
 All numbers measured on one real **TPU v5 lite (v5e) chip** reached over a
-network tunnel. Synthetic weights — throughput is weight-value independent,
-but it means **semantic quality is unvalidated in this sandbox**: no egress,
-so the gated golden tier against a real pretrained checkpoint
-(`tests/test_real_assets.py`, `SYMBIONT_MODEL_DIR`) has never executed here —
-run it where a fetched snapshot exists (`scripts/fetch_model.py`), then check
-in golden vectors (`scripts/make_goldens.py` → `tests/test_golden_vectors.py`)
-so torch-free hosts re-validate semantic fidelity offline; the flow itself is
-proven in-suite on a transformers-serialized synthetic checkpoint.
+network tunnel. Synthetic weights (`"semantic_validation":
+"synthetic-only"` in the JSON line) — throughput is weight-value
+independent, but it means **semantic quality is unvalidated in this
+sandbox**: no egress, so the gated golden tier against a real pretrained
+checkpoint (`tests/test_real_assets.py`, `SYMBIONT_MODEL_DIR`) has never
+executed here — run it where a fetched snapshot exists
+(`scripts/fetch_model.py`), then check in golden vectors
+(`scripts/make_goldens.py` → `tests/test_golden_vectors.py`) so torch-free
+hosts re-validate semantic fidelity offline; the flow itself is proven
+in-suite on a transformers-serialized synthetic checkpoint.
 Reproduce with `python bench.py`: it prints ONE JSON line whose fields carry
 **every number in the table below** (the driver archives that line as
-`BENCH_r{{N}}.json` each round — the archived line is authoritative; tunnel
-load makes individual runs vary by ~±20%, so compare fields, not memories of
-fields). `--quick` runs only the primary metric.
+`BENCH_r{{N}}.json` each round — the archived line is authoritative).
+
+**Which fields are comparable across rounds.** The JSON line's
+`primary_metrics` list names them: device-bound numbers (compute-only MFU
+family, decode ms/step) move ±1-2% run to run, and the full-stack `e2e_*`
+tier is dominated by its own pipeline, so regressions there are real. The
+tunnel-bound fields (`tunnel_emb_per_s`, `ingest_10k_*`, `search_*`,
+`rerank_*`) ride a link whose bandwidth drifts on the scale of hours — the
+archived r1–r4 history spans **2.5×** on `tunnel_emb_per_s` with zero code
+change (r4's min/max: 3,483–8,663 within ONE run). They are reported with
+min/max spread and must never be A/B'd across rounds. (Earlier revisions of
+this doc claimed "~±20%" — the archive itself refutes that.)
 
 The reference publishes no numbers at all (BASELINE.md), so the baseline
 column is the reference's *policy* measured on identical hardware: fixed
@@ -898,7 +1113,41 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{e2e_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{e2e_section}## The decode roofline (measured, r5)
+
+Decode is weight-read bound, so the honest roofline needs the chip's
+MEASURED bandwidth, not the paper number. Measured on this v5e via
+microbenchmarks (scripts/profile_decode.py + ad-hoc, r5 logs):
+
+- pure stream (reduce-sum over 3 GB): **581 GB/s** (the 819 GB/s paper
+  figure is not reachable by any kernel we measured);
+- serially-dependent weight-streaming matmuls (decode's exact access
+  pattern — each layer's matmul waits on the previous): **~90–220 GB/s**
+  depending on shape, batch-independent (B=8 and B=128 chains measure the
+  same). This is a compiler/hardware pipelining property, not model code.
+
+Against that: TinyLlama batch-8 decode streams
+{f.get('tinyllama_1b_hbm_gbps', '—')} GB/s =
+**{f.get('tinyllama_1b_hbm_util_vs_measured_pct', '—')}% of the measured
+pure-stream peak** — small-batch decode is already at the wall. At batch
+128 the per-step bytes grow only 1.25× (weights dominate; KV reads are
+`{f.get('tinyllama_1b_hbm_gbps_b128', '—')}` GB/s effective) but the chain
+throughput drops toward the serial-matmul ceiling — the batch sweep's
+`*_hbm_util_vs_measured_pct_b*` fields archive exactly where each point
+sits, so a regression-from-roofline is visible (VERDICT r4 weak #3).
+
+What r5 changed, measured on the CHUNKED serving path (the one streaming /
+continuous batching actually runs): donating the KV-cache carry across the
+chunk-call boundary (gpt.py `_decode_chunk_jit`) removed an input+output
+double-residency that thrashed HBM at serving sizes — TinyLlama b128 with
+a 960-slot cache went **385 → 19.8 ms/step (19.5×)**, b128×192 17.8 →
+14.3 ms, b8 6.6 → 4.8 ms; storing params at model dtype (bf16) halved
+their residency and removed a full f32→bf16 convert per chunk. Ablations
+(profile_decode.py): sampling is INNOCENT — greedy-argmax ≡ top-k
+sampling ≡ no-top-k within noise at every batch, so the per-row top-k
+hypothesis from r4 is dead.
+
+## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.
@@ -913,13 +1162,16 @@ co-located.
 
 ## Methodology notes
 
-- Headline metrics (primary emb/s, both search p50s) are **median-of-5**
-  with min/max archived alongside (`*_min`/`*_max`) — single samples on
-  this link are noise: measured floor per engine call = one device RTT
-  (~110 ms here) + result bytes / tunnel bandwidth, and both terms vary
-  run to run by ±20%+. Round-over-round comparisons must overlap error
-  bars before claiming a regression (the r02→r03 "27% dip" was exactly
-  this: one sample vs one sample).
+- The PRIMARY metrics are device-bound (`primary_metrics` in the JSON
+  line): compute-only MFU family as median-of-5 with min/max, decode
+  ms/step as best-of-3. Tunnel-touching metrics (tunnel_emb_per_s, search
+  p50s) are median-of-5 with min/max archived alongside
+  (`*_min`/`*_max`) — single samples on this link are noise: measured
+  floor per engine call = one device RTT (~110 ms here) + result bytes /
+  tunnel bandwidth, and both terms drift by hours-scale factors (2.5×
+  observed across the r1–r4 archives). Round-over-round comparisons of
+  tunnel-bound fields are meaningless; the r02→r03 "27% dip" was exactly
+  this: one sample vs one sample.
 - Secondary metrics remain best-of-3 (tunnel jitter is one-sided; min is
   the honest estimate of chip-side cost).
 - Warmup compiles every (length-bucket, batch-bucket) executable the timed
@@ -1029,12 +1281,44 @@ def main() -> None:
             bench_e2e(results)
 
     log(f"total bench time {time.time() - t_start:.0f}s")
+    # tunnel-bound embedding throughput: informational-with-spread, NOT the
+    # headline — archived r1-r4 history shows 2.5× run-to-run variance on
+    # this link with zero code change (VERDICT r4 weak #1 / next-2)
+    results["tunnel_emb_per_s"] = round(eps_ours, 1)
+    results["tunnel_emb_per_s_min"] = results.pop("value_min")
+    results["tunnel_emb_per_s_max"] = results.pop("value_max")
+    results["tunnel_emb_per_s_samples"] = results.pop("value_samples")
+    if "compute_only_emb_per_s" in results:
+        # the headline is DEVICE-BOUND (A/B-able round over round: measured
+        # spread ±1-2%): compute-only embedding throughput at the primary
+        # geometry. The tunnel number stays in the archive with its spread.
+        metric = ("compute-only embeddings/sec/chip (MiniLM-L6 geometry, "
+                  "bf16, device-resident batches)")
+        value = results["compute_only_emb_per_s"]
+    else:  # --quick: only the tunnel metric was measured
+        metric = ("embeddings/sec/chip (MiniLM-L6 geometry, bf16, "
+                  "mixed-length corpus, TUNNEL-BOUND)")
+        value = round(eps_ours, 1)
     line = {
-        "metric": "embeddings/sec/chip (MiniLM-L6 geometry, bf16, mixed-length corpus)",
-        "value": round(eps_ours, 1),
+        "metric": metric,
+        "value": value,
         "unit": "embeddings/s",
         "vs_baseline": round(eps_ours / eps_ref, 2),
         "ts": int(time.time()),
+        # throughput numbers come from synthetic weights (no egress in this
+        # sandbox): they are weight-value independent, but NO consumer may
+        # mistake them for a semantically validated model (VERDICT r4 next-6)
+        "semantic_validation": "synthetic-only",
+        # the fields a round-over-round comparison should use (device-bound
+        # or full-stack; everything tunnel-bound carries min/max spread)
+        "primary_metrics": [
+            "compute_only_emb_per_s", "mfu_compute_only_pct",
+            "mfu_compute_only_768_pct", "mfu_compute_only_1024_pct",
+            "gpt2_124m_ms_per_step_b128", "tinyllama_1b_ms_per_step_b128",
+            "tinyllama_1b_hbm_util_vs_measured_pct",
+            "e2e_ingest_emb_per_s", "e2e_search_p50_ms",
+            "e2e_gen_tok_per_s", "e2e_first_delta_ms",
+        ],
         **results,
     }
     print(json.dumps(line))

@@ -64,15 +64,31 @@ struct InflightBatch {
 
 }  // namespace
 
+// The broker counts EVERY delivery attempt toward max_deliver, including
+// ones a worker skips (dedupe of a copy it already holds, backpressure) —
+// so a skipped redelivery silently burns a retry. When the NEXT redelivery
+// would dead-letter the message, the worker must take it despite the skip
+// conditions: duplicate work / memory beats data loss.
+inline bool last_chance(const symbus::BusMsg& m, uint32_t max_deliver) {
+  auto it = m.headers.find("X-Symbus-Deliveries");
+  if (it == m.headers.end()) return false;  // core mode: no dead-letter
+  return (uint32_t)std::atoi(it->second.c_str()) + 1 >= max_deliver;
+}
+
+inline size_t env_size_t(const char* key, long dflt, long lo) {
+  long v = std::atol(symbiont::env_or(key, std::to_string(dflt)).c_str());
+  return (size_t)(v < lo ? lo : v);  // clamp BEFORE the size_t cast: a
+  // negative value must not wrap to 2^64 and disable the bound
+}
+
 int main() try {
   int engine_timeout_ms =
       std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
-  size_t max_inflight = (size_t)std::atoi(
-      symbiont::env_or("SYMBIONT_PREPROC_MAX_INFLIGHT", "3").c_str());
-  size_t max_batch_sents = (size_t)std::atoi(
-      symbiont::env_or("SYMBIONT_PREPROC_MAX_BATCH_SENTS", "128").c_str());
-  if (max_inflight < 1) max_inflight = 1;
-  if (max_batch_sents < 1) max_batch_sents = 1;
+  size_t max_inflight = env_size_t("SYMBIONT_PREPROC_MAX_INFLIGHT", 3, 1);
+  size_t max_batch_sents =
+      env_size_t("SYMBIONT_PREPROC_MAX_BATCH_SENTS", 128, 1);
+  uint32_t max_deliver = (uint32_t)std::atoi(
+      symbiont::env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
 
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
@@ -241,14 +257,16 @@ int main() try {
         bus.ack(*msg);  // permanent: the document has no content
         continue;
       }
-      if (pending_ids.count(d.raw.id)) {
+      if (pending_ids.count(d.raw.id) && !last_chance(*msg, max_deliver)) {
         // ack_wait redelivery of a doc still queued/in flight here:
         // embedding it again would duplicate downstream publishes; skip
         // WITHOUT ack (if our copy fails, a later redelivery re-enters
-        // because the id is erased on drop)
+        // because the id is erased on drop). On the final attempt the
+        // skip is overridden — a skipped delivery still counts toward
+        // max_deliver, and duplicate work beats dead-lettering the doc.
         continue;
       }
-      if (durable && ready.size() >= 256) {
+      if (durable && ready.size() >= 256 && !last_chance(*msg, max_deliver)) {
         // backpressure: leave the delivery unacked for redelivery instead
         // of growing a queue whose tail would blow past ack_wait anyway
         if (!ready_high_water_warned) {

@@ -57,15 +57,28 @@ struct InflightUpsert {
 
 }  // namespace
 
+// See preprocessing.cpp: a skipped redelivery still counts toward
+// max_deliver, so the final attempt must override the skip conditions.
+inline bool last_chance(const symbus::BusMsg& m, uint32_t max_deliver) {
+  auto it = m.headers.find("X-Symbus-Deliveries");
+  if (it == m.headers.end()) return false;  // core mode: no dead-letter
+  return (uint32_t)std::atoi(it->second.c_str()) + 1 >= max_deliver;
+}
+
+inline size_t env_size_t(const char* key, long dflt, long lo) {
+  long v = std::atol(symbiont::env_or(key, std::to_string(dflt)).c_str());
+  return (size_t)(v < lo ? lo : v);  // clamp BEFORE the size_t cast: a
+  // negative value must not wrap to 2^64 and disable the bound
+}
+
 int main() try {
   int engine_timeout_ms =
       std::atoi(symbiont::env_or("SYMBIONT_ENGINE_TIMEOUT_MS", "120000").c_str());
-  size_t max_inflight = (size_t)std::atoi(
-      symbiont::env_or("SYMBIONT_VECMEM_MAX_INFLIGHT", "3").c_str());
-  size_t max_batch_points = (size_t)std::atoi(
-      symbiont::env_or("SYMBIONT_VECMEM_MAX_BATCH_POINTS", "256").c_str());
-  if (max_inflight < 1) max_inflight = 1;
-  if (max_batch_points < 1) max_batch_points = 1;
+  size_t max_inflight = env_size_t("SYMBIONT_VECMEM_MAX_INFLIGHT", 3, 1);
+  size_t max_batch_points =
+      env_size_t("SYMBIONT_VECMEM_MAX_BATCH_POINTS", 256, 1);
+  uint32_t max_deliver = (uint32_t)std::atoi(
+      symbiont::env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
 
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
@@ -232,14 +245,17 @@ int main() try {
         bus.ack(*msg);  // nothing to store
         continue;
       }
-      if (pending_ids.count(d.m.original_id)) {
+      if (pending_ids.count(d.m.original_id)
+          && !last_chance(*msg, max_deliver)) {
         // ack_wait redelivery of a doc still queued/in flight here: taking
         // it again would double the work; skipping WITHOUT ack keeps the
         // at-least-once contract (if our copy fails, a later redelivery
-        // re-enters because the id is erased on drop)
+        // re-enters because the id is erased on drop). Final attempt
+        // overrides the skip — see last_chance above.
         continue;
       }
-      if (durable && ready.size() >= 512) {
+      if (durable && ready.size() >= 512
+          && !last_chance(*msg, max_deliver)) {
         // backpressure: the engine is slower than the feed; leave the
         // delivery unacked for redelivery instead of growing an unbounded
         // queue whose tail would blow past ack_wait anyway
