@@ -224,6 +224,39 @@ def bench_tinyllama_decode(results: dict) -> None:
         arch="llama"))
 
 
+def bench_stream_ceiling(results: dict) -> None:
+    """Measure THIS RUN's achievable HBM stream bandwidth (reduce-sum over a
+    3.2 GB bf16 array, 16 in-graph passes, best-of-3). The decode
+    utilization fields divide by this, not a constant: the same kernel
+    measured 581 GB/s and 715 GB/s on this chip hours apart, so a fixed
+    denominator would make utilization drift meaningless across rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        return
+    big = jax.random.normal(jax.random.key(0), (24, 8192, 8192), jnp.bfloat16)
+
+    @jax.jit
+    def reduce(x):
+        def body(acc, _):
+            return acc + x.sum(), None
+        return jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=16)[0]
+
+    np.asarray(reduce(big))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        np.asarray(reduce(big))
+        best = min(best, time.time() - t0)
+    gbps = big.size * 2 / (best / 16) / 1e9
+    results["hbm_stream_gbps_measured"] = round(gbps, 1)
+    del big
+    log(f"HBM stream ceiling (reduce-sum, 3.2 GB bf16, this run): "
+        f"{gbps:.0f} GB/s (v5e paper: 819)")
+
+
 def _bench_decode_geometry(label: str, key: str, results: dict,
                            cfg_kw: dict) -> None:
     """Decode tok/s at batch 8 (+ TTFT), then the batch 32/64/128 sweep —
@@ -273,40 +306,58 @@ def _bench_decode_geometry(label: str, key: str, results: dict,
         run(B, ids, mask, NEW)  # compile the NEW-step scan
         # prefill + 1 step + dispatch/RTT, measured per batch: subtracted
         # below so ms/step (and the HBM-roofline fields derived from it)
-        # reflect DECODE steps only, not the prompt forward (TTFT at B=8)
-        dt1 = float("inf")
-        for _ in range(3):
+        # reflect DECODE steps only, not the prompt forward (TTFT at B=8).
+        # PAIRED samples, median of per-pair differences: each (dt1, dtN)
+        # pair runs back-to-back so both walls share the link state — two
+        # independently-sampled sets straddling a tunnel drift made the
+        # subtraction wrong by up to a full RTT (~±0.9 ms/step at NEW=128;
+        # observed as a model "exceeding" the measured bandwidth ceiling)
+        dt1s, dts, diffs = [], [], []
+        for _ in range(5):
             t0 = time.time()
             run(B, ids, mask, 1)
-            dt1 = min(dt1, time.time() - t0)
-        if B == 8:
-            results[f"{key}_ttft_ms"] = round(dt1 * 1000, 1)
-        dt = float("inf")
-        for _ in range(3):
+            d1 = time.time() - t0
             t0 = time.time()
             run(B, ids, mask, NEW)
-            dt = min(dt, time.time() - t0)
+            dN = time.time() - t0
+            dt1s.append(d1)
+            dts.append(dN)
+            diffs.append(dN - d1)
+        dt1 = med_min_max(dt1s)[0]
+        dt = med_min_max(dts)[0]
+        decode_s = max(med_min_max(diffs)[0], 0.0)
+        if B == 8:
+            results[f"{key}_ttft_ms"] = round(min(dt1s) * 1000, 1)
         results[f"{key}_tok_per_s{suffix}"] = round(B * NEW / dt, 1)
         if B == 8:
             results[f"{key}_tok_per_s_stream"] = round(NEW / dt, 1)
         # roofline context: bytes the chip must stream per decode step
         # (weights once — shared by all rows — plus the full padded KV
         # cache both k and v) over the measured per-step time, vs the
-        # MEASURED sustainable stream bandwidth of this chip (581 GB/s on
-        # a pure reduce-sum of 3 GB; the 819 GB/s paper number is not
-        # reachable by any kernel we measured)
-        ms_step = (dt - dt1) / (NEW - 1) * 1000
+        # stream bandwidth THIS RUN measured (hbm_stream_gbps_measured —
+        # the achievable rate drifts hour to hour on this device, so a
+        # constant denominator would be meaningless)
+        ms_step = decode_s / (NEW - 1) * 1000
         kv_bytes = (2 * cfg.num_layers * B * (P + NEW) * cfg.kv_heads
                     * cfg.head_dim * 2)
-        gbps = (param_bytes + kv_bytes) / (ms_step / 1000) / 1e9
+        gbps = ((param_bytes + kv_bytes) / (ms_step / 1000) / 1e9
+                if ms_step > 0 else 0.0)
+        # when the decode window is comparable to the subtracted prefill+RTT
+        # term, the estimator is jitter-limited — flag it so nobody regresses
+        # on noise (small models on a high-RTT link land here)
+        noise_limited = decode_s < dt1
         results[f"{key}_ms_per_step{suffix}"] = round(ms_step, 2)
         results[f"{key}_hbm_gbps{suffix}"] = round(gbps, 1)
-        results[f"{key}_hbm_util_vs_measured_pct{suffix}"] = round(
-            100 * gbps / 581.0, 1)
+        results[f"{key}_ms_per_step_noise_limited{suffix}"] = int(
+            noise_limited)
+        # utilization fields are computed ONCE in main() against the final
+        # observed ceiling (which this point may itself raise) — logging a
+        # percentage here could contradict the archived value
         log(f"lm decode ({label} geometry, bf16, batch {B}, prompt {P}, "
             f"{NEW} new): {B * NEW / dt:.0f} tokens/s/chip "
             f"({NEW / dt:.0f} tok/s/stream, {ms_step:.2f} ms/step, "
-            f"{gbps:.0f} GB/s = {100 * gbps / 581.0:.0f}% of measured peak)"
+            f"{gbps:.0f} GB/s streamed"
+            + (", NOISE-LIMITED estimate" if noise_limited else "") + ")"
             + (f", TTFT {results[f'{key}_ttft_ms']:.0f}ms" if B == 8 else ""))
 
 
@@ -360,8 +411,11 @@ def bench_compute_mfu(results: dict, peak: float | None) -> None:
         return
     _compute_mfu_geometry(results, peak, dim=384, B=1024, S=64,
                           key_suffix="")
-    _compute_mfu_geometry(results, peak, dim=768, B=512, S=128,
-                          key_suffix="_768")
+    # B=1024 (was 512 through r4): the r5 shape sweep measured [1024,128]
+    # best at this geometry (58.8-59.2% vs 55.9-57.4% at [512,128]); every
+    # other lever tried measured WORSE — see the PERF.md note
+    _compute_mfu_geometry(results, peak, dim=768, B=1024, S=128,
+                          key_suffix="_768", N=12)
     # BASELINE.md config #3: e5-large geometry (1024-d, 24 layers) — the
     # largest encoder in the capability set; completes the model-set sweep
     _compute_mfu_geometry(results, peak, dim=1024, B=256, S=128,
@@ -895,8 +949,11 @@ def render_doc(r: dict, source_name: str) -> str:
         for b in (32, 64, 128):
             if f"{gkey}_tok_per_s_b{b}" in f:
                 util = f.get(f"{gkey}_hbm_util_vs_measured_pct_b{b}")
+                nl = (" (noise-limited estimate)"
+                      if r.get(f"{gkey}_ms_per_step_noise_limited_b{b}")
+                      else "")
                 extra = (f"; {f[f'{gkey}_ms_per_step_b{b}']} ms/step, "
-                         f"{util}% of measured HBM peak" if util else "")
+                         f"{util}% of measured HBM peak{nl}" if util else "")
                 rows.append((
                     f"`{gkey}_tok_per_s_b{b}`",
                     f"{glabel} decode at batch {b}{extra}",
@@ -1031,7 +1088,19 @@ here.
             f"\n   At the reference's own default geometry (mpnet, H=768) the "
             f"wider matmuls fill the 128×128 MXU better: "
             f"`mfu_compute_only_768_pct` = **{f['mfu_compute_only_768_pct']} %** "
-            f"({f['compute_only_768_emb_per_s']} emb/s at [512, 128]).")
+            f"({f['compute_only_768_emb_per_s']} emb/s at [1024, 128]).\n"
+            f"   Why it tops out here (r5 sweep, all measured on this chip): "
+            f"the batch/bucket sweep peaked at [1024, 128] (58.8–59.2% vs "
+            f"55.9–57.4% at the previous [512, 128]); every other lever "
+            f"measured WORSE — pallas flash attention 36–42%, fused QKV "
+            f"52.8% (the same post-matmul slicing loss as the decode-side "
+            f"negative result), f32 softmax −3 pts at S=128 and −5.7 pts at "
+            f"S=512 (the bf16-softmax decision re-confirmed at long "
+            f"buckets), and bf16 LayerNorm statistics a wash (the f32 "
+            f"stats are already fused). Bare chained matmuls at the "
+            f"encoder's own shapes measure BELOW the full fused model on "
+            f"this chip, so ~59% useful-FLOPs MFU is the practical ceiling "
+            f"of this v5e for a 12-layer 768-wide encoder.")
     return f"""# Measured performance
 
 **Rendered from `{source_name}` — do not edit the numbers by hand.**
@@ -1116,25 +1185,34 @@ co-located.
 {e2e_section}## The decode roofline (measured, r5)
 
 Decode is weight-read bound, so the honest roofline needs the chip's
-MEASURED bandwidth, not the paper number. Measured on this v5e via
-microbenchmarks (scripts/profile_decode.py + ad-hoc, r5 logs):
-
-- pure stream (reduce-sum over 3 GB): **581 GB/s** (the 819 GB/s paper
-  figure is not reachable by any kernel we measured);
-- serially-dependent weight-streaming matmuls (decode's exact access
-  pattern — each layer's matmul waits on the previous): **~90–220 GB/s**
-  depending on shape, batch-independent (B=8 and B=128 chains measure the
-  same). This is a compiler/hardware pipelining property, not model code.
+MEASURED bandwidth, not the paper number — and that measurement drifts
+with the hour on this tunnel-attached device (the same reduce-sum kernel
+measured 581 and 715 GB/s hours apart), so each bench run measures its
+OWN ceiling: the fastest sustained stream observed in the run, whether
+the reduce-sum reference kernel (`hbm_stream_gbps_measured` =
+{f.get('hbm_stream_gbps_measured', '—')} GB/s) or the decode path itself
+(`hbm_stream_gbps_ceiling` =
+**{f.get('hbm_stream_gbps_ceiling', f.get('hbm_stream_gbps_measured', '—'))} GB/s**
+this run; v5e paper: 819). The decode utilization fields divide by that
+ceiling, so they can never exceed 100% by construction. Also measured
+(scripts/profile_decode.py + r5 logs): serially-dependent weight-streaming
+matmuls — decode's exact access pattern, each layer's matmul waiting on
+the previous — sustain only a fraction of the pure-stream rate
+(~90–220 GB/s in isolated chains, batch-independent), a compiler/hardware
+pipelining property, not model code.
 
 Against that: TinyLlama batch-8 decode streams
 {f.get('tinyllama_1b_hbm_gbps', '—')} GB/s =
-**{f.get('tinyllama_1b_hbm_util_vs_measured_pct', '—')}% of the measured
-pure-stream peak** — small-batch decode is already at the wall. At batch
+**{f.get('tinyllama_1b_hbm_util_vs_measured_pct', '—')}% of this run's
+stream ceiling** — small-batch decode is essentially at the wall. At batch
 128 the per-step bytes grow only 1.25× (weights dominate; KV reads are
 `{f.get('tinyllama_1b_hbm_gbps_b128', '—')}` GB/s effective) but the chain
-throughput drops toward the serial-matmul ceiling — the batch sweep's
+throughput drops toward the serial-matmul regime — the batch sweep's
 `*_hbm_util_vs_measured_pct_b*` fields archive exactly where each point
-sits, so a regression-from-roofline is visible (VERDICT r4 weak #3).
+sits, so a regression-from-roofline is visible (VERDICT r4 weak #3). The
+per-step estimator subtracts a paired prefill measurement; points flagged
+`*_noise_limited` have a decode window comparable to the subtracted
+RTT+prefill term and carry ~±20% uncertainty.
 
 What r5 changed, measured on the CHUNKED serving path (the one streaming /
 continuous batching actually runs): donating the KV-cache carry across the
@@ -1274,11 +1352,34 @@ def main() -> None:
         bench_compute_mfu(results, peak)
         bench_search_latency(results)
         bench_rerank(results)
+        bench_stream_ceiling(results)
         bench_lm_decode(results)
         bench_tinyllama_decode(results)
         bench_streaming(results)
         if "--no-e2e" not in sys.argv:
             bench_e2e(results)
+
+    if "hbm_stream_gbps_measured" in results:
+        # the stream ceiling is a SAMPLE of a drifting device: one run's
+        # reduce-sum reference landed below what decode itself sustained
+        # minutes later (decode "146% of ceiling"). The honest ceiling is
+        # the fastest sustained stream OBSERVED this run — reference kernel
+        # or the decode path itself — so utilization can never exceed 100%
+        # by construction and regressions stay meaningful.
+        achieved = [
+            v for k, v in results.items()
+            if "_hbm_gbps" in k and isinstance(v, (int, float))
+            # a noise-limited per-step estimate can overshoot wildly —
+            # it must never SET the ceiling every other point divides by
+            and not results.get(
+                k.replace("_hbm_gbps", "_ms_per_step_noise_limited"))]
+        ceiling = max([results["hbm_stream_gbps_measured"]] + achieved)
+        results["hbm_stream_gbps_ceiling"] = round(ceiling, 1)
+        for k in [k for k in results if "_hbm_gbps" in k
+                  and k != "hbm_stream_gbps_measured"
+                  and k != "hbm_stream_gbps_ceiling"]:
+            results[k.replace("_hbm_gbps", "_hbm_util_vs_measured_pct")] = \
+                round(100 * results[k] / ceiling, 1)
 
     log(f"total bench time {time.time() - t_start:.0f}s")
     # tunnel-bound embedding throughput: informational-with-spread, NOT the

@@ -117,9 +117,16 @@ class LmConfig:
     top_k: int = 40
     seed: int = 0
     # generation micro-batching: concurrent generate requests within the
-    # flush window decode as one batched call (engine/batcher.GenBatcher)
+    # flush window decode as one batched call (engine/batcher.GenBatcher).
+    # The window matters more than for embeddings: a newcomer whose budget
+    # EQUALS the session's new-token bucket can never join mid-flight
+    # (its budget always exceeds the remaining steps), so same-budget
+    # request waves batch only if they land in one window — 30 ms of
+    # added first-token latency vs multi-second decodes is the right
+    # trade (measured r5: a 16-client wave missing the window fragmented
+    # into per-request sessions, 10x the wall time).
     gen_max_batch: int = 8
-    gen_flush_deadline_ms: float = 10.0
+    gen_flush_deadline_ms: float = 30.0
     # continuous batching: a decode session keeps at least this many batch
     # rows so requests arriving mid-decode can JOIN at chunk boundaries
     # (BatchSession.admit). Nearly free on TPU — decode steps are bound by

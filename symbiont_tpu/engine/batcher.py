@@ -161,11 +161,19 @@ class MicroBatcher(_BatcherBase):
                  max_inflight_flushes: Optional[int] = None):
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
                     else engine.config.flush_deadline_ms) / 1000.0
+        from symbiont_tpu.config import EngineConfig
+
         super().__init__(max_batch or engine.config.max_batch, deadline,
                          max_inflight_flushes=(
                              max_inflight_flushes
                              if max_inflight_flushes is not None
-                             else engine.config.max_inflight_flushes))
+                             # duck-typed test configs may predate the
+                             # field; fall back to the REAL dataclass
+                             # default so a future tuning there is never
+                             # shadowed by a stale literal here
+                             else getattr(
+                                 engine.config, "max_inflight_flushes",
+                                 EngineConfig.max_inflight_flushes)))
         self.engine = engine
 
     async def embed(self, texts: Sequence[str]) -> np.ndarray:

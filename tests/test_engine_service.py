@@ -128,3 +128,61 @@ def test_engine_service_error_replies(tmp_path):
             await svc.stop()
 
     _run(scenario())
+
+
+def test_engine_service_b64_encodings(tmp_path):
+    """The compact base64 f32 forms on the framework-internal engine plane
+    (r5): embed.batch replies with one b64 block when asked, vector.upsert
+    accepts the b64 request form, and malformed shapes get typed errors
+    instead of silently dropping points."""
+    import base64
+
+    async def scenario():
+        bus = InprocBus()
+        store = VectorStore(VectorStoreConfig(dim=32, data_dir=str(tmp_path)))
+        svc = EngineService(bus, engine=_engine(), vector_store=store)
+        await svc.start()
+        try:
+            plain = await _req(bus, subjects.ENGINE_EMBED_BATCH,
+                               {"texts": ["hello world", "tpu"]})
+            b64 = await _req(bus, subjects.ENGINE_EMBED_BATCH,
+                             {"texts": ["hello world", "tpu"],
+                              "encoding": "b64"})
+            assert b64["error_message"] is None
+            assert b64["count"] == 2 and b64["dim"] == 32
+            rows = np.frombuffer(base64.b64decode(b64["vectors_b64"]),
+                                 dtype=np.float32).reshape(2, 32)
+            # b64 is EXACT f32 — tighter than the JSON text round-trip
+            np.testing.assert_allclose(rows, np.asarray(plain["vectors"]),
+                                       rtol=1e-6)
+
+            ids = [f"00000000-0000-4000-8000-{i:012d}" for i in range(2)]
+            up = await _req(bus, subjects.ENGINE_VECTOR_UPSERT, {
+                "ids": ids, "dim": 32,
+                "vectors_b64": base64.b64encode(
+                    rows.astype(np.float32).tobytes()).decode(),
+                "payloads": [{"sentence_text": "hello world"},
+                             {"sentence_text": "tpu"}]})
+            assert up["error_message"] is None and up["upserted"] == 2
+            hits = await _req(bus, subjects.ENGINE_VECTOR_SEARCH,
+                              {"vector": plain["vectors"][0], "top_k": 1})
+            assert hits["hits"][0]["id"] == ids[0]
+
+            # malformed: payload count != id count must ERROR, not truncate
+            bad = await _req(bus, subjects.ENGINE_VECTOR_UPSERT, {
+                "ids": ids, "dim": 32,
+                "vectors_b64": base64.b64encode(
+                    rows.astype(np.float32).tobytes()).decode(),
+                "payloads": [{}]})
+            assert bad["error_message"] is not None
+            # malformed: float count != ids*dim must ERROR
+            bad2 = await _req(bus, subjects.ENGINE_VECTOR_UPSERT, {
+                "ids": ids, "dim": 32,
+                "vectors_b64": base64.b64encode(
+                    rows[:1].astype(np.float32).tobytes()).decode(),
+                "payloads": [{}, {}]})
+            assert bad2["error_message"] is not None
+        finally:
+            await svc.stop()
+
+    _run(scenario())
