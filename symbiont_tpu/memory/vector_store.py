@@ -60,6 +60,7 @@ class VectorStore:
         self._search_fns: dict = {}
         self._warmed_capacity = None  # capacity warm_fused last compiled for
         self._wal_file = None
+        self.last_load_skipped_lines = 0  # corrupt WAL lines on last load()
         if self.config.data_dir:
             Path(self.config.data_dir).mkdir(parents=True, exist_ok=True)
             self.load()
@@ -355,6 +356,7 @@ class VectorStore:
                 self._vectors = np.load(root / f"{self.config.collection}.vectors.npy")
                 self._id_to_row = {pid: i for i, pid in enumerate(self._ids)}
             wal = self._wal_path()
+            skipped = 0
             if wal and wal.exists():
                 replay: List[Tuple[str, list, dict]] = []
                 with open(wal, encoding="utf-8") as f:
@@ -374,7 +376,21 @@ class VectorStore:
                                 vec = rec["vector"]
                             replay.append((rec["id"], vec, rec["payload"]))
                         except (json.JSONDecodeError, KeyError, ValueError):
-                            log.warning("skipping corrupt WAL line")
+                            skipped += 1
+                if skipped:
+                    # a rollback to a pre-r5 build re-writes this WAL with
+                    # float-list records; anything the OLD code cannot parse
+                    # (e.g. the r5 vector_b64 format) is not "a corrupt
+                    # line", it is DATA LOSS — make the count visible so the
+                    # operator knows how many points vanished (compact()
+                    # BEFORE rolling back, see docs/DEPLOYMENT.md)
+                    log.warning(
+                        "%s: skipped %d corrupt/unreadable WAL line(s) — "
+                        "these points are NOT loaded; if this follows a "
+                        "version rollback, the WAL format changed and the "
+                        "skipped records are lost unless re-ingested "
+                        "(run compact() before rolling back)",
+                        wal, skipped)
                 if replay:
                     # replay through upsert minus re-logging
                     wal_file, self._wal_file = self._wal_file, None
@@ -384,4 +400,5 @@ class VectorStore:
                     finally:
                         self.config.data_dir = data_dir
                         self._wal_file = wal_file
+            self.last_load_skipped_lines = skipped
             self._dirty = True

@@ -189,3 +189,32 @@ def test_compat_command_fails_on_dead_target(capsys):
         dead = s.getsockname()[1]
     failures = _qdrant_compat(f"http://127.0.0.1:{dead}", say=lambda *a: None)
     assert failures, "a dead qdrant target must produce failures"
+
+
+def test_help_flag_exits_cleanly(capsys):
+    """`--help` used to be treated as a compose path and die with a
+    FileNotFoundError traceback (VERDICT r5 weak #5)."""
+    from symbiont_tpu.deploy import main
+
+    assert main(["--help"]) == 0
+    assert "Usage" in capsys.readouterr().err
+    assert main(["-h"]) == 0
+    assert main([]) == 2  # no args still prints usage, but is an error
+
+
+def test_missing_compose_path_is_friendly(capsys):
+    from symbiont_tpu.deploy import main
+
+    assert main(["no/such/compose.yml"]) == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_compat_duplicate_target_kind_rejected(capsys):
+    """`--compat qdrant=A qdrant=B` silently checked only B while the
+    operator believed both were covered (ADVICE r5 finding)."""
+    from symbiont_tpu.deploy import main
+
+    rc = main(["--compat", "qdrant=http://a:6333", "qdrant=http://b:6333"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "given twice" in err and "qdrant" in err

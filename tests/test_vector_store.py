@@ -121,3 +121,37 @@ def test_sharded_search_matches_unsharded():
     h1 = [h.id for h in plain.search(q, top_k=8)]
     h2 = [h.id for h in sharded.search(q, top_k=8)]
     assert h1 == h2
+
+
+def test_load_counts_skipped_corrupt_wal_lines(tmp_path, caplog):
+    """A pre-r5 rollback skips r5 `vector_b64` WAL records as corrupt —
+    silent data loss. The count is now surfaced: one warning with the
+    number, and `last_load_skipped_lines` for programmatic checks
+    (flush-before-rollback requirement documented in docs/DEPLOYMENT.md)."""
+    import json as _json
+    import logging
+
+    store = VectorStore(_cfg(tmp_path))
+    rng = np.random.default_rng(11)
+    vecs = rng.normal(size=(3, 8)).astype(np.float32)
+    store.upsert([(f"p{i}", vecs[i], {"i": i}) for i in range(3)])
+    assert store.last_load_skipped_lines == 0
+    wal = tmp_path / f"{store.config.collection}.wal.jsonl"
+    with open(wal, "a", encoding="utf-8") as f:
+        f.write("{not json at all\n")
+        f.write(_json.dumps({"id": "q1", "unknown_format": [1, 2]}) + "\n")
+    with caplog.at_level(logging.WARNING,
+                         logger="symbiont_tpu.memory.vector_store"):
+        store2 = VectorStore(_cfg(tmp_path))
+    assert store2.count() == 3  # intact records still load
+    assert store2.last_load_skipped_lines == 2
+    assert any("skipped 2" in r.getMessage() for r in caplog.records)
+
+
+def test_clean_load_reports_zero_skipped(tmp_path):
+    store = VectorStore(_cfg(tmp_path))
+    rng = np.random.default_rng(12)
+    store.upsert([("a", rng.normal(size=8).astype(np.float32), {})])
+    store2 = VectorStore(_cfg(tmp_path))
+    assert store2.count() == 1
+    assert store2.last_load_skipped_lines == 0
