@@ -101,13 +101,24 @@ inline uint64_t now_ms() {
   return (uint64_t)ts.tv_sec * 1000 + (uint64_t)ts.tv_nsec / 1000000;
 }
 
-// Trace propagation: same trace, fresh span (telemetry.child_headers parity).
+// Trace propagation: same trace, same ACTIVE span id (telemetry.child_headers
+// parity — the span-id header names the span under which the message was
+// published; a bus hop is an edge in the trace tree, not a span of its own).
+// Native workers record no spans, so propagating verbatim is what keeps a
+// mixed Python/native pipeline's downstream handler spans linked to the last
+// recording hop instead of to a fresh id nobody owns.
 inline std::map<std::string, std::string> child_headers(
     const std::map<std::string, std::string>& parent) {
   std::map<std::string, std::string> h;
   auto it = parent.find(TRACE_HEADER);
-  h[TRACE_HEADER] = it != parent.end() ? it->second : uuid4();
-  h[SPAN_HEADER] = uuid4();
+  if (it == parent.end()) {  // no context: start a fresh trace
+    h[TRACE_HEADER] = uuid4();
+    h[SPAN_HEADER] = uuid4();
+    return h;
+  }
+  h[TRACE_HEADER] = it->second;
+  auto sp = parent.find(SPAN_HEADER);
+  h[SPAN_HEADER] = sp != parent.end() ? sp->second : uuid4();
   return h;
 }
 

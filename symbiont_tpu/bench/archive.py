@@ -144,6 +144,17 @@ def validate_line(d: dict) -> List[str]:
                             for k, x in v.items())):
                 problems.append(f"{key}: expected an object of "
                                 "tier name -> skip reason strings")
+        elif key == "metrics_snapshot":
+            # internal-gauge snapshot from the e2e tier (obs subsystem):
+            # one flat string -> finite number object
+            if not isinstance(v, dict):
+                problems.append(f"{key}: expected an object")
+            else:
+                for mk, mv in v.items():
+                    if not isinstance(mk, str):
+                        problems.append(f"{key}: non-string key {mk!r}")
+                    else:
+                        _check_number(f"{key}.{mk}", mv, problems)
         else:
             _check_number(key, v, problems)
     for key in d:

@@ -422,6 +422,16 @@ def tier_e2e(results: dict, ctx) -> None:
             f"{results['e2e_first_delta_ms_max']:.0f}] (median of "
             f"{len(deltas)}, full HTTP→bus→decode→SSE path)")
         sse_stop.set()
+        # internal-gauge snapshot INTO the archive: BENCH_*.json carried
+        # only external timings before — now the engine-plane view (batcher
+        # fill ratios, padding waste, compile count/seconds, decode tok/s,
+        # span histograms) of the same run rides along, so a throughput
+        # regression can be read against what the engine saw internally.
+        # Taken before teardown: closing the batchers unregisters/kills
+        # their gauges.
+        from symbiont_tpu.utils.telemetry import metrics as _metrics
+
+        results["metrics_snapshot"] = _metrics.flat_snapshot()
         await tg.stop()
         await gen_batcher.close()
         await tg_bus.close()

@@ -244,6 +244,31 @@ class ParallelConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Observability (symbiont_tpu/obs/): flight-recorder sizing and the
+    SLO watchdog. Thresholds are "span.name=p99_ms" entries, e.g.
+    SYMBIONT_OBS_SLO_P99_MS='["api.search=500", "preprocessing.handle=2000"]'
+    — the watchdog task only runs when at least one is configured."""
+
+    # span records kept in the in-process flight recorder ring
+    trace_capacity: int = 4096
+    # seconds between SLO evaluations
+    slo_interval_s: float = 10.0
+    # "span_name=p99_ms" entries evaluated against span.<name>.ms histograms
+    slo_p99_ms: List[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.trace_capacity < 1:
+            raise ValueError("obs.trace_capacity must be >= 1")
+        if self.slo_interval_s <= 0:
+            raise ValueError("obs.slo_interval_s must be positive")
+        # malformed SLO entries fail at boot, not silently never fire
+        from symbiont_tpu.obs.watchdog import parse_thresholds
+
+        parse_thresholds(self.slo_p99_ms)
+
+
+@dataclass
 class RunnerConfig:
     """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
 
@@ -270,6 +295,7 @@ class SymbiontConfig:
     perception: PerceptionConfig = field(default_factory=PerceptionConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runner: RunnerConfig = field(default_factory=RunnerConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def __post_init__(self) -> None:
         # cross-section invariant: every top_k the gateway routes to the

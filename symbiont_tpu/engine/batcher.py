@@ -26,12 +26,15 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.utils.telemetry import metrics
 
 log = logging.getLogger(__name__)
 
@@ -49,11 +52,17 @@ class _BatcherBase:
     sessions admit newcomers at chunk boundaries instead, and two sessions
     would only contend on the LM lock."""
 
+    # metric label distinguishing the two policies over one registry
+    kind = "batcher"
+
     def __init__(self, max_batch: int, deadline_s: float,
                  max_inflight_flushes: int = 1):
         self.max_batch = max_batch
         self.deadline_s = deadline_s
-        self._queue: List = []
+        # deque: popleft is O(1); the pre-obs list popped index 0, an O(n)
+        # shift per item that scaled with backlog depth exactly when the
+        # batcher was busiest
+        self._queue: deque = deque()
         self._queued = 0
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -65,6 +74,31 @@ class _BatcherBase:
         if self._task is None:
             self._task = asyncio.create_task(
                 self._run(), name=type(self).__name__)
+            self._register_gauges()
+
+    def _register_gauges(self) -> None:
+        """Engine-plane queue gauges, read at scrape time. Weakref-bound
+        (register_weakref_gauge): a dead or closed batcher's gauges retire
+        themselves — tests churn through batchers and the registry must not
+        pin them."""
+        labels = {"service": "engine", "batcher": self.kind}
+
+        def depth(b):
+            return None if b._closed else b._queued
+
+        def oldest_wait_s(b):
+            if b._closed:
+                return None
+            if not b._queue:
+                return 0.0
+            # FIFO (requeues go to the FRONT), so [0] is the oldest
+            t = getattr(b._queue[0], "_t_submit", None)
+            return 0.0 if t is None else max(0.0, time.monotonic() - t)
+
+        metrics.register_weakref_gauge("batcher.queue_depth", self, depth,
+                                       labels=labels)
+        metrics.register_weakref_gauge("batcher.oldest_wait_s", self,
+                                       oldest_wait_s, labels=labels)
 
     async def close(self) -> None:
         self._closed = True
@@ -78,7 +112,8 @@ class _BatcherBase:
         # after _run has already exited — with no loop left to serve them,
         # their futures would hang forever. All flushes are done now, so the
         # queue is final: fail what's left.
-        leftovers, self._queue[:] = list(self._queue), []
+        leftovers = list(self._queue)
+        self._queue.clear()
         self._queued = 0
         for item in leftovers:
             if not item.future.done():
@@ -87,8 +122,23 @@ class _BatcherBase:
     def _submit(self, item) -> None:
         if self._closed:
             raise RuntimeError("batcher closed")
+        item._t_submit = time.monotonic()  # queue-age gauge reads this
         self._queue.append(item)
         self._queued += self._size(item)
+        self._wake.set()
+
+    def _requeue(self, items: List) -> None:
+        """Put stolen-but-unserved items back, ahead of anything submitted
+        meanwhile (preserve arrival order), and wake the run loop — it may
+        have parked on a cleared _wake after the steal emptied the queue;
+        without a wake the re-queued items sit unserved until an unrelated
+        submission arrives (ADVICE r4 medium)."""
+        if not items:
+            return
+        # extendleft reverses its argument, so reversed(items) lands the
+        # re-queued block at the front IN ORIGINAL ORDER (covered by tests)
+        self._queue.extendleft(reversed(items))
+        self._queued += sum(self._size(k) for k in items)
         self._wake.set()
 
     def _take_chunk(self) -> List:
@@ -97,10 +147,16 @@ class _BatcherBase:
         size = 0
         while self._queue and (not taken
                                or size + self._size(self._queue[0]) <= self.max_batch):
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             size += self._size(item)
             taken.append(item)
         self._queued -= size
+        if taken:
+            labels = {"service": "engine", "batcher": self.kind}
+            fill = size / self.max_batch if self.max_batch else 0.0
+            metrics.observe("batcher.flush_fill_ratio", fill, labels=labels)
+            metrics.gauge_set("batcher.last_flush_fill_ratio", round(fill, 4),
+                              labels=labels)
         return taken
 
     async def _run(self) -> None:
@@ -156,6 +212,8 @@ class _Pending:
 
 
 class MicroBatcher(_BatcherBase):
+    kind = "embed"
+
     def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None,
                  max_inflight_flushes: Optional[int] = None):
@@ -230,6 +288,8 @@ class GenBatcher(_BatcherBase):
     prompt bucket (LmEngine.BatchSession.can_admit) — otherwise it waits for
     the next session."""
 
+    kind = "generate"
+
     def __init__(self, lm, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None):
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
@@ -257,18 +317,6 @@ class GenBatcher(_BatcherBase):
             if max_new <= b:
                 return b
         return self.lm.config.new_token_buckets[-1]
-
-    def _requeue(self, items: List) -> None:
-        """Put stolen-but-unserved items back, ahead of anything submitted
-        meanwhile (preserve arrival order), and wake the run loop — it may
-        have parked on a cleared _wake after the steal emptied the queue;
-        without a wake the re-queued items sit unserved until an unrelated
-        submission arrives (ADVICE r4 medium)."""
-        if not items:
-            return
-        self._queue[:0] = items
-        self._queued += sum(self._size(k) for k in items)
-        self._wake.set()
 
     async def _flush(self, batch: List) -> None:
         loop = asyncio.get_running_loop()

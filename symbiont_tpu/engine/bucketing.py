@@ -94,6 +94,18 @@ def pad_batch_rows_ids(
     return ids, lengths, n
 
 
+def padding_stats(
+    lengths: Sequence[int], bucket: int, batch_rows: int
+) -> Tuple[int, int]:
+    """(real_tokens, padded_slots) for one dispatched batch: how many of the
+    `batch_rows * bucket` token slots the device will chew on carry real
+    tokens vs bucket/row padding. Feeds the engine-plane padding-waste
+    gauges (docs/OBSERVABILITY.md) — the quantified version of this module's
+    whole reason to exist (SURVEY.md §5.7's 10-80x pad-to-max waste)."""
+    real = int(sum(min(int(n), bucket) for n in lengths))
+    return real, int(batch_rows) * int(bucket)
+
+
 def plan_batches(
     lengths: Sequence[int],
     length_buckets: Sequence[int],

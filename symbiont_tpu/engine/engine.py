@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Optional, Sequence
 
@@ -44,12 +45,13 @@ from symbiont_tpu.engine.bucketing import (
     pad_batch_rows_ids,
     pad_ids_rows,
     pad_to_bucket,
+    padding_stats,
     plan_batches,
 )
 from symbiont_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from symbiont_tpu.models import bert as bert_mod
 from symbiont_tpu.models.bert import BertConfig
-from symbiont_tpu.utils.telemetry import maybe_profile
+from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
 log = logging.getLogger(__name__)
 
@@ -203,11 +205,35 @@ class TpuEngine:
 
         # stats (SURVEY.md §5.5: the reference has none). Mutate via _bump
         # only — bare `stats[k] += 1` is a read-modify-write that loses
-        # increments under concurrent entry points.
+        # increments under concurrent entry points. compile_s is first-call
+        # wall time of each executable (XLA compiles synchronously inside
+        # the first dispatch): an approximation that includes one dispatch,
+        # but compiles are seconds and dispatches are microseconds.
         self.stats = {"embed_calls": 0, "sentences_embedded": 0,
-                      "rerank_calls": 0, "qsearch_calls": 0, "compiles": 0}
+                      "rerank_calls": 0, "qsearch_calls": 0, "compiles": 0,
+                      "compile_s": 0.0}
+        self._register_gauges()
 
-    def _bump(self, **counts: int) -> None:
+    def _register_gauges(self) -> None:
+        """Engine-plane gauges (docs/OBSERVABILITY.md): compile count and
+        seconds under a service label. Weakref-bound so the process-global
+        registry never pins a dead engine (tests churn through dozens)."""
+        def stat(key):
+            def read(eng):
+                with eng._stats_lock:
+                    return eng.stats[key]
+            return read
+
+        labels = {"service": "engine"}
+        metrics.register_weakref_gauge("engine.compiles", self,
+                                       stat("compiles"), labels=labels)
+        metrics.register_weakref_gauge("engine.compile_s", self,
+                                       stat("compile_s"), labels=labels)
+        metrics.register_weakref_gauge("engine.sentences_embedded", self,
+                                       stat("sentences_embedded"),
+                                       labels=labels)
+
+    def _bump(self, **counts) -> None:
         with self._stats_lock:
             for k, v in counts.items():
                 self.stats[k] += v
@@ -289,7 +315,7 @@ class TpuEngine:
         else:
             raise ValueError(kind)
 
-        jitted = jax.jit(fn)
+        jitted = self._time_first_call(jax.jit(fn))
         with self._lock:
             # two threads can race the cold-miss check above; the loser
             # discards its wrapper and reuses the winner's, so one shape
@@ -302,6 +328,42 @@ class TpuEngine:
                 self._exec_cache.popitem(last=False)
         self._bump(compiles=1)
         return jitted
+
+    def _time_first_call(self, jitted: Callable) -> Callable:
+        """Account the executable's first-call wall time as compile seconds
+        (XLA compiles synchronously inside the first dispatch; subsequent
+        calls skip straight to the async dispatch). The flag flips BEFORE
+        dispatch: two threads can race a cold executable (see the cache-miss
+        note in _get_executable), and claiming first keeps the shared
+        compile from being counted twice — a lost claim under-counts one
+        dispatch, never double-counts a multi-second compile."""
+        first = [True]
+
+        def wrapper(*args):
+            if not first[0]:
+                return jitted(*args)
+            first[0] = False
+            t0 = time.perf_counter()
+            out = jitted(*args)
+            self._bump(compile_s=time.perf_counter() - t0)
+            return out
+
+        return wrapper
+
+    def _note_padding(self, true_lengths, bucket: int, batch_rows: int,
+                      n_real: int) -> None:
+        """Bucket padding-waste + batch fill-ratio gauges for one dispatched
+        batch (engine/bucketing.py quantified live)."""
+        real, total = padding_stats(true_lengths, bucket, batch_rows)
+        labels = {"service": "engine"}
+        metrics.inc("engine.tokens_real", real, labels=labels)
+        metrics.inc("engine.tokens_padding", total - real, labels=labels)
+        metrics.gauge_set("engine.batch_fill_ratio",
+                          round(n_real / batch_rows, 4) if batch_rows else 0.0,
+                          labels=labels)
+        metrics.gauge_set("engine.bucket_pad_waste_ratio",
+                          round(1.0 - real / total, 4) if total else 0.0,
+                          labels=labels)
 
     def _device_batch(self, *arrays: np.ndarray):
         """Move batch-dim-0 arrays to the device (sharded over 'data' when
@@ -360,6 +422,8 @@ class TpuEngine:
                                      dtype=self._ids_dtype)
             bb = self._batch_bucket(len(indices))
             ids, lens, n_real = pad_batch_rows_ids(ids, lens, bb)
+            self._note_padding([lengths[i] for i in indices], bucket, bb,
+                               n_real)
             fn = self._get_executable("embed", bucket, bb)
             ids_d, lens_d = self._device_batch(ids, lens)
             rows = ([offset + i for i in indices] if offset else indices)
@@ -490,6 +554,8 @@ class TpuEngine:
                                    np.int32)
                 bb = self._batch_bucket(len(indices))
                 ids, lens, n_real = pad_batch_rows_ids(ids, lens, bb)
+                self._note_padding([lengths[i] for i in indices], bucket, bb,
+                                   n_real)
                 if len_a.shape[0] < bb:
                     len_a = np.concatenate(
                         [len_a, np.zeros(bb - n_real, np.int32)])

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
@@ -30,7 +31,7 @@ import numpy as np
 from symbiont_tpu.config import LmConfig
 from symbiont_tpu.models import gpt as gpt_mod
 from symbiont_tpu.models.gpt import GPTConfig
-from symbiont_tpu.utils.telemetry import maybe_profile
+from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
 log = logging.getLogger(__name__)
 
@@ -241,6 +242,49 @@ class LmEngine:
         self._prefill_shapes: set = set()
         self.stats = {"generate_calls": 0, "tokens_generated": 0,
                       "decode_s": 0.0}
+        # live continuous-batching sessions (BatchSession registers itself);
+        # weak so a finished session vanishes from the KV gauges without an
+        # explicit close hook. Own lock: sessions register from executor
+        # threads while scrapes iterate from the event loop, and WeakSet is
+        # not thread-safe (the engine lock is no substitute — it's held for
+        # whole decode calls and a scrape must never block behind one).
+        self._sessions: "weakref.WeakSet" = weakref.WeakSet()
+        self._sessions_lock = threading.Lock()
+        self._register_gauges()
+
+    def _register_gauges(self) -> None:
+        """Engine-plane decode gauges (docs/OBSERVABILITY.md): KV-cache row
+        occupancy across live sessions, and cumulative decode tokens/s.
+        Weakref-bound so the process-global registry never pins a dead
+        engine."""
+        def kv_rows(active_only: bool):
+            def read(lm):
+                with lm._sessions_lock:
+                    sessions = list(lm._sessions)
+                total = 0
+                for sess in sessions:
+                    if sess.done():
+                        continue
+                    total += (sum(1 for r in sess.rows if r is not None)
+                              if active_only else sess.bb)
+                return total
+            return read
+
+        def tok_per_s(lm):
+            # lockless read: the engine lock is held for whole decode calls,
+            # and a scrape must never block seconds behind one. Two GIL-
+            # atomic dict reads can straddle an update — a gauge tolerates
+            # that; a frozen /metrics endpoint doesn't.
+            toks, secs = lm.stats["tokens_generated"], lm.stats["decode_s"]
+            return toks / secs if secs > 0 else 0.0
+
+        labels = {"service": "lm"}
+        metrics.register_weakref_gauge("lm.kv_rows_active", self,
+                                       kv_rows(True), labels=labels)
+        metrics.register_weakref_gauge("lm.kv_rows_allocated", self,
+                                       kv_rows(False), labels=labels)
+        metrics.register_weakref_gauge("lm.decode_tok_per_s", self,
+                                       tok_per_s, labels=labels)
 
     def _place_params(self, params):
         """ONE home for parameter placement: megatron-sharded over the mesh's
@@ -564,6 +608,8 @@ class BatchSession:
             self.decode_s += time.perf_counter() - t0
             lm.stats["sessions"] = lm.stats.get("sessions", 0) + 1
         lm._prefill_shapes.add((self.bb, self.P, self.new_bucket))
+        with lm._sessions_lock:  # weak: KV-occupancy gauges see live sessions
+            lm._sessions.add(self)
         self._pos = prompt_len
         self._done = jnp.zeros((self.bb,), bool)
 

@@ -1,0 +1,19 @@
+"""Observability subsystem: flight-recorder trace store, Prometheus text
+exposition, and the SLO watchdog.
+
+Layering (import order matters — keep it acyclic):
+
+- ``obs.trace_store`` has zero symbiont imports; ``utils/telemetry.span``
+  writes into its process-global ring buffer on every span exit.
+- ``obs.prometheus`` reads the ``utils/telemetry.metrics`` registry and
+  renders Prometheus text exposition (served at ``GET /metrics``).
+- ``obs.watchdog`` evaluates p99 SLO thresholds over the span histograms
+  (started by the runner when ``obs.slo_p99_ms`` is configured).
+
+This package's ``__init__`` deliberately imports only the dependency-free
+trace store; import ``obs.prometheus`` / ``obs.watchdog`` as submodules.
+"""
+
+from symbiont_tpu.obs.trace_store import SpanRecord, TraceStore, trace_store
+
+__all__ = ["SpanRecord", "TraceStore", "trace_store"]

@@ -1,0 +1,185 @@
+"""Prometheus text exposition (format 0.0.4) over the telemetry registry.
+
+`GET /api/metrics` is a JSON dump — fine for humans with curl, invisible to
+every standard scraper. This module renders the same registry as Prometheus
+text exposition for `GET /metrics`:
+
+- counters → `symbiont_<name>_total` (TYPE counter)
+- gauges (value + callback) → `symbiont_<name>` (TYPE gauge)
+- histograms → TYPE summary: `{quantile="0.5|0.95|0.99"}` series plus
+  `_sum`/`_count`, and exact-extreme companions `_min`/`_max` gauges (the
+  reservoir decimates; min/max are tracked exactly — see _Histogram).
+
+Label conventions (docs/OBSERVABILITY.md): explicitly-labeled series pass
+their labels through; legacy dot-concatenated names are split so the first
+segment becomes a `service` label instead of being fused into the metric
+name — `perception.scrape_failed` → `symbiont_scrape_failed_total
+{service="perception"}`. Span series get a `span` label carrying the full
+span name plus the service label: `span.api.search.ms` →
+`symbiont_span_duration_ms{service="api",span="api.search"}`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+_NAME_PREFIX = "symbiont_"
+_INVALID_NAME_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+_INVALID_LABEL_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+# services whose dot-prefixed legacy counters should fold into a
+# service="..." label (anything else keeps its full name — guessing labels
+# out of arbitrary dotted names would mint garbage label sets)
+_KNOWN_SERVICES = frozenset({
+    "api", "perception", "preprocessing", "vector_memory",
+    "knowledge_graph", "text_generator", "engine", "lm", "batcher", "bus",
+    "slo",
+})
+
+
+def _metric_name(raw: str, suffix: str = "") -> str:
+    name = _INVALID_NAME_CHARS.sub("_", raw).strip("_") or "unnamed"
+    if name[0].isdigit():
+        name = "_" + name
+    return f"{_NAME_PREFIX}{name}{suffix}"
+
+
+def _label_name(raw: str) -> str:
+    name = _INVALID_LABEL_CHARS.sub("_", raw) or "label"
+    if name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def escape_label_value(v: str) -> str:
+    """Exposition-format label escaping: backslash, double-quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{_label_name(k)}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if f != int(f) else str(int(f))
+
+
+def _split_legacy(raw: str, labels: Dict[str, str]
+                  ) -> Tuple[str, Dict[str, str]]:
+    """Fold a known dot-concatenated prefix into a service label. Series
+    that already carry labels pass through untouched (new-style callers
+    label explicitly)."""
+    if "." in raw:
+        head, rest = raw.split(".", 1)
+        if head in _KNOWN_SERVICES and "service" not in labels:
+            return rest, {**labels, "service": head}
+    return raw, labels
+
+
+def _span_series(raw: str) -> Optional[Tuple[str, str]]:
+    """`span.<name>.<ms|errors>` → (kind, span-name)."""
+    if raw.startswith("span."):
+        body = raw[len("span."):]
+        for kind in ("ms", "errors"):
+            if body.endswith("." + kind):
+                return kind, body[: -(len(kind) + 1)]
+    return None
+
+
+class _Family:
+    __slots__ = ("name", "kind", "help", "samples")
+
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.samples: List[str] = []
+
+
+def _family(families: Dict[str, _Family], name: str, kind: str,
+            help_text: str) -> _Family:
+    fam = families.get(name)
+    if fam is None:
+        fam = families[name] = _Family(name, kind, help_text)
+    return fam
+
+
+def _span_labels(span_name: str, labels: Dict[str, str]) -> Dict[str, str]:
+    out = {**labels, "span": span_name}
+    out.setdefault("service", span_name.split(".", 1)[0])
+    return out
+
+
+def render(registry: Optional[Metrics] = None) -> str:
+    """Render the registry as Prometheus text exposition."""
+    ex = (registry or _global_metrics).export()
+    families: Dict[str, _Family] = {}
+
+    for raw, labels, value in ex["counters"]:
+        sp = _span_series(raw)
+        if sp is not None and sp[0] == "errors":
+            fam = _family(families, _metric_name("span_errors", "_total"),
+                          "counter", "Errored span exits by span name.")
+            fam.samples.append(
+                f"{fam.name}{_fmt_labels(_span_labels(sp[1], labels))} "
+                f"{_fmt_value(value)}")
+            continue
+        name, labels = _split_legacy(raw, labels)
+        fam = _family(families, _metric_name(name, "_total"), "counter",
+                      f"Counter {raw}.")
+        fam.samples.append(f"{fam.name}{_fmt_labels(labels)} "
+                           f"{_fmt_value(value)}")
+
+    for raw, labels, value in ex["gauges"]:
+        name, labels = _split_legacy(raw, labels)
+        fam = _family(families, _metric_name(name), "gauge",
+                      f"Gauge {raw}.")
+        fam.samples.append(f"{fam.name}{_fmt_labels(labels)} "
+                           f"{_fmt_value(value)}")
+
+    for raw, labels, summary in ex["histograms"]:
+        sp = _span_series(raw)
+        if sp is not None and sp[0] == "ms":
+            base, labels = "span_duration_ms", _span_labels(sp[1], labels)
+            help_text = "Span duration in milliseconds by span name."
+        else:
+            base, labels = _split_legacy(raw, labels)
+            help_text = f"Distribution of {raw}."
+        fam = _family(families, _metric_name(base), "summary", help_text)
+        for q, stat in _QUANTILES:
+            qlabels = {**labels, "quantile": q}
+            fam.samples.append(f"{fam.name}{_fmt_labels(qlabels)} "
+                               f"{_fmt_value(summary[stat])}")
+        fam.samples.append(f"{fam.name}_sum{_fmt_labels(labels)} "
+                           f"{_fmt_value(summary['mean'] * summary['count'])}")
+        fam.samples.append(f"{fam.name}_count{_fmt_labels(labels)} "
+                           f"{_fmt_value(summary['count'])}")
+        for stat in ("min", "max"):
+            # exact running extremes ride alongside the summary (the
+            # reservoir's quantiles are approximate; these are not)
+            gfam = _family(families, _metric_name(base, f"_{stat}"),
+                           "gauge", f"Exact running {stat} of {raw}.")
+            gfam.samples.append(f"{gfam.name}{_fmt_labels(labels)} "
+                                f"{_fmt_value(summary[stat])}")
+
+    lines: List[str] = []
+    for fam_name in sorted(families):
+        fam = families[fam_name]
+        lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        lines.extend(fam.samples)
+    return "\n".join(lines) + ("\n" if lines else "")

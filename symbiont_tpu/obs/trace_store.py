@@ -1,0 +1,161 @@
+"""Flight-recorder span store: a bounded in-process ring buffer of finished
+spans, queryable by trace id.
+
+The pre-existing telemetry layer wrote spans as structured log LINES — fine
+for grepping one hop, useless for answering "where did this submit→embed→
+upsert pipeline spend its time" without log aggregation infrastructure. This
+store keeps the last N span records in memory (a flight recorder, not a
+tracing backend: bounded, lossy under sustained overload, zero dependencies)
+and reassembles parent-linked trees on demand for ``GET /api/traces/<id>``
+and ``GET /api/traces/recent``.
+
+No symbiont imports here: ``utils/telemetry`` writes into this module on
+every span exit, and anything above telemetry may read from it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class SpanRecord:
+    """One finished span. ``parent_id`` is the span id of the enclosing
+    span (same process) or of the publishing hop's handler span (across the
+    bus, via the X-Span-Id header) — None for roots."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start_s: float          # wall clock (time.time) at span entry
+    duration_ms: float
+    status: str             # "ok" | "error"
+    fields: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_ms": round(self.start_s * 1000.0, 3),
+            "duration_ms": round(self.duration_ms, 3),
+            "status": self.status,
+            "fields": self.fields,
+        }
+
+
+class TraceStore:
+    """Thread-safe bounded ring of SpanRecords.
+
+    Lookup scans the ring (capacity is a few thousand records; a scan is
+    microseconds) instead of maintaining a per-trace index — the ring is the
+    single source of truth, so eviction can never leave a stale index entry
+    behind."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize the ring, keeping the newest records (runner applies
+        ObsConfig.trace_capacity at boot)."""
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+
+    def record(self, rec: SpanRecord) -> None:
+        with self._lock:
+            self._ring.append(rec)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ---------------------------------------------------------------- query
+
+    def spans_for(self, trace_id: str) -> List[SpanRecord]:
+        with self._lock:
+            return [r for r in self._ring if r.trace_id == trace_id]
+
+    def trace_tree(self, trace_id: str) -> Optional[dict]:
+        """Reassemble the parent-linked span tree for one trace.
+
+        Spans whose parent was never recorded (evicted from the ring, or a
+        context hop through a process that doesn't record spans — e.g. the
+        native C++ workers) surface as top-level roots rather than being
+        dropped: a partial trace is still a trace. Returns None when the
+        ring holds nothing for this trace id."""
+        spans = self.spans_for(trace_id)
+        if not spans:
+            return None
+        spans.sort(key=lambda r: r.start_s)
+        ids = {r.span_id for r in spans}
+        nodes: Dict[str, dict] = {}
+        for r in spans:
+            node = r.to_dict()
+            node["children"] = []
+            # duplicate span ids cannot happen (uuid per span), but a
+            # defensive setdefault keeps the tree well-formed regardless
+            nodes.setdefault(r.span_id, node)
+        roots: List[dict] = []
+        for r in spans:
+            node = nodes[r.span_id]
+            if r.parent_id is not None and r.parent_id in ids:
+                nodes[r.parent_id]["children"].append(node)
+            else:
+                roots.append(node)
+        t0 = min(r.start_s for r in spans)
+        t1 = max(r.start_s + r.duration_ms / 1000.0 for r in spans)
+        return {
+            "trace_id": trace_id,
+            "span_count": len(spans),
+            "error_count": sum(1 for r in spans if r.status != "ok"),
+            "services": sorted({r.name.split(".", 1)[0] for r in spans}),
+            "duration_ms": round((t1 - t0) * 1000.0, 3),
+            "start_ms": round(t0 * 1000.0, 3),
+            "roots": roots,
+        }
+
+    def recent(self, limit: int = 20) -> List[dict]:
+        """Trace summaries for the flight-recorder window, errored traces
+        first, then slowest-first — the triage order an operator wants."""
+        with self._lock:
+            records = list(self._ring)
+        by_trace: Dict[str, List[SpanRecord]] = {}
+        for r in records:
+            by_trace.setdefault(r.trace_id, []).append(r)
+        summaries = []
+        for trace_id, spans in by_trace.items():
+            t0 = min(r.start_s for r in spans)
+            t1 = max(r.start_s + r.duration_ms / 1000.0 for r in spans)
+            errors = sum(1 for r in spans if r.status != "ok")
+            root = min(spans, key=lambda r: r.start_s)
+            summaries.append({
+                "trace_id": trace_id,
+                "root": root.name,
+                "span_count": len(spans),
+                "error_count": errors,
+                "services": sorted({r.name.split(".", 1)[0] for r in spans}),
+                "duration_ms": round((t1 - t0) * 1000.0, 3),
+                "start_ms": round(t0 * 1000.0, 3),
+            })
+        summaries.sort(key=lambda s: (-(s["error_count"] > 0),
+                                      -s["duration_ms"]))
+        return summaries[: max(0, int(limit))]
+
+
+# process-global flight recorder (one per process, like the metrics registry)
+trace_store = TraceStore()

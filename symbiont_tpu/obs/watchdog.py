@@ -1,0 +1,127 @@
+"""SLO watchdog: periodic p99 evaluation over the span histograms.
+
+The flight recorder answers "what happened to THIS request"; the watchdog
+answers "is the pipeline meeting its latency objectives AT ALL" — without an
+external alerting stack. The runner starts one task when
+``obs.slo_p99_ms`` is configured (entries like ``"api.search=500"``); every
+interval it reads each named span's p99 from the metrics registry and, on
+breach, emits a structured warning event: a JSON log line, an
+``slo.breaches{span=}`` counter, and a bounded in-memory event list (the
+last ``max_events`` breaches, queryable by tests/operators via
+``watchdog.events``). Evaluated p99s are exported as ``slo.p99_ms{span=}``
+gauges whether breached or not, so dashboards see the margin, not just the
+violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+log = logging.getLogger("symbiont.slo")
+
+
+def parse_thresholds(entries: List[str]) -> Dict[str, float]:
+    """``["api.search=500", "preprocessing.handle=2000"]`` → {span: p99_ms}.
+    Raises ValueError on malformed entries — a typo'd SLO must fail at boot,
+    not silently never fire."""
+    out: Dict[str, float] = {}
+    for entry in entries:
+        name, sep, raw = entry.partition("=")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"SLO threshold {entry!r} must look like 'span.name=p99_ms'")
+        try:
+            limit = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"SLO threshold {entry!r}: {raw!r} is not a number") from None
+        if limit <= 0:
+            raise ValueError(f"SLO threshold {entry!r} must be positive")
+        out[name] = limit
+    return out
+
+
+class SloWatchdog:
+    def __init__(self, thresholds: Dict[str, float],
+                 interval_s: float = 10.0,
+                 registry: Optional[Metrics] = None,
+                 max_events: int = 256):
+        self.thresholds = dict(thresholds)
+        self.interval_s = max(0.1, float(interval_s))
+        self.registry = registry or _global_metrics
+        self.events: deque = deque(maxlen=max_events)
+        self._task: Optional[asyncio.Task] = None
+        # observation count at the last evaluation, per span: an idle span
+        # must not re-alert every interval off the same old samples
+        self._seen_counts: Dict[str, int] = {}
+
+    def evaluate(self) -> List[dict]:
+        """One evaluation pass; returns the breach events it emitted.
+        Synchronous so tests (and one-shot CLI checks) can drive it without
+        an event loop.
+
+        The judged p99 is over the span histogram's process lifetime (the
+        registry keeps no windows), with one guard: a span that received NO
+        new observations since the last pass is skipped, so a single old
+        outlier cannot alert every interval forever. The flip side — a
+        fresh regression diluted under a long healthy history crosses the
+        cumulative p99 late — is the accepted flight-recorder trade
+        (documented in docs/OBSERVABILITY.md); windowed histograms are the
+        upgrade path if it bites."""
+        breaches: List[dict] = []
+        for span_name, limit_ms in self.thresholds.items():
+            summary = self.registry.histogram_summary(f"span.{span_name}.ms")
+            if summary is None or not summary["count"]:
+                continue  # span never ran: nothing to judge
+            if summary["count"] == self._seen_counts.get(span_name):
+                continue  # idle since last pass: no fresh evidence to judge
+            self._seen_counts[span_name] = summary["count"]
+            p99 = summary["p99"]
+            self.registry.gauge_set("slo.p99_ms", p99,
+                                    labels={"span": span_name})
+            if p99 <= limit_ms:
+                continue
+            event = {
+                "event": "slo_breach",
+                "span": span_name,
+                "p99_ms": round(p99, 3),
+                "threshold_ms": limit_ms,
+                "count": summary["count"],
+                "ts": time.time(),
+            }
+            self.registry.inc("slo.breaches", labels={"span": span_name})
+            self.events.append(event)
+            breaches.append(event)
+            log.warning(json.dumps(event, ensure_ascii=False))
+        return breaches
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.evaluate()
+            except Exception:
+                # the watchdog observes; it must never take the stack down
+                log.exception("SLO evaluation failed")
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="slo-watchdog")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -49,6 +49,7 @@ class SymbiontStack:
         self.vector_store = None
         self.graph_store = None
         self.api: Optional[ApiService] = None
+        self.watchdog = None  # obs.watchdog.SloWatchdog when configured
 
     KNOWN_SERVICES = {"all", "perception", "preprocessing", "vector_memory",
                       "knowledge_graph", "text_generator", "api", "engine"}
@@ -64,6 +65,20 @@ class SymbiontStack:
 
         def on(name: str) -> bool:
             return "all" in want or name in want
+
+        # observability plane (symbiont_tpu/obs/): size the flight recorder
+        # and, when p99 thresholds are configured, run the SLO watchdog over
+        # the span histograms every service handler feeds
+        from symbiont_tpu.obs.trace_store import trace_store
+
+        if trace_store.capacity != cfg.obs.trace_capacity:
+            trace_store.set_capacity(cfg.obs.trace_capacity)
+        if cfg.obs.slo_p99_ms:
+            from symbiont_tpu.obs.watchdog import SloWatchdog, parse_thresholds
+
+            self.watchdog = SloWatchdog(parse_thresholds(cfg.obs.slo_p99_ms),
+                                        interval_s=cfg.obs.slo_interval_s)
+            self.watchdog.start()
 
         self.services = []
         self.bus = self._bus_override or await connect(cfg.bus.url)
@@ -201,6 +216,9 @@ class SymbiontStack:
             log.info("symbiont stack up (no api): %s", sorted(want))
 
     async def stop(self) -> None:
+        if self.watchdog is not None:
+            await self.watchdog.stop()
+            self.watchdog = None
         if self.api:
             await self.api.stop()
         for s in self.services:

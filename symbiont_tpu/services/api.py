@@ -13,7 +13,10 @@ the reference's Next.js frontend works against this unmodified):
                               error-body mapping (main.rs:272-512)
 - CORS on localhost origins (main.rs:555-567)
 
-Additions (SURVEY.md §5.5/§5.3 plans): GET /api/metrics, GET /healthz.
+Additions (SURVEY.md §5.5/§5.3 plans): GET /api/metrics (JSON snapshot),
+GET /metrics (Prometheus text exposition), GET /healthz, and the
+flight-recorder query surface GET /api/traces/recent +
+GET /api/traces/<trace_id> (obs/trace_store.py).
 
 Server: stdlib asyncio HTTP/1.1 — no web framework; this is the Python twin of
 the native C++ gateway under native/.
@@ -216,6 +219,20 @@ class ApiService:
                 if path == "/api/events" and method == "GET":
                     await self._serve_sse(writer, headers, query)
                     return  # SSE occupies the connection
+                if path == "/metrics" and method == "GET":
+                    # Prometheus text exposition (scrapers want text/plain,
+                    # not the /api/metrics JSON snapshot)
+                    from symbiont_tpu.obs import prometheus
+
+                    await self._write_response(
+                        writer, 200, prometheus.render(),
+                        origin=headers.get("origin"),
+                        content_type=("text/plain; version=0.0.4; "
+                                      "charset=utf-8"),
+                        keep_alive=keep_alive)
+                    if not keep_alive:
+                        break
+                    continue
                 if path in ("/", "/index.html") and method == "GET":
                     html = _frontend_html()
                     if html is not None:
@@ -322,6 +339,20 @@ class ApiService:
                 return await self._semantic_search(body)
             if path == "/api/metrics" and method == "GET":
                 return 200, json.dumps(metrics.snapshot())
+            if path == "/api/traces/recent" and method == "GET":
+                from symbiont_tpu.obs.trace_store import trace_store
+
+                return 200, json.dumps({"traces": trace_store.recent()})
+            if path.startswith("/api/traces/") and method == "GET":
+                from symbiont_tpu.obs.trace_store import trace_store
+
+                tree = trace_store.trace_tree(path[len("/api/traces/"):])
+                if tree is None:
+                    return 404, json.dumps(
+                        {"message": "trace not found (evicted from the "
+                                    "flight recorder, or never recorded)",
+                         "task_id": None})
+                return 200, json.dumps(tree)
             if path == "/healthz" and method == "GET":
                 return 200, json.dumps({"status": "ok"})
             if path == "/api/health/engine" and method == "GET":
@@ -344,9 +375,13 @@ class ApiService:
         if not url:
             # reference: main.rs:48-53
             return 400, json.dumps({"message": "URL cannot be empty", "task_id": None})
-        await self.bus.publish(subjects.TASKS_PERCEIVE_URL,
-                               to_json_bytes_url(url),
-                               headers=new_trace_headers())
+        # root span of the ingest pipeline trace: every downstream handler
+        # span (perception → preprocessing → vector_memory/knowledge_graph)
+        # links back to this one in the flight recorder
+        with span("api.submit_url", None, url=url) as sp:
+            await self.bus.publish(subjects.TASKS_PERCEIVE_URL,
+                                   to_json_bytes_url(url),
+                                   headers=sp.headers)
         return 200, json.dumps({
             "message": f"Task to scrape URL '{url}' submitted successfully.",
             "task_id": None})
@@ -372,8 +407,9 @@ class ApiService:
             return 400, json.dumps({
                 "message": "top_k must be at most 100000",
                 "task_id": task.task_id})
-        await self.bus.publish(subjects.TASKS_GENERATION_TEXT,
-                               to_json_bytes(task), headers=new_trace_headers())
+        with span("api.generate_text", None, task_id=task.task_id) as sp:
+            await self.bus.publish(subjects.TASKS_GENERATION_TEXT,
+                                   to_json_bytes(task), headers=sp.headers)
         return 200, json.dumps({
             "message": f"Text generation task (id: {task.task_id}) submitted successfully.",
             "task_id": task.task_id})
@@ -383,14 +419,16 @@ class ApiService:
         (main.rs:272-512): bus timeout → 503; service-reported error → 500."""
         req = from_dict(SemanticSearchApiRequest, json.loads(body))
         request_id = generate_uuid()
-        trace = new_trace_headers()
 
         def resp(results, err=None) -> str:
             return to_json(SemanticSearchApiResponse(
                 search_request_id=request_id, results=results,
                 error_message=err))
 
-        with span("api.search", trace, top_k=req.top_k):
+        with span("api.search", None, top_k=req.top_k) as sp:
+            # downstream hops publish under THIS span's context so their
+            # handler spans link into the search trace
+            trace = sp.headers
             if self.config.fused_search:
                 fused = await self._fused_search(req, trace)
                 if fused is not None:
@@ -562,7 +600,12 @@ class ApiService:
         await writer.drain()
         task_filter = (parse_qs(query).get("task_id") or [None])[0] or None
         q = self.hub.register(task_filter)
-        metrics.inc("api.sse_clients")
+        # live-connection GAUGE (decremented on disconnect below) plus a
+        # cumulative counter — the pre-obs `api.sse_clients` counter only
+        # ever incremented, so it silently read as "clients currently
+        # connected" while actually counting connects-ever
+        metrics.gauge_add("api.sse_clients", 1)
+        metrics.inc("api.sse_clients_total")
         try:
             while True:
                 try:
@@ -580,6 +623,7 @@ class ApiService:
             pass
         finally:
             self.hub.unregister(q)
+            metrics.gauge_add("api.sse_clients", -1)
 
 
 def to_json_bytes_url(url: str) -> bytes:

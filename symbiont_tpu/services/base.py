@@ -14,6 +14,7 @@ that SURVEY.md §5.2/§5.3 documents:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
 from typing import Awaitable, Callable, Optional
 
@@ -74,15 +75,28 @@ class Service:
     async def _run_handler(self, subject: str, handler: Handler, msg: Msg,
                            ack: bool = False) -> None:
         try:
-            metrics.inc(f"{self.name}.{subject}.consumed")
-            with span(f"{self.name}.handle", msg.headers, subject=subject):
-                await handler(msg)
+            metrics.inc("bus.consumed",
+                        labels={"service": self.name, "subject": subject})
+            with span(f"{self.name}.handle", msg.headers,
+                      subject=subject) as sp:
+                # hand the handler a PRIVATE message bound to this handler
+                # span's context: the inproc bus shares one Msg (and one
+                # headers dict) across all subscribers, so rebinding a copy
+                # — never mutating the original — is what lets every
+                # downstream publish link to this span without racing a
+                # sibling subscriber's handler (obs trace model; the ack
+                # below still uses the ORIGINAL msg, whose transport
+                # headers the copy merge also preserves)
+                hmsg = dataclasses.replace(
+                    msg, headers={**(msg.headers or {}), **sp.headers})
+                await handler(hmsg)
             if ack:
                 # ack-after-success: a failed handler leaves the message
                 # unacked for redelivery
                 await self.bus.ack(msg)
         except Exception:
-            metrics.inc(f"{self.name}.{subject}.failed")
+            metrics.inc("bus.failed",
+                        labels={"service": self.name, "subject": subject})
             log.exception("%s: handler failed for %s", self.name, subject)
         finally:
             self._sem.release()

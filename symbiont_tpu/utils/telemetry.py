@@ -6,10 +6,22 @@ zero counters. Here:
 
 - Trace: every message carries trace/span ids in bus headers
   (X-Trace-Id/X-Span-Id); `child_headers` propagates across hops; `span`
-  times a handler and logs a structured line.
-- Metrics: process-global registry of counters and histograms (p50/p95/p99),
-  rendered as JSON (api /api/metrics) — these produce the BASELINE.md numbers
-  (per-subject consumed/published/failed, embed throughput, search latency).
+  times a handler, logs a structured line, AND appends a SpanRecord to the
+  process-global flight recorder (obs/trace_store.py) so
+  `GET /api/traces/<id>` can reassemble the full pipeline tree.
+- Metrics: process-global registry of counters, histograms (p50/p95/p99 +
+  exact running min/max), and gauges (set/add, plus callback gauges read at
+  scrape time). All three kinds take optional `{label: value}` labels —
+  rendered as JSON (api /api/metrics) and as Prometheus text exposition
+  (api /metrics, obs/prometheus.py).
+
+Span-id semantics (the contract the trace tree depends on): the X-Span-Id
+header names the ACTIVE span — the one under which a message was published.
+`span()` mints its own id with the header's id as parent and exposes its own
+context at `handle.headers`; `child_headers` PROPAGATES the active context
+unchanged (a bus hop is an edge, not a span). The service base loop hands
+each handler a message rebound to the handler span's context, so every
+downstream publish links to it (services/base.py).
 """
 
 from __future__ import annotations
@@ -20,8 +32,9 @@ import logging
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
+from symbiont_tpu.obs.trace_store import SpanRecord, trace_store
 from symbiont_tpu.utils.ids import generate_uuid
 
 log = logging.getLogger("symbiont.trace")
@@ -35,10 +48,18 @@ def new_trace_headers() -> Dict[str, str]:
 
 
 def child_headers(parent: Optional[Dict[str, str]]) -> Dict[str, str]:
-    """Same trace, fresh span; starts a new trace when no parent context."""
+    """Propagate the active trace context; start a new trace without one.
+
+    The span id is carried over VERBATIM (it names the publishing span):
+    the receiving handler's span records it as parent_id, which is what
+    links hops into one tree. (Pre-obs versions minted a fresh span id per
+    hop — an id that no recorded span owned, so trees could never link.)"""
     if not parent or TRACE_HEADER not in parent:
         return new_trace_headers()
-    return {TRACE_HEADER: parent[TRACE_HEADER], SPAN_HEADER: generate_uuid()}
+    out = {TRACE_HEADER: parent[TRACE_HEADER]}
+    if SPAN_HEADER in parent:
+        out[SPAN_HEADER] = parent[SPAN_HEADER]
+    return out
 
 
 _profile_lock = threading.Lock()
@@ -76,36 +97,80 @@ def maybe_profile(name: str):
         _profile_lock.release()
 
 
+class SpanHandle:
+    """Live-span context yielded by `span()`. `headers` is the context to
+    publish downstream messages under (same trace, THIS span as the active
+    id); `fields` may be extended while the span is open and lands on the
+    flight-recorder record."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "fields")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], fields: dict):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.fields = fields
+
+    @property
+    def headers(self) -> Dict[str, str]:
+        return {TRACE_HEADER: self.trace_id, SPAN_HEADER: self.span_id}
+
+
 @contextmanager
 def span(name: str, headers: Optional[Dict[str, str]] = None, **fields):
-    """Timed span with structured log line (duration_ms, trace id, extras)."""
+    """Timed span: structured log line + `span.<name>.ms` histogram + a
+    SpanRecord in the flight recorder. Errors are accounted, not swallowed:
+    status lands on the record (queryable via /api/traces) and
+    `span.<name>.errors` increments before the exception propagates."""
     t0 = time.perf_counter()
-    trace_id = (headers or {}).get(TRACE_HEADER, "-")
+    start_s = time.time()
+    ctx = headers or {}
+    trace_id = ctx.get(TRACE_HEADER) or generate_uuid()
+    handle = SpanHandle(trace_id, generate_uuid(), ctx.get(SPAN_HEADER),
+                        dict(fields))
+    status = "ok"
     try:
-        yield
-        status = "ok"
-    except Exception:
+        yield handle
+    except BaseException as e:
         status = "error"
+        handle.fields.setdefault("error", type(e).__name__)
+        metrics.inc(f"span.{name}.errors")
         raise
     finally:
         dur_ms = (time.perf_counter() - t0) * 1000
         metrics.observe(f"span.{name}.ms", dur_ms)
-        log.info(json.dumps({"span": name, "trace": trace_id, "status": status,
-                             "duration_ms": round(dur_ms, 3), **fields},
-                            ensure_ascii=False))
+        trace_store.record(SpanRecord(
+            trace_id=trace_id, span_id=handle.span_id,
+            parent_id=handle.parent_id, name=name, start_s=start_s,
+            duration_ms=dur_ms, status=status, fields=handle.fields))
+        log.info(json.dumps({"span": name, "trace": trace_id,
+                             "status": status,
+                             "duration_ms": round(dur_ms, 3),
+                             **handle.fields}, ensure_ascii=False,
+                            default=str))
 
 
 class _Histogram:
-    __slots__ = ("values", "count", "total")
+    __slots__ = ("values", "count", "total", "vmin", "vmax")
 
     def __init__(self) -> None:
         self.values: list = []  # sorted reservoir (bounded)
         self.count = 0
         self.total = 0.0
+        # exact running extremes: the reservoir decimation below drops
+        # alternating samples (including, half the time, the true min) and
+        # truncates tails — min/max must not ride the lossy reservoir
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
 
     def observe(self, v: float) -> None:
         self.count += 1
         self.total += v
+        if self.vmin is None or v < self.vmin:
+            self.vmin = v
+        if self.vmax is None or v > self.vmax:
+            self.vmax = v
         bisect.insort(self.values, v)
         if len(self.values) > 4096:
             # drop alternating samples to stay bounded but keep the shape
@@ -120,37 +185,212 @@ class _Histogram:
     def summary(self) -> dict:
         return {"count": self.count,
                 "mean": self.total / self.count if self.count else 0.0,
+                "min": self.vmin if self.vmin is not None else 0.0,
+                "max": self.vmax if self.vmax is not None else 0.0,
                 "p50": self.quantile(0.50), "p95": self.quantile(0.95),
                 "p99": self.quantile(0.99)}
 
 
+# label set normalized to a sorted tuple: one canonical key per
+# (name, labels) pair regardless of caller dict ordering
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_key(name: str, lk: _LabelKey) -> str:
+    if not lk:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in lk)
+    return f"{name}{{{inner}}}"
+
+
 class Metrics:
+    """Counters + histograms + gauges, each optionally labeled.
+
+    Gauges come in two flavors: value gauges (`gauge_set`/`gauge_add` — e.g.
+    live SSE clients) and callback gauges (`register_gauge` — evaluated at
+    scrape time, e.g. batcher queue depth). A callback returning None (or
+    raising) is dropped from the registry: callbacks close over weakrefs of
+    engine/batcher instances, and a dead instance must disappear from the
+    scrape instead of pinning the object or poisoning the snapshot."""
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._hists: Dict[str, _Histogram] = {}
+        self._counters: Dict[Tuple[str, _LabelKey], float] = {}
+        self._hists: Dict[Tuple[str, _LabelKey], _Histogram] = {}
+        self._gauges: Dict[Tuple[str, _LabelKey], float] = {}
+        self._gauge_fns: Dict[Tuple[str, _LabelKey], Callable] = {}
 
-    def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+    # ------------------------------------------------------------- counters
 
-    def observe(self, name: str, value: float) -> None:
+    def inc(self, name: str, n: float = 1,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        key = (name, _label_key(labels))
         with self._lock:
-            self._hists.setdefault(name, _Histogram()).observe(value)
+            self._counters[key] = self._counters.get(key, 0) + n
 
-    def get(self, name: str) -> int:
+    def get(self, name: str,
+            labels: Optional[Dict[str, str]] = None) -> float:
         with self._lock:
-            return self._counters.get(name, 0)
+            return self._counters.get((name, _label_key(labels)), 0)
+
+    # ----------------------------------------------------------- histograms
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._hists.setdefault(key, _Histogram()).observe(value)
+
+    def histogram_summary(self, name: str,
+                          labels: Optional[Dict[str, str]] = None
+                          ) -> Optional[dict]:
+        with self._lock:
+            h = self._hists.get((name, _label_key(labels)))
+            return h.summary() if h is not None else None
+
+    # --------------------------------------------------------------- gauges
+
+    def gauge_set(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
+
+    def gauge_add(self, name: str, delta: float,
+                  labels: Optional[Dict[str, str]] = None) -> float:
+        key = (name, _label_key(labels))
+        with self._lock:
+            v = self._gauges.get(key, 0) + delta
+            self._gauges[key] = v
+            return v
+
+    def gauge_get(self, name: str,
+                  labels: Optional[Dict[str, str]] = None) -> float:
+        key = (name, _label_key(labels))
+        with self._lock:
+            if key in self._gauges:
+                return self._gauges[key]
+            fn = self._gauge_fns.get(key)
+        if fn is None:
+            return 0
+        evaluated = self._eval_gauge_fns({key: fn})
+        return evaluated.get(key, 0)
+
+    def register_gauge(self, name: str, fn: Callable,
+                       labels: Optional[Dict[str, str]] = None) -> None:
+        """Callback gauge, read at scrape time. Re-registering the same
+        (name, labels) replaces the callback (a fresh engine instance takes
+        over its predecessor's gauge)."""
+        with self._lock:
+            self._gauge_fns[(name, _label_key(labels))] = fn
+
+    def register_weakref_gauge(self, name: str, obj, reader: Callable,
+                               labels: Optional[Dict[str, str]] = None
+                               ) -> None:
+        """Callback gauge bound to `obj` WITHOUT pinning it: the registry
+        holds a weakref, `reader(obj)` produces the value, and when the
+        owner dies (or the reader signals retirement by returning None) the
+        gauge unregisters itself at the next scrape. The one place the
+        owner-lifecycle contract lives — engine/batcher/LM gauges all go
+        through here."""
+        import weakref
+
+        ref = weakref.ref(obj)
+
+        def fn():
+            o = ref()
+            return None if o is None else reader(o)
+
+        self.register_gauge(name, fn, labels=labels)
+
+    def unregister_gauge(self, name: str,
+                         labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauge_fns.pop((name, _label_key(labels)), None)
+
+    def _eval_gauge_fns(self, fns: Dict) -> Dict:
+        """Evaluate callback gauges OUTSIDE the registry lock (a callback may
+        take an engine/batcher lock; holding ours too invites ordering
+        deadlocks). A callback returning None is retired (the weakref-death
+        convention); one that RAISES is skipped for this scrape but kept —
+        a transient error (e.g. a racing collection mutation) must not
+        silently delete a gauge for the life of the process."""
+        out, dead = {}, []
+        for key, fn in fns.items():
+            try:
+                v = fn()
+            except Exception:
+                log.debug("callback gauge %s failed this scrape", key[0],
+                          exc_info=True)
+                continue
+            if v is None:
+                dead.append(key)
+            else:
+                out[key] = v
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._gauge_fns.pop(key, None)
+        return out
+
+    # ------------------------------------------------------------ rendering
+
+    def export(self) -> dict:
+        """Structured dump for renderers: kind → [(name, labels-dict,
+        value-or-summary)]. Callback gauges are evaluated here."""
+        with self._lock:
+            counters = list(self._counters.items())
+            hists = [(k, h.summary()) for k, h in self._hists.items()]
+            gauges = list(self._gauges.items())
+            fns = dict(self._gauge_fns)
+        gauges += list(self._eval_gauge_fns(fns).items())
+        return {
+            "counters": [(n, dict(lk), v) for (n, lk), v in counters],
+            "histograms": [(n, dict(lk), s) for (n, lk), s in hists],
+            "gauges": [(n, dict(lk), v) for (n, lk), v in gauges],
+        }
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {"counters": dict(self._counters),
-                    "histograms": {k: h.summary() for k, h in self._hists.items()}}
+        """JSON-shaped view (api /api/metrics; BASELINE.md numbers). Labeled
+        series render as `name{k="v"}` keys; unlabeled keep their bare name
+        (the shape every pre-obs consumer knows)."""
+        ex = self.export()
+        return {
+            "counters": {_render_key(n, _label_key(lb)): v
+                         for n, lb, v in ex["counters"]},
+            "histograms": {_render_key(n, _label_key(lb)): s
+                           for n, lb, s in ex["histograms"]},
+            "gauges": {_render_key(n, _label_key(lb)): v
+                       for n, lb, v in ex["gauges"]},
+        }
+
+    def flat_snapshot(self) -> Dict[str, float]:
+        """One flat string→number dict (archived into bench JSON so
+        BENCH_*.json carries the internal gauges, not just external
+        timings). Histograms contribute count/p50/p99/min/max."""
+        snap = self.snapshot()
+        flat: Dict[str, float] = {}
+        for k, v in snap["counters"].items():
+            flat[f"counter.{k}"] = v
+        for k, v in snap["gauges"].items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                flat[f"gauge.{k}"] = float(v)
+        for k, s in snap["histograms"].items():
+            for stat in ("count", "p50", "p99", "min", "max"):
+                flat[f"hist.{k}.{stat}"] = float(s[stat])
+        return flat
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._hists.clear()
+            self._gauges.clear()
+            self._gauge_fns.clear()
 
 
 metrics = Metrics()

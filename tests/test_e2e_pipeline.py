@@ -130,6 +130,24 @@ def test_ingest_search_generate_roundtrip(stack_config):
             assert status == 200
             assert body["counters"].get("api.fused_search", 0) >= 1
 
+            # Prometheus exposition over the SAME run: the engine-plane
+            # gauges (compile count, batch fill ratio, batcher queue depth)
+            # carry service labels (obs acceptance criterion)
+            def fetch_metrics():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                    return r.status, r.headers["Content-Type"], \
+                        r.read().decode()
+
+            status, ctype, text = await loop.run_in_executor(
+                None, fetch_metrics)
+            assert status == 200 and ctype.startswith("text/plain")
+            assert 'symbiont_engine_compiles{service="engine"}' in text
+            assert ('symbiont_engine_batch_fill_ratio{service="engine"}'
+                    in text)
+            assert 'symbiont_batcher_queue_depth{batcher="embed"' in text
+            assert "# TYPE symbiont_span_duration_ms summary" in text
+
             # --- 3.2b search + cross-encoder rerank (BASELINE #4) --------
             status, body = await http("POST", port, "/api/search/semantic",
                                       {"query_text": "matrix multiplication",

@@ -734,7 +734,7 @@ def test_gen_batcher_requeue_wakes_run_loop():
         b._wake.clear()
         await b._flush(batch)
         assert first.future.result() == "first done"
-        assert b._queue == [late]      # rejected newcomer was re-queued...
+        assert list(b._queue) == [late]  # rejected newcomer was re-queued...
         assert b._wake.is_set()        # ...and the run loop was woken
 
     asyncio.run(scenario())

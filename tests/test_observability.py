@@ -1,0 +1,487 @@
+"""Observability subsystem tests: flight-recorder trace store, span parent
+linkage, labeled metrics + gauges, Prometheus text exposition, SLO watchdog,
+batcher queue swap, and end-to-end trace propagation through a full (stub-
+engine) runner stack over the in-proc bus.
+"""
+
+import asyncio
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from symbiont_tpu.obs import prometheus
+from symbiont_tpu.obs.trace_store import SpanRecord, TraceStore, trace_store
+from symbiont_tpu.obs.watchdog import SloWatchdog, parse_thresholds
+from symbiont_tpu.utils.telemetry import (
+    SPAN_HEADER,
+    TRACE_HEADER,
+    Metrics,
+    _Histogram,
+    child_headers,
+    metrics,
+    span,
+)
+
+
+def _rec(trace="t1", sid="s1", parent=None, name="svc.op", start=100.0,
+         dur=5.0, status="ok"):
+    return SpanRecord(trace_id=trace, span_id=sid, parent_id=parent,
+                      name=name, start_s=start, duration_ms=dur,
+                      status=status)
+
+
+# --------------------------------------------------------------- trace store
+
+def test_trace_tree_parent_linkage():
+    ts = TraceStore(capacity=16)
+    ts.record(_rec(sid="root", name="api.submit_url", start=1.0))
+    ts.record(_rec(sid="c1", parent="root", name="perception.handle",
+                   start=2.0))
+    ts.record(_rec(sid="c2", parent="c1", name="preprocessing.handle",
+                   start=3.0))
+    ts.record(_rec(sid="c3", parent="c1", name="vector_memory.handle",
+                   start=4.0, status="error"))
+    tree = ts.trace_tree("t1")
+    assert tree["span_count"] == 4
+    assert tree["error_count"] == 1
+    assert tree["services"] == ["api", "perception", "preprocessing",
+                                "vector_memory"]
+    (root,) = tree["roots"]
+    assert root["name"] == "api.submit_url"
+    (c1,) = root["children"]
+    assert c1["name"] == "perception.handle"
+    assert {c["name"] for c in c1["children"]} == {
+        "preprocessing.handle", "vector_memory.handle"}
+
+
+def test_trace_tree_orphan_parent_becomes_root():
+    # parent evicted from the ring (or a hop through the native workers):
+    # the span must surface as a root, not vanish
+    ts = TraceStore(capacity=16)
+    ts.record(_rec(sid="x", parent="never-recorded"))
+    tree = ts.trace_tree("t1")
+    assert len(tree["roots"]) == 1
+    assert ts.trace_tree("missing") is None
+
+
+def test_trace_store_ring_bound_and_recent_order():
+    ts = TraceStore(capacity=8)
+    for i in range(20):
+        ts.record(_rec(trace=f"t{i}", sid=f"s{i}", start=float(i),
+                       dur=float(i)))
+    assert len(ts) == 8  # bounded: oldest 12 evicted
+    ts.record(_rec(trace="terr", sid="serr", start=0.5, dur=0.1,
+                   status="error"))
+    recent = ts.recent(limit=3)
+    # errored traces first, then slowest
+    assert recent[0]["trace_id"] == "terr"
+    durs = [r["duration_ms"] for r in recent[1:]]
+    assert durs == sorted(durs, reverse=True)
+
+
+# ---------------------------------------------------------------------- span
+
+def test_span_records_parent_linkage_and_error_accounting():
+    trace_store.clear()
+    errors_before = metrics.get("span.obs_test.child.errors")
+    with span("obs_test.root", None) as root_sp:
+        ctx = child_headers(root_sp.headers)
+        # child_headers PROPAGATES the active span id (a hop is an edge)
+        assert ctx[SPAN_HEADER] == root_sp.span_id
+        assert ctx[TRACE_HEADER] == root_sp.trace_id
+        with pytest.raises(ValueError):
+            with span("obs_test.child", ctx):
+                raise ValueError("boom")
+    assert metrics.get("span.obs_test.child.errors") == errors_before + 1
+    spans = trace_store.spans_for(root_sp.trace_id)
+    by_name = {s.name: s for s in spans}
+    assert by_name["obs_test.root"].status == "ok"
+    child = by_name["obs_test.child"]
+    assert child.status == "error"
+    assert child.parent_id == root_sp.span_id
+    assert child.fields["error"] == "ValueError"
+    tree = trace_store.trace_tree(root_sp.trace_id)
+    (root_node,) = tree["roots"]
+    assert [c["name"] for c in root_node["children"]] == ["obs_test.child"]
+
+
+# ------------------------------------------------------------------- metrics
+
+def test_histogram_exact_min_max_survive_decimation():
+    h = _Histogram()
+    values = list(np.random.default_rng(0).uniform(10.0, 100.0, 6000))
+    values[137] = 1.25   # unique true min, early (decimation drops evens)
+    values[5391] = 999.5  # unique true max
+    for v in values:
+        h.observe(v)
+    s = h.summary()
+    assert len(h.values) < 6000  # the reservoir actually decimated
+    assert s["min"] == 1.25
+    assert s["max"] == 999.5
+    assert s["count"] == 6000
+
+
+def test_labeled_metrics_and_gauges():
+    m = Metrics()
+    m.inc("bus.consumed", labels={"service": "api", "subject": "a.b"})
+    m.inc("bus.consumed", labels={"subject": "a.b", "service": "api"})
+    assert m.get("bus.consumed", labels={"service": "api",
+                                         "subject": "a.b"}) == 2
+    m.gauge_add("api.sse_clients", 1)
+    m.gauge_add("api.sse_clients", -1)
+    snap = m.snapshot()
+    assert snap["counters"]['bus.consumed{service="api",subject="a.b"}'] == 2
+    assert snap["gauges"]["api.sse_clients"] == 0
+
+
+def test_callback_gauge_dropped_when_dead():
+    m = Metrics()
+
+    class Owner:
+        pass
+
+    import weakref
+
+    owner = Owner()
+    ref = weakref.ref(owner)
+    m.register_gauge("x.depth", lambda: 7 if ref() is not None else None)
+    assert m.snapshot()["gauges"]["x.depth"] == 7
+    del owner
+    assert "x.depth" not in m.snapshot()["gauges"]
+    assert "x.depth" not in m.snapshot()["gauges"]  # stays dropped
+
+
+# ---------------------------------------------------------------- prometheus
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|NaN|[+-]Inf)$')
+
+
+def test_prometheus_exposition_parses():
+    m = Metrics()
+    m.inc("perception.published", 3)
+    m.inc("api.POST./api/submit-url")  # hostile chars in the name
+    m.observe("span.api.search.ms", 12.0)
+    m.observe("span.api.search.ms", 30.0)
+    m.gauge_set("batcher.queue_depth", 4,
+                labels={"service": "engine", "batcher": "embed"})
+    out = prometheus.render(m)
+    assert out.endswith("\n")
+    declared_type = {}
+    seen_samples = set()
+    for line in out.splitlines():
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "summary")
+            declared_type[name] = kind
+            continue
+        match = _SAMPLE_RE.match(line)
+        assert match, f"unparseable sample line: {line!r}"
+        base = match.group(1)
+        family = re.sub(r"_(sum|count|min|max)$", "", base)
+        assert base in declared_type or family in declared_type, (
+            f"sample {base} has no preceding TYPE")
+        seen_samples.add(base)
+    assert "symbiont_published_total" in seen_samples
+    assert "symbiont_batcher_queue_depth" in seen_samples
+    assert "symbiont_span_duration_ms" in seen_samples
+    assert declared_type["symbiont_span_duration_ms"] == "summary"
+    # service labels derived from dot names
+    assert 'symbiont_published_total{service="perception"} 3' in out
+    assert ('symbiont_span_duration_ms_count'
+            '{service="api",span="api.search"} 2') in out
+
+
+def test_prometheus_label_escaping_roundtrip():
+    hostile = 'a"b\\c\nd'
+    m = Metrics()
+    m.gauge_set("g", 1, labels={"k": hostile})
+    out = prometheus.render(m)
+    (line,) = [ln for ln in out.splitlines() if not ln.startswith("#")]
+    assert "\n" not in line  # the raw newline must have been escaped
+    escaped = line.split('k="', 1)[1].rsplit('"', 1)[0]
+    unescaped = (escaped.replace("\\n", "\n").replace('\\"', '"')
+                 .replace("\\\\", "\\"))
+    assert prometheus.escape_label_value(hostile) == escaped
+    # NB: naive sequential unescape is escape-order sensitive; exact
+    # equality via the library's own escape is the contract under test
+    assert unescaped.count("b") == 1
+
+
+# ------------------------------------------------------------------ watchdog
+
+def test_watchdog_threshold_parsing():
+    assert parse_thresholds(["api.search=500", "x.y=1.5"]) == {
+        "api.search": 500.0, "x.y": 1.5}
+    for bad in (["api.search"], ["=5"], ["a=notanumber"], ["a=-3"]):
+        with pytest.raises(ValueError):
+            parse_thresholds(bad)
+
+
+def test_watchdog_breach_emits_structured_event():
+    m = Metrics()
+    for v in (5.0, 6.0, 900.0):
+        m.observe("span.api.search.ms", v)
+    m.observe("span.api.healthy.ms", 1.0)
+    wd = SloWatchdog({"api.search": 100.0, "api.healthy": 100.0,
+                      "api.never_ran": 1.0}, registry=m)
+    breaches = wd.evaluate()
+    assert len(breaches) == 1
+    ev = breaches[0]
+    assert ev["event"] == "slo_breach" and ev["span"] == "api.search"
+    assert ev["p99_ms"] > ev["threshold_ms"] == 100.0
+    assert m.get("slo.breaches", labels={"span": "api.search"}) == 1
+    # evaluated p99 exported for BOTH spans, breached or not
+    gauges = m.snapshot()["gauges"]
+    assert 'slo.p99_ms{span="api.search"}' in gauges
+    assert 'slo.p99_ms{span="api.healthy"}' in gauges
+    assert list(wd.events) == breaches
+    # idle span (no new samples): no re-alert off the same old outlier
+    assert wd.evaluate() == []
+    assert m.get("slo.breaches", labels={"span": "api.search"}) == 1
+    # fresh samples while still breached: the counter keeps counting
+    m.observe("span.api.search.ms", 2.0)
+    wd.evaluate()
+    assert m.get("slo.breaches", labels={"span": "api.search"}) == 2
+
+
+# ------------------------------------------------------- batcher queue swap
+
+def test_batcher_deque_order_and_accounting():
+    from symbiont_tpu.engine.batcher import _BatcherBase
+
+    class Item:
+        def __init__(self, tag, size):
+            self.tag, self.size = tag, size
+            self.future = None
+
+    class B(_BatcherBase):
+        def _size(self, item):
+            return item.size
+
+    b = B(max_batch=4, deadline_s=0.01)
+    for i, size in enumerate([2, 1, 1, 3]):
+        b._submit(Item(i, size))
+    assert b._queued == 7
+    chunk = b._take_chunk()
+    # FIFO: 2+1+1 fits in max_batch=4; the 3-sized item stays queued
+    assert [it.tag for it in chunk] == [0, 1, 2]
+    assert b._queued == 3
+    # requeue puts items back at the FRONT in original order
+    b._requeue(chunk[1:])
+    assert [it.tag for it in b._queue] == [1, 2, 3]
+    assert b._queued == 5
+    assert b._wake.is_set()
+    # oversized head still moves alone (the "always at least one" contract)
+    big = b._take_chunk()
+    assert [it.tag for it in big] == [1, 2]  # 1+1 fits, then 3 would exceed
+    assert [it.tag for it in b._take_chunk()] == [3]
+    assert b._queued == 0
+
+
+def test_batcher_gen_queue_survives_steal_and_requeue():
+    # the GenBatcher steal pattern: list(queue) + clear + partial requeue
+    from symbiont_tpu.engine.batcher import _BatcherBase
+
+    class Item:
+        def __init__(self, tag):
+            self.tag = tag
+            self.future = None
+
+    class B(_BatcherBase):
+        def _size(self, item):
+            return 1
+
+    b = B(max_batch=8, deadline_s=0.01)
+    for i in range(5):
+        b._submit(Item(i))
+    candidates = list(b._queue)
+    b._queue.clear()
+    b._queued -= sum(b._size(c) for c in candidates)
+    assert b._queued == 0
+    b._submit(Item(99))  # arrives mid-steal
+    b._requeue(candidates[3:])  # transient rejects go back to the front
+    assert [it.tag for it in b._queue] == [3, 4, 99]
+    assert b._queued == 3
+
+
+# ----------------------------------------------------- SSE gauge (satellite)
+
+def test_sse_clients_is_a_real_gauge():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import ApiConfig
+    from symbiont_tpu.services.api import ApiService
+
+    async def scenario():
+        api = ApiService(InprocBus(), ApiConfig(port=0, sse_keepalive_s=0.2))
+        await api.start()
+        base_gauge = metrics.gauge_get("api.sse_clients")
+        base_total = metrics.get("api.sse_clients_total")
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           api.port)
+            writer.write(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            await reader.readline()  # HTTP/1.1 200 OK
+            for _ in range(50):
+                if metrics.gauge_get("api.sse_clients") == base_gauge + 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert metrics.gauge_get("api.sse_clients") == base_gauge + 1
+            assert metrics.get("api.sse_clients_total") == base_total + 1
+            writer.close()
+            await writer.wait_closed()
+            for _ in range(100):
+                if metrics.gauge_get("api.sse_clients") == base_gauge:
+                    break
+                await asyncio.sleep(0.05)
+            # DECREMENTED on disconnect (the pre-obs counter only ever rose)
+            assert metrics.gauge_get("api.sse_clients") == base_gauge
+            assert metrics.get("api.sse_clients_total") == base_total + 1
+        finally:
+            await api.stop()
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------- e2e trace propagation (runner)
+
+class _StubEngine:
+    """Duck-typed engine: deterministic fake embeddings, no device, no
+    compiles — the trace-propagation test is about span plumbing, not BERT."""
+
+    class _ModelCfg:
+        hidden_size = 16
+
+    def __init__(self):
+        from symbiont_tpu.config import EngineConfig
+
+        self.config = EngineConfig(embedding_dim=16, max_batch=8,
+                                   flush_deadline_ms=2.0)
+        self.model_cfg = self._ModelCfg()
+        self.cross_params = None
+        self.stats = {"embed_calls": 0, "compiles": 0}
+
+    def embed_texts(self, texts):
+        self.stats["embed_calls"] += 1
+        rng = np.random.default_rng(len(texts))
+        return rng.standard_normal((len(texts), 16)).astype(np.float32)
+
+
+def test_ingest_trace_spans_pipeline(tmp_path):
+    """A submitted URL yields ONE trace id whose parent-linked tree spans
+    the ingest pipeline (≥3 services) — the flight-recorder acceptance
+    criterion, driven through the real runner + HTTP surface."""
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (
+        ApiConfig,
+        GraphStoreConfig,
+        SymbiontConfig,
+        TextGeneratorConfig,
+        VectorStoreConfig,
+    )
+    from symbiont_tpu.runner import SymbiontStack
+
+    page = ("<html><body><main><p>Tracing the pipeline end to end.</p>"
+            "<p>Spans must link across services!</p></main></body></html>")
+
+    cfg = SymbiontConfig(
+        vector_store=VectorStoreConfig(dim=16,
+                                       data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(markov_state_path=None),
+        api=ApiConfig(host="127.0.0.1", port=0),
+    )
+    cfg.runner.services = ("perception,preprocessing,vector_memory,"
+                           "knowledge_graph,api")
+
+    async def scenario():
+        trace_store.clear()
+        stack = SymbiontStack(cfg, bus=InprocBus(), engine=_StubEngine(),
+                              fetcher=lambda url: page)
+        await stack.start()
+        port = stack.api.port
+        loop = asyncio.get_running_loop()
+
+        def http_get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, json.loads(r.read())
+
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/submit-url",
+                data=json.dumps({"url": "http://fake/doc"}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            status = (await loop.run_in_executor(
+                None, lambda: urllib.request.urlopen(req, timeout=10))).status
+            assert status == 200
+            for _ in range(200):
+                if (stack.vector_store.count() >= 2
+                        and stack.graph_store.counts()["Document"] >= 1):
+                    break
+                await asyncio.sleep(0.05)
+            assert stack.vector_store.count() >= 2
+
+            status, body = await loop.run_in_executor(
+                None, http_get, "/api/traces/recent")
+            assert status == 200
+            ingest = [t for t in body["traces"]
+                      if t["root"] == "api.submit_url"]
+            assert ingest, f"no ingest trace in {body['traces']}"
+            summary = ingest[0]
+            assert summary["error_count"] == 0
+            assert len(summary["services"]) >= 3
+
+            status, tree = await loop.run_in_executor(
+                None, http_get, f"/api/traces/{summary['trace_id']}")
+            assert status == 200
+            services = set(tree["services"])
+            assert {"api", "perception", "preprocessing",
+                    "vector_memory"} <= services
+            # parent-linked: ONE root (the submit span), everything else
+            # hangs off it
+            assert len(tree["roots"]) == 1
+            root = tree["roots"][0]
+            assert root["name"] == "api.submit_url"
+
+            def names(node):
+                out = {node["name"]}
+                for c in node["children"]:
+                    out |= names(c)
+                return out
+
+            reachable = names(root)
+            assert "perception.handle" in reachable
+            assert "preprocessing.handle" in reachable
+            assert "vector_memory.handle" in reachable
+            assert "vector_memory.upsert" in reachable
+            # Prometheus exposition over the same run, with the engine-plane
+            # gauges the acceptance criterion names
+            def get_text(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                    return r.status, r.headers["Content-Type"], \
+                        r.read().decode()
+
+            status, ctype, text = await loop.run_in_executor(
+                None, get_text, "/metrics")
+            assert status == 200 and ctype.startswith("text/plain")
+            assert 'symbiont_batcher_queue_depth{batcher="embed"' in text
+            assert ('symbiont_batcher_last_flush_fill_ratio'
+                    '{batcher="embed",service="engine"}') in text
+            assert ('symbiont_bus_consumed_total{service="perception"'
+                    in text)
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())
