@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Chaos suite: seeded fault-injection scenarios asserting zero-loss ingest
+# under each fault class (docs/RESILIENCE.md). Deterministic (seeded
+# FaultPlans) and device-free — runs anywhere the fast test tier runs.
+#
+#   scripts/chaos.sh            # the whole suite
+#   scripts/chaos.sh -k poison  # one scenario
+#
+# The same suite runs as the bench subsystem's `chaos` tier
+# (symbiont_tpu/bench/chaos.py), where its pass rate is archived and
+# regression-gated like a perf metric; this script is the fast local loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# scoped to the chaos module (not the whole tree) so an unrelated module's
+# env-dependent collection error can't block the fault suite
+exec python -m pytest tests/test_chaos.py -m chaos -q "$@"

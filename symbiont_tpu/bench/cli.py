@@ -3,6 +3,7 @@
     python bench.py                 # full run, all tiers
     python bench.py --quick         # embed-policy tier only (~1 min)
     python bench.py --no-e2e        # skip the full-stack tier
+    python bench.py --no-chaos      # skip the fault-injection tier
     python bench.py --render-doc BENCH_rNN.json > docs/PERF.md
     python bench.py --gate NEW.json BASELINE.json   # regression gate
     python bench.py --validate ARCHIVE.json [...]   # schema check
@@ -189,11 +190,13 @@ def main(argv=None) -> int:
     import jax
 
     # tier implementations register themselves on import; import order IS
-    # run order: policy A/B, compute MFU, engine plane, decode, full stack
+    # run order: policy A/B, compute MFU, engine plane, decode, full stack,
+    # then the fault-injection (loss-under-fault) tier
     from symbiont_tpu.bench import compute  # noqa: F401
     from symbiont_tpu.bench import engine_plane  # noqa: F401
     from symbiont_tpu.bench import decode  # noqa: F401
     from symbiont_tpu.bench import e2e  # noqa: F401
+    from symbiont_tpu.bench import chaos  # noqa: F401
 
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
@@ -202,8 +205,12 @@ def main(argv=None) -> int:
 
     quick = "--quick" in argv
     results: dict = {}
-    run = tiers.run_tiers(results, ctx, quick=quick,
-                          skip=("e2e",) if "--no-e2e" in argv else (),
+    skip = []
+    if "--no-e2e" in argv:
+        skip.append("e2e")
+    if "--no-chaos" in argv:
+        skip.append("chaos")
+    run = tiers.run_tiers(results, ctx, quick=quick, skip=tuple(skip),
                           log=log)
     # dual-ceiling utilization over every decode point, after ALL tiers:
     # the reference kernel and the best-OTHER-observed stream are only

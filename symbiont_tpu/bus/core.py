@@ -33,7 +33,11 @@ class Subscription:
             return True
         except asyncio.QueueFull:
             # drop-on-overflow like a core-NATS slow consumer; callers that
-            # need at-least-once use the durable layer
+            # need at-least-once use the durable layer. Counted — a silent
+            # drop is the reference's failure policy, not ours
+            from symbiont_tpu.utils.telemetry import metrics
+
+            metrics.inc("bus.dropped", labels={"subject": self.subject})
             return False
 
     async def next(self, timeout: Optional[float] = None) -> Optional[Msg]:

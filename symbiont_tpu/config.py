@@ -43,6 +43,12 @@ class BusConfig:
     durable_ack_wait_s: float = 60.0
     durable_max_deliver: int = 5
 
+    def __post_init__(self) -> None:
+        if self.durable_ack_wait_s <= 0:
+            raise ValueError("bus.durable_ack_wait_s must be positive")
+        if self.durable_max_deliver < 1:
+            raise ValueError("bus.durable_max_deliver must be >= 1")
+
 
 @dataclass
 class EngineConfig:
@@ -269,6 +275,63 @@ class ObsConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Resilience plane (symbiont_tpu/resilience/, docs/RESILIENCE.md):
+    handler timeouts/retries, store circuit breakers with WAL spill, the
+    dead-letter quarantine, and loop-supervisor backoff."""
+
+    # per-handler deadline; the handler is CANCELLED at the deadline and a
+    # durable delivery stays unacked for redelivery. 0 disables (default:
+    # first-call XLA compiles can legitimately take minutes on a cold
+    # engine; production deployments should set an explicit budget).
+    handler_timeout_s: float = 0.0
+    # in-process retries for a FAILED (not timed-out) handler, with
+    # full-jitter exponential backoff between attempts
+    handler_retries: int = 0
+    handler_backoff_base_s: float = 0.05
+    handler_backoff_max_s: float = 2.0
+    # circuit breakers around the EXTERNAL store backends (Qdrant/Neo4j):
+    # after `breaker_failure_threshold` consecutive failures the breaker
+    # opens, writes spill to a local WAL (replayed on recovery), and a
+    # half-open probe is admitted every `breaker_reset_timeout_s`
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_reset_timeout_s: float = 30.0
+    # spill WAL directory for breaker-degraded writes
+    spill_dir: str = "data/resilience"
+    # dead-letter quarantine ring size (inproc durable bus; GET /api/dlq)
+    dlq_capacity: int = 256
+    # restart backoff for crashed service dispatch loops
+    supervisor_backoff_base_s: float = 0.5
+    supervisor_backoff_max_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.handler_timeout_s < 0:
+            raise ValueError("resilience.handler_timeout_s must be >= 0")
+        if self.handler_retries < 0:
+            raise ValueError("resilience.handler_retries must be >= 0")
+        if (self.handler_backoff_base_s <= 0
+                or self.handler_backoff_max_s < self.handler_backoff_base_s):
+            raise ValueError(
+                "resilience.handler_backoff_base_s must be positive and "
+                "<= handler_backoff_max_s")
+        if self.breaker_failure_threshold < 1:
+            raise ValueError(
+                "resilience.breaker_failure_threshold must be >= 1")
+        if self.breaker_reset_timeout_s <= 0:
+            raise ValueError(
+                "resilience.breaker_reset_timeout_s must be positive")
+        if self.dlq_capacity < 1:
+            raise ValueError("resilience.dlq_capacity must be >= 1")
+        if (self.supervisor_backoff_base_s <= 0
+                or self.supervisor_backoff_max_s
+                < self.supervisor_backoff_base_s):
+            raise ValueError(
+                "resilience.supervisor_backoff_base_s must be positive and "
+                "<= supervisor_backoff_max_s")
+
+
+@dataclass
 class RunnerConfig:
     """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
 
@@ -296,6 +359,7 @@ class SymbiontConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runner: RunnerConfig = field(default_factory=RunnerConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def __post_init__(self) -> None:
         # cross-section invariant: every top_k the gateway routes to the

@@ -157,10 +157,30 @@ class Neo4jGraphStore:
         pass
 
 
-def make_graph_store(config: GraphStoreConfig):
-    """Backend selection: uri set → external Neo4j; else embedded sqlite."""
+def make_graph_store(config: GraphStoreConfig, resilience=None):
+    """Backend selection: uri set → external Neo4j; else embedded sqlite.
+
+    With a ResilienceConfig (and breakers enabled), the EXTERNAL backend is
+    wrapped in a circuit breaker + document spill (resilience/stores.py):
+    a mid-run Neo4j outage spools save_tokenized payloads locally and
+    replays them on recovery instead of dropping them."""
     if config.uri:
-        return Neo4jGraphStore(config)
+        store = Neo4jGraphStore(config)
+        if resilience is not None and resilience.breaker_enabled:
+            from pathlib import Path
+
+            from symbiont_tpu.resilience.breaker import CircuitBreaker
+            from symbiont_tpu.resilience.stores import ResilientGraphStore
+
+            return ResilientGraphStore(
+                store,
+                breaker=CircuitBreaker(
+                    "graph_store",
+                    failure_threshold=resilience.breaker_failure_threshold,
+                    reset_timeout_s=resilience.breaker_reset_timeout_s),
+                spill_path=str(Path(resilience.spill_dir)
+                               / "graph.spill.jsonl"))
+        return store
     from symbiont_tpu.graph.store import GraphStore
 
     return GraphStore(config)

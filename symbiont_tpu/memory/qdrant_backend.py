@@ -149,11 +149,32 @@ class QdrantStore:
         return int(out.get("result", {}).get("count", 0))
 
 
-def make_vector_store(config: VectorStoreConfig, mesh=None):
+def make_vector_store(config: VectorStoreConfig, mesh=None, resilience=None):
     """Backend selection: uri set → external Qdrant; else the embedded
-    TPU-native store (the default and the fast path)."""
+    TPU-native store (the default and the fast path).
+
+    With a ResilienceConfig (and breakers enabled), the EXTERNAL backend is
+    wrapped in a circuit breaker + WAL spill (resilience/stores.py): a
+    mid-run Qdrant outage degrades to local spooling instead of turning
+    every embedding into a dropped write. The embedded store needs no
+    breaker — its failure domain is the process itself."""
     if config.uri:
-        return QdrantStore(config)
+        store = QdrantStore(config)
+        if resilience is not None and resilience.breaker_enabled:
+            from pathlib import Path
+
+            from symbiont_tpu.resilience.breaker import CircuitBreaker
+            from symbiont_tpu.resilience.stores import ResilientVectorStore
+
+            return ResilientVectorStore(
+                store,
+                breaker=CircuitBreaker(
+                    "vector_store",
+                    failure_threshold=resilience.breaker_failure_threshold,
+                    reset_timeout_s=resilience.breaker_reset_timeout_s),
+                spill_path=str(Path(resilience.spill_dir)
+                               / f"{config.collection}.spill.jsonl"))
+        return store
     from symbiont_tpu.memory.vector_store import VectorStore
 
     return VectorStore(config, mesh=mesh)

@@ -1,0 +1,29 @@
+"""Resilience plane (SURVEY.md §5.3: the reference's one failure policy is
+log-and-drop).
+
+Modules:
+- faults:     deterministic seeded fault injection at the bus/store seams
+              (the chaos-test harness; a no-op unless a plan is active);
+- breaker:    circuit breakers with closed/open/half-open states and
+              `breaker.*` gauges;
+- dlq:        bounded dead-letter quarantine store behind `GET /api/dlq`;
+- stores:     breaker + WAL-spill wrappers over the vector/graph backends
+              (graceful degradation: an outage spills writes locally and
+              replays them on recovery);
+- supervisor: restart-with-backoff for long-lived service loop tasks.
+
+docs/RESILIENCE.md carries the fault → layer → policy → metric matrix.
+"""
+
+from symbiont_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
+from symbiont_tpu.resilience.dlq import DeadLetterStore
+from symbiont_tpu.resilience.faults import FaultInjected, FaultPlan, FaultRule
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DeadLetterStore",
+    "FaultInjected",
+    "FaultPlan",
+    "FaultRule",
+]

@@ -86,7 +86,9 @@ class SymbiontStack:
         # at-least-once pipeline (SURVEY.md §5.3): one durable stream captures
         # the fire-and-forget subjects; each consumer acks after its side
         # effect lands. Request-reply subjects stay core (their failure mode
-        # is the caller's timeout + retry).
+        # is the caller's timeout + retry). Both the native broker AND the
+        # default in-proc bus implement the stream contract now (resilience
+        # plane) — bus.durable works on the single-process stack.
         pipeline_stream = None
         if cfg.bus.durable and hasattr(self.bus, "add_stream"):
             pipeline_stream = "pipeline"
@@ -99,7 +101,11 @@ class SymbiontStack:
                 max_deliver=cfg.bus.durable_max_deliver)
         elif cfg.bus.durable:
             log.warning("bus.durable requested but transport %s has no "
-                        "durable streams (use symbus://)", cfg.bus.url)
+                        "durable streams (use inproc:// or symbus://)",
+                        cfg.bus.url)
+        # size the dead-letter quarantine behind GET /api/dlq (inproc bus)
+        if hasattr(self.bus, "dlq"):
+            self.bus.dlq.capacity = cfg.resilience.dlq_capacity
         if on("preprocessing") or on("engine"):
             self.engine = self._engine_override or TpuEngine(cfg.engine,
                                                              mesh=self._mesh)
@@ -120,7 +126,8 @@ class SymbiontStack:
             # backend; else the embedded TPU-native store
             from symbiont_tpu.memory.qdrant_backend import make_vector_store
 
-            self.vector_store = make_vector_store(vs_cfg, mesh=self._mesh)
+            self.vector_store = make_vector_store(
+                vs_cfg, mesh=self._mesh, resilience=cfg.resilience)
             if not on("vector_memory"):
                 # engine-only deployment: VectorMemoryService isn't there to
                 # run the startup ensure, so do it here (idempotent);
@@ -131,7 +138,8 @@ class SymbiontStack:
             # uri set (or reference NEO4J_URI alias) → external Neo4j backend
             from symbiont_tpu.graph.neo4j_backend import make_graph_store
 
-            self.graph_store = make_graph_store(cfg.graph_store)
+            self.graph_store = make_graph_store(cfg.graph_store,
+                                                resilience=cfg.resilience)
             if not on("knowledge_graph"):
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.graph_store.ensure_schema)  # engine-only: see above
@@ -207,6 +215,9 @@ class SymbiontStack:
                 lm_batcher=lm_batcher,
                 vector_store=self.vector_store, graph_store=self.graph_store))
         for s in self.services:
+            # handler timeout/retry + loop-supervisor knobs (resilience
+            # plane); services may further tune their own fields after
+            s.apply_resilience(cfg.resilience)
             await s.start()
         if on("api"):
             self.api = ApiService(self.bus, cfg.api, cfg.bus)

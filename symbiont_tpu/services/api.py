@@ -353,6 +353,11 @@ class ApiService:
                                     "flight recorder, or never recorded)",
                          "task_id": None})
                 return 200, json.dumps(tree)
+            if path == "/api/dlq" and method == "GET":
+                return self._dlq_list()
+            if path == "/api/dlq/replay" and method == "POST":
+                metrics.inc("api.POST./api/dlq/replay")
+                return await self._dlq_replay(body)
             if path == "/healthz" and method == "GET":
                 return 200, json.dumps({"status": "ok"})
             if path == "/api/health/engine" and method == "GET":
@@ -473,6 +478,52 @@ class ApiService:
             if req.rerank and results:
                 return await self._apply_rerank(req, results, resp, trace)
             return 200, resp(results)
+
+    # ------------------------------------------------------------------ DLQ
+
+    def _dlq_store(self):
+        """The bus-attached dead-letter quarantine (inproc durable layer).
+        On broker transports quarantine lives broker-side; this surface
+        reports unavailable rather than pretending it is empty."""
+        return getattr(self.bus, "dlq", None)
+
+    def _dlq_list(self) -> Tuple[int, str]:
+        store = self._dlq_store()
+        if store is None:
+            return 200, json.dumps({
+                "available": False, "size": 0, "entries": [],
+                "message": ("no in-process DLQ on this bus transport — "
+                            "dead letters are accounted broker-side "
+                            "(stream_stats dead_lettered)")})
+        return 200, json.dumps({
+            "available": True, "size": len(store),
+            "entries": [e.summary() for e in store.list()]})
+
+    async def _dlq_replay(self, body: bytes) -> Tuple[int, str]:
+        """Replay quarantined message(s) to their original subject —
+        body {"id": N} for one entry, {"all": true} for everything. The
+        replayed message re-enters the durable flow with a fresh delivery
+        budget (fix the handler first)."""
+        store = self._dlq_store()
+        if store is None:
+            return 503, json.dumps(
+                {"message": "no in-process DLQ on this bus transport",
+                 "replayed": 0})
+        data = json.loads(body) if body else {}
+        entry_id = data.get("id")
+        if entry_id is None and not data.get("all"):
+            return 400, json.dumps(
+                {"message": 'pass {"id": N} or {"all": true}',
+                 "replayed": 0})
+        if entry_id is not None and not isinstance(entry_id, int):
+            return 400, json.dumps(
+                {"message": "id must be an integer", "replayed": 0})
+        replayed = await store.replay(self.bus, entry_id)
+        if entry_id is not None and replayed == 0:
+            return 404, json.dumps(
+                {"message": f"no DLQ entry {entry_id} (already replayed or "
+                            "evicted)", "replayed": 0})
+        return 200, json.dumps({"replayed": replayed})
 
     async def _engine_health(self) -> Tuple[int, str]:
         """Engine-plane health over HTTP: one bus round-trip to

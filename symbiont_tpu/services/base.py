@@ -2,13 +2,20 @@
 
 Mirrors the reference's per-service main-loop shape (subscribe →
 `while let Some(msg) = sub.next().await` → spawn handler; e.g. reference:
-services/perception_service/src/main.rs:172-247) with the two flaws fixed
-that SURVEY.md §5.2/§5.3 documents:
+services/perception_service/src/main.rs:172-247) with the flaws fixed that
+SURVEY.md §5.2/§5.3 documents:
 
 - bounded concurrency (semaphore) instead of unbounded tokio::spawn;
 - queue-group subscriptions so replicas shard work instead of duplicating it;
 - handler failures are counted + logged with trace context, never kill the
-  loop.
+  loop;
+- (resilience plane) per-handler TIMEOUT — a hung handler is cancelled, so
+  it can never pin a semaphore slot, and its durable delivery stays unacked
+  for redelivery — plus in-process RETRY with jittered exponential backoff
+  for transient failures, both configurable via ResilienceConfig /
+  `apply_resilience()`;
+- dispatch loops are SUPERVISED (resilience/supervisor.py): a crashed loop
+  restarts with backoff instead of dying unlogged.
 """
 
 from __future__ import annotations
@@ -16,14 +23,27 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import random
 from typing import Awaitable, Callable, Optional
 
 from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.resilience import faults
+from symbiont_tpu.resilience.supervisor import supervise
+from symbiont_tpu.utils.retry import jittered
 from symbiont_tpu.utils.telemetry import metrics, span
 
 log = logging.getLogger(__name__)
 
 Handler = Callable[[Msg], Awaitable[None]]
+
+
+class HandlerTimeout(Exception):
+    """The handler-deadline sentinel: raised by _attempt ONLY when OUR
+    wait_for cancelled the handler. A TimeoutError raised by the handler's
+    own code (a bus request timeout, a socket read timeout — and on 3.11+
+    asyncio.TimeoutError IS builtin TimeoutError) is an ordinary failure:
+    it must hit the retry/accounting path, not masquerade as the
+    deadline."""
 
 
 class Service:
@@ -36,6 +56,25 @@ class Service:
         self._subs: list = []
         self._loops: list = []
         self._running = False
+        # resilience knobs (ResilienceConfig defaults; see apply_resilience)
+        self.handler_timeout_s = 0.0  # 0 disables the timeout
+        self.handler_retries = 0
+        self.handler_backoff_base_s = 0.05
+        self.handler_backoff_max_s = 2.0
+        self.supervisor_backoff_base_s = 0.5
+        self.supervisor_backoff_max_s = 30.0
+        self._rng = random.Random()  # jitter source; seedable in tests
+
+    def apply_resilience(self, cfg) -> None:
+        """Adopt a ResilienceConfig (config.py). Called by the runner on
+        every hosted service; individual services may override fields after
+        (per-service tuning)."""
+        self.handler_timeout_s = cfg.handler_timeout_s
+        self.handler_retries = cfg.handler_retries
+        self.handler_backoff_base_s = cfg.handler_backoff_base_s
+        self.handler_backoff_max_s = cfg.handler_backoff_max_s
+        self.supervisor_backoff_base_s = cfg.supervisor_backoff_base_s
+        self.supervisor_backoff_max_s = cfg.supervisor_backoff_max_s
 
     async def start(self) -> None:
         self._running = True
@@ -69,7 +108,17 @@ class Service:
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
 
-        t = asyncio.create_task(loop(), name=f"{self.name}:{subject}")
+        # supervised: an exception escaping the loop body restarts it with
+        # backoff (same still-open subscription) instead of silently ending
+        # consumption for the life of the process
+        t = asyncio.create_task(
+            supervise(loop, name=f"{self.name}:{subject}",
+                      backoff_base_s=self.supervisor_backoff_base_s,
+                      backoff_max_s=self.supervisor_backoff_max_s,
+                      labels={"service": self.name},
+                      still_wanted=lambda: self._running,
+                      rng=self._rng),
+            name=f"{self.name}:{subject}")
         self._loops.append(t)
 
     async def _run_handler(self, subject: str, handler: Handler, msg: Msg,
@@ -77,29 +126,85 @@ class Service:
         try:
             metrics.inc("bus.consumed",
                         labels={"service": self.name, "subject": subject})
-            with span(f"{self.name}.handle", msg.headers,
-                      subject=subject) as sp:
-                # hand the handler a PRIVATE message bound to this handler
-                # span's context: the inproc bus shares one Msg (and one
-                # headers dict) across all subscribers, so rebinding a copy
-                # — never mutating the original — is what lets every
-                # downstream publish link to this span without racing a
-                # sibling subscriber's handler (obs trace model; the ack
-                # below still uses the ORIGINAL msg, whose transport
-                # headers the copy merge also preserves)
-                hmsg = dataclasses.replace(
-                    msg, headers={**(msg.headers or {}), **sp.headers})
-                await handler(hmsg)
-            if ack:
-                # ack-after-success: a failed handler leaves the message
-                # unacked for redelivery
-                await self.bus.ack(msg)
-        except Exception:
-            metrics.inc("bus.failed",
-                        labels={"service": self.name, "subject": subject})
-            log.exception("%s: handler failed for %s", self.name, subject)
+            attempts = 1 + max(0, self.handler_retries)
+            delay = self.handler_backoff_base_s
+            for attempt in range(attempts):
+                try:
+                    await self._attempt(subject, handler, msg)
+                except HandlerTimeout:
+                    # the handler was CANCELLED at the deadline: the slot is
+                    # free again, and (durable) the unacked delivery will
+                    # redeliver after ack_wait — no in-process retry of a
+                    # side effect whose state is unknown
+                    metrics.inc("bus.handler_timeout",
+                                labels={"service": self.name,
+                                        "subject": subject})
+                    log.warning(
+                        "%s: handler for %s timed out after %.1fs and was "
+                        "cancelled%s", self.name, subject,
+                        self.handler_timeout_s,
+                        " (unacked: will redeliver)" if ack else "")
+                    return
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    metrics.inc("bus.failed",
+                                labels={"service": self.name,
+                                        "subject": subject})
+                    log.exception("%s: handler failed for %s (attempt %d/%d)",
+                                  self.name, subject, attempt + 1, attempts)
+                    if attempt + 1 >= attempts:
+                        return  # durable: stays unacked -> redelivery/DLQ
+                    metrics.inc("bus.handler_retries",
+                                labels={"service": self.name,
+                                        "subject": subject})
+                    # full-jitter exponential backoff between attempts
+                    await asyncio.sleep(jittered(delay, self._rng))
+                    delay = min(delay * 2, self.handler_backoff_max_s)
+                    continue
+                if ack:
+                    # ack-after-success: a failed handler leaves the message
+                    # unacked for redelivery
+                    await self.bus.ack(msg)
+                return
         finally:
             self._sem.release()
+
+    async def _attempt(self, subject: str, handler: Handler, msg: Msg) -> None:
+        """One handler invocation under its span, bounded by the handler
+        timeout (the fault seam and any injected hang live INSIDE the
+        timeout window, so chaos can prove the cancellation)."""
+        with span(f"{self.name}.handle", msg.headers, subject=subject) as sp:
+            # hand the handler a PRIVATE message bound to this handler
+            # span's context: the inproc bus shares one Msg (and one
+            # headers dict) across all subscribers, so rebinding a copy
+            # — never mutating the original — is what lets every
+            # downstream publish link to this span without racing a
+            # sibling subscriber's handler (obs trace model; the ack
+            # in _run_handler still uses the ORIGINAL msg, whose transport
+            # headers the copy merge also preserves)
+            hmsg = dataclasses.replace(
+                msg, headers={**(msg.headers or {}), **sp.headers})
+
+            async def invoke() -> None:
+                plan = faults.active_plan()
+                if plan is not None:
+                    await plan.async_fault("handler",
+                                           f"{self.name}:{subject}")
+                await handler(hmsg)
+
+            if self.handler_timeout_s > 0:
+                fut = asyncio.ensure_future(invoke())
+                try:
+                    await asyncio.wait_for(fut, self.handler_timeout_s)
+                except asyncio.TimeoutError:
+                    if fut.cancelled():
+                        # OUR deadline fired (wait_for cancelled the
+                        # handler) — not a TimeoutError the handler raised
+                        raise HandlerTimeout() from None
+                    raise  # the handler's own timeout: a normal failure
+            else:
+                await invoke()
 
     async def stop(self) -> None:
         self._running = False
@@ -107,6 +212,11 @@ class Service:
             s.close()
         for t in self._loops:
             t.cancel()
+        if self._loops:
+            # await the cancellations: a fire-and-forget cancel leaves
+            # "Task was destroyed but it is pending" warnings (and live
+            # supervisor sleeps) behind on interpreter shutdown
+            await asyncio.gather(*self._loops, return_exceptions=True)
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
         self._loops.clear()

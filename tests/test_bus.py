@@ -112,6 +112,32 @@ def test_slow_consumer_drops_not_blocks():
     _run(main())
 
 
+def test_overflow_drop_increments_bus_dropped_metric():
+    """Subscription._deliver drop-on-overflow must be ACCOUNTED, not
+    silent (pre-resilience it vanished without a trace): every dropped
+    message increments the subject-labeled `bus.dropped` counter."""
+    from symbiont_tpu.utils.telemetry import metrics
+
+    async def main():
+        bus = InprocBus()
+        sub = await bus.subscribe("flood.metric", maxsize=2)
+        before = metrics.get("bus.dropped",
+                             labels={"subject": "flood.metric"})
+        for i in range(7):
+            await bus.publish("flood.metric", str(i).encode())
+        after = metrics.get("bus.dropped",
+                            labels={"subject": "flood.metric"})
+        assert after - before == 5  # 7 published, 2 queued, 5 dropped
+        # the close-sentinel eviction path is NOT a consumer drop: closing
+        # a full subscription must not inflate the metric
+        sub.close()
+        assert metrics.get("bus.dropped",
+                           labels={"subject": "flood.metric"}) == after
+        await bus.close()
+
+    _run(main())
+
+
 def test_publish_after_close_raises():
     async def main():
         bus = InprocBus()

@@ -1,0 +1,145 @@
+"""Static pipeline-wiring check: subjects.py vs actual call sites.
+
+The reference SHIPPED a dead limb — knowledge_graph_service subscribed
+`data.processed_text.tokenized` while nothing published it (SURVEY.md fact
+#3, reference CHANGELOG.md:57-60): the whole knowledge-graph path was
+silently inert in v0.3.0. This test makes that bug class impossible to
+reintroduce here: it walks every Python AND native C++ source for
+`subjects.<NAME>` / `subjects::<NAME>` (and literal subject strings in the
+C++ tree), classifies each site as producer (publish / request /
+engine_call) or consumer (subscribe / durable_subscribe / _subscribe_loop),
+and fails on any subscribed-but-never-published subject.
+"""
+
+import re
+from pathlib import Path
+
+import symbiont_tpu.subjects as subjects_mod
+from symbiont_tpu import subjects
+
+REPO = Path(__file__).resolve().parent.parent
+
+# producer call tokens: the Python bus surface plus the native helper that
+# wraps request-reply to the engine plane (native/services/common.hpp)
+_PRODUCER_CALLS = ("publish(", "request(", "engine_call(")
+# consumer call tokens; "await sub(" covers engine_service's local alias
+# `sub = self._subscribe_loop`
+_CONSUMER_CALLS = ("durable_subscribe(", "_subscribe_loop(", "subscribe(",
+                   "await sub(")
+_NEITHER_CALLS = ("add_stream(",)  # capture config, not production
+
+# Served-but-uncalled endpoints we KEEP deliberately: the engine plane is a
+# public RPC surface for native worker shells and external bus clients;
+# engine.embed.query is the non-fused query-embedding endpoint exported in
+# the generated C++ header for remote callers. Anything else showing up
+# here is a dead limb — fix the wiring, don't grow this list.
+ALLOWED_UNPRODUCED = {"ENGINE_EMBED_QUERY"}
+
+
+def _subject_constants() -> dict:
+    """NAME -> value for every real subject constant (queue-group names are
+    subscription arguments, not subjects)."""
+    out = {}
+    for name in dir(subjects_mod):
+        if not name.isupper():
+            continue
+        value = getattr(subjects_mod, name)
+        if isinstance(value, str) and not value.startswith("q."):
+            out[name] = value
+    return out
+
+
+def _classify(context: str):
+    """Nearest preceding call token wins (multi-line calls put the callee
+    before the subject argument)."""
+    best_pos, best_kind = -1, None
+    for token, kind in (
+            [(t, "producer") for t in _PRODUCER_CALLS]
+            + [(t, "consumer") for t in _CONSUMER_CALLS]
+            + [(t, None) for t in _NEITHER_CALLS]):
+        i = context.rfind(token)
+        if i > best_pos:
+            best_pos, best_kind = i, kind
+    return best_kind if best_pos >= 0 else None
+
+
+def _scan():
+    consts = _subject_constants()
+    by_value = {v: k for k, v in consts.items()}
+    producers, consumers = {}, {}
+    files = [p for p in (REPO / "symbiont_tpu").rglob("*.py")
+             if p.name != "subjects.py"]
+    native_files = []
+    for ext in ("*.cpp", "*.hpp", "*.h"):
+        native_files += list((REPO / "native").rglob(ext))
+    const_ref = re.compile(r"subjects(?:\.|::)([A-Z][A-Z0-9_]*)")
+    for f in files + native_files:
+        text = f.read_text(errors="replace")
+        hits = [(m.start(), m.group(1)) for m in const_ref.finditer(text)
+                if m.group(1) in consts]
+        if f in native_files:
+            # native code may also use the literal subject string (e.g.
+            # knowledge_graph.cpp's engine_call(bus, "engine.graph.save"))
+            for value, name in by_value.items():
+                for m in re.finditer(re.escape(f'"{value}"'), text):
+                    hits.append((m.start(), name))
+        for pos, name in hits:
+            kind = _classify(text[max(0, pos - 200):pos])
+            target = {"producer": producers, "consumer": consumers}.get(kind)
+            if target is not None:
+                target.setdefault(name, set()).add(
+                    str(f.relative_to(REPO)))
+    return producers, consumers
+
+
+def test_no_subscribed_but_never_published_subject():
+    producers, consumers = _scan()
+    dead = set(consumers) - set(producers) - ALLOWED_UNPRODUCED
+    assert not dead, (
+        f"dead limbs: subscribed but never published anywhere "
+        f"(the reference's data.processed_text.tokenized bug class): "
+        f"{ {d: sorted(consumers[d]) for d in sorted(dead)} }")
+
+
+def test_allowlist_entries_are_still_served():
+    """The allowlist documents SERVED endpoints without in-repo callers; if
+    the subscription disappears the entry is stale — prune it."""
+    _, consumers = _scan()
+    stale = ALLOWED_UNPRODUCED - set(consumers)
+    assert not stale, f"ALLOWED_UNPRODUCED entries no longer subscribed: {stale}"
+
+
+def test_pipeline_subjects_have_consumers_and_producers():
+    """Both directions for the eight reference-parity pipeline subjects
+    (ALL_SUBJECTS): each must have at least one producer AND one consumer —
+    the full-duplex wiring SURVEY.md §1-L3 documents."""
+    producers, consumers = _scan()
+    name_by_value = {getattr(subjects, n): n for n in dir(subjects)
+                     if n.isupper() and isinstance(getattr(subjects, n), str)}
+    for value in subjects.ALL_SUBJECTS:
+        name = name_by_value[value]
+        assert name in producers, f"pipeline subject {value} has no producer"
+        assert name in consumers, f"pipeline subject {value} has no consumer"
+
+
+def test_scanner_sees_known_ground_truth():
+    """Self-check so the scanner can't silently rot into vacuous passes:
+    a few known call sites must classify as expected."""
+    producers, consumers = _scan()
+    # api publishes the perceive task; perception consumes it
+    assert any("services/api.py" in f
+               for f in producers["TASKS_PERCEIVE_URL"])
+    assert any("services/perception.py" in f
+               for f in consumers["TASKS_PERCEIVE_URL"])
+    # the un-orphaned subject: preprocessing produces, knowledge_graph eats
+    assert any("services/preprocessing.py" in f
+               for f in producers["DATA_PROCESSED_TEXT_TOKENIZED"])
+    assert any("services/knowledge_graph.py" in f
+               for f in consumers["DATA_PROCESSED_TEXT_TOKENIZED"])
+    # engine_service's aliased `await sub(...)` sites are seen as consumers
+    assert any("services/engine_service.py" in f
+               for f in consumers["ENGINE_HEALTH"])
+    # native C++ engine_call sites are seen as producers
+    assert any(f.startswith("native/")
+               for f in producers.get("ENGINE_VECTOR_SEARCH", set())), \
+        "native engine_call producer sites not detected"

@@ -212,7 +212,14 @@ def test_full_stack_over_external_qdrant(fake_qdrant, tmp_path):
         stack = SymbiontStack(cfg, bus=InprocBus(), fetcher=_fake_fetcher)
         await stack.start()
         try:
-            assert isinstance(stack.vector_store, QdrantStore)
+            # the runner wraps the external backend in the resilience
+            # plane's breaker + WAL-spill adapter (docs/RESILIENCE.md)
+            from symbiont_tpu.resilience.stores import (
+                ResilientVectorStore,
+            )
+
+            assert isinstance(stack.vector_store, ResilientVectorStore)
+            assert isinstance(stack.vector_store.inner, QdrantStore)
             loop = asyncio.get_running_loop()
             status, _ = await loop.run_in_executor(None, lambda: _http(
                 "POST", stack.api.port, "/api/submit-url",
