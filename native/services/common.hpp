@@ -251,6 +251,119 @@ inline std::vector<unsigned char> b64_decode(const std::string& s) {
   return out;
 }
 
+// ---- binary tensor frames (mirror of symbiont_tpu/schema/frames.py) -----
+//
+// A frame is a fixed 16-byte header + packed little-endian f32 rows,
+// APPENDED to the ordinary JSON message body; the X-Symbiont-Frame header
+// ("tensor/f32;off=<n>", n = JSON prefix length) announces it. Golden-byte
+// fixtures in tests/test_frames.py pin this layout against the Python
+// codec. Both ends of this wire are little-endian (x86/arm64) — the same
+// stance the b64 vector encoding above already takes.
+inline const char* FRAME_HEADER = "X-Symbiont-Frame";
+constexpr size_t FRAME_HDR_LEN = 16;
+constexpr uint8_t FRAME_VERSION = 1;
+constexpr uint8_t FRAME_DTYPE_F32 = 1;
+
+inline void put_u16le(std::string& out, uint16_t v) {
+  out.push_back((char)(v & 0xff));
+  out.push_back((char)(v >> 8));
+}
+
+inline void put_u32le(std::string& out, uint32_t v) {
+  for (int i = 0; i < 4; ++i) out.push_back((char)((v >> (8 * i)) & 0xff));
+}
+
+inline uint32_t get_u32le(const char* p) {
+  return (uint32_t)(unsigned char)p[0] | (uint32_t)(unsigned char)p[1] << 8 |
+         (uint32_t)(unsigned char)p[2] << 16 |
+         (uint32_t)(unsigned char)p[3] << 24;
+}
+
+// Header + raw payload (raw_f32 must hold rows*cols little-endian floats).
+inline std::string make_frame(const std::string& raw_f32, uint32_t rows,
+                              uint32_t cols) {
+  if (raw_f32.size() != (size_t)rows * cols * sizeof(float))
+    throw std::runtime_error("frame payload size mismatch");
+  std::string out;
+  out.reserve(FRAME_HDR_LEN + raw_f32.size());
+  out += "SYTF";
+  out.push_back((char)FRAME_VERSION);
+  out.push_back((char)FRAME_DTYPE_F32);
+  put_u16le(out, 0);  // reserved
+  put_u32le(out, rows);
+  put_u32le(out, cols);
+  out += raw_f32;
+  return out;
+}
+
+inline std::string frame_header_value(size_t json_len) {
+  return "tensor/f32;off=" + std::to_string(json_len);
+}
+
+// View into a frame-bearing body (payload points INTO the body string).
+struct FrameView {
+  uint32_t rows = 0;
+  uint32_t cols = 0;
+  const char* payload = nullptr;
+  size_t payload_len = 0;
+};
+
+// Split a possibly-frame-bearing body. Returns false (json_part = whole
+// body) when no frame header is present — the JSON fallback. Throws on a
+// malformed header or truncated frame (the delivery stays unacked).
+inline bool split_frame(const std::map<std::string, std::string>& headers,
+                        const std::string& body, std::string& json_part,
+                        FrameView& frame) {
+  auto it = headers.find(FRAME_HEADER);
+  if (it == headers.end()) {
+    json_part = body;
+    return false;
+  }
+  const std::string& v = it->second;
+  if (v.rfind("tensor/f32", 0) != 0)
+    throw std::runtime_error("unknown frame content type: " + v);
+  auto off_pos = v.find("off=");
+  if (off_pos == std::string::npos)
+    throw std::runtime_error("frame header missing off=: " + v);
+  long long off = std::atoll(v.c_str() + off_pos + 4);
+  if (off < 0 || (size_t)off + FRAME_HDR_LEN > body.size())
+    throw std::runtime_error("frame offset beyond body");
+  const char* p = body.data() + off;
+  if (std::memcmp(p, "SYTF", 4) != 0)
+    throw std::runtime_error("bad frame magic");
+  if ((uint8_t)p[4] != FRAME_VERSION)
+    throw std::runtime_error("unsupported frame version");
+  if ((uint8_t)p[5] != FRAME_DTYPE_F32)
+    throw std::runtime_error("unsupported frame dtype");
+  frame.rows = get_u32le(p + 8);
+  frame.cols = get_u32le(p + 12);
+  frame.payload = p + FRAME_HDR_LEN;
+  frame.payload_len = (size_t)frame.rows * frame.cols * sizeof(float);
+  if ((size_t)off + FRAME_HDR_LEN + frame.payload_len > body.size())
+    throw std::runtime_error("frame payload truncated");
+  json_part.assign(body.data(), (size_t)off);
+  return true;
+}
+
+// Frame payload → [rows][cols] float rows (memcpy per row, no text parse).
+inline std::vector<std::vector<float>> frame_rows(const FrameView& f) {
+  std::vector<std::vector<float>> rows(f.rows);
+  for (uint32_t i = 0; i < f.rows; ++i) {
+    rows[i].resize(f.cols);
+    std::memcpy(rows[i].data(), f.payload + (size_t)i * f.cols * sizeof(float),
+                f.cols * sizeof(float));
+  }
+  return rows;
+}
+
+// Frames deployment knob, mirror of schema.frames.frames_enabled (default
+// ON; set SYMBIONT_FRAMES=0 when a reference-era JSON-only peer shares the
+// pub/sub subjects).
+inline bool frames_enabled() {
+  std::string v = env_or("SYMBIONT_FRAMES", "");
+  return !(v == "0" || v == "false" || v == "no" || v == "off");
+}
+
 // Decode an engine embed reply into [n][dim] float rows. Accepts either the
 // compact b64 form ({"vectors_b64", "count", "dim"}) or the plain JSON
 // array-of-arrays form ({"vectors"}), so callers work against old and new

@@ -89,6 +89,11 @@ int main() try {
       env_size_t("SYMBIONT_PREPROC_MAX_BATCH_SENTS", 128, 1);
   uint32_t max_deliver = (uint32_t)std::atoi(
       symbiont::env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
+  // binary tensor frames (common.hpp / schema/frames.py): ask the engine
+  // for frame replies and publish data.text.with_embeddings with the f32
+  // block attached — floats never pass through text. SYMBIONT_FRAMES=0
+  // restores the reference-era JSON wire for old downstream peers.
+  bool use_frames = symbiont::frames_enabled();
 
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
@@ -134,7 +139,9 @@ int main() try {
       }
       json::Value req = json::Value::object();
       req.set("texts", std::move(texts));
-      req.set("encoding", json::Value("b64"));
+      // an old engine ignores the unknown "frame" encoding and replies
+      // with JSON float lists — complete() accepts every reply form
+      req.set("encoding", json::Value(use_frames ? "frame" : "b64"));
       std::string inbox = "_INBOX." + symbiont::uuid4();
       uint32_t sid = bus.subscribe(inbox);
       batch.deadline_ms = symbiont::now_ms() + (uint64_t)engine_timeout_ms;
@@ -146,17 +153,33 @@ int main() try {
 
   // Distribute one reply's vectors back to its documents in order and
   // publish/ack per doc. Throws on malformed replies (docs stay unacked).
+  // A frame reply is re-sliced per document as RAW BYTES (memcpy, no float
+  // parse/format anywhere between the engine and the downstream consumers).
   auto complete = [&](InflightBatch& batch, const symbus::BusMsg& msg) {
-    json::Value r = json::parse(msg.data);
+    std::string json_part;
+    symbiont::FrameView fv;
+    bool framed = symbiont::split_frame(msg.headers, msg.data, json_part, fv);
+    json::Value r = json::parse(framed ? json_part : msg.data);
     if (!r.at("error_message").is_null())
       throw std::runtime_error("engine error: " +
                                r.at("error_message").as_string());
-    auto vectors = symbiont::decode_vectors(r);
-    if (vectors.size() != batch.total_sentences)
-      throw std::runtime_error(
-          "engine returned " + std::to_string(vectors.size()) +
-          " vectors for " + std::to_string(batch.total_sentences) +
-          " sentences");
+    std::vector<std::vector<float>> vectors;
+    if (framed) {
+      if (fv.rows != batch.total_sentences)
+        throw std::runtime_error(
+            "engine frame holds " + std::to_string(fv.rows) +
+            " rows for " + std::to_string(batch.total_sentences) +
+            " sentences");
+      if (!use_frames)  // frames toggled off: fall back to JSON publishes
+        vectors = symbiont::frame_rows(fv);
+    } else {
+      vectors = symbiont::decode_vectors(r);
+      if (vectors.size() != batch.total_sentences)
+        throw std::runtime_error(
+            "engine returned " + std::to_string(vectors.size()) +
+            " vectors for " + std::to_string(batch.total_sentences) +
+            " sentences");
+    }
     std::string model_name = r.at("model_name").as_string();
     size_t off = 0;
     for (auto& d : batch.docs) {
@@ -165,15 +188,32 @@ int main() try {
       out.source_url = d.raw.source_url;
       out.model_name = model_name;
       out.timestamp_ms = symbiont::now_ms();
+      bool publish_frame = framed && use_frames;
       for (size_t i = 0; i < d.sentences.size(); ++i) {
         symbiont::SentenceEmbedding se;
         se.sentence_text = d.sentences[i];
-        se.embedding = std::move(vectors[off + i]);
+        if (!publish_frame)
+          se.embedding = std::move(vectors[off + i]);
         out.embeddings_data.push_back(std::move(se));
       }
+      if (publish_frame) {
+        std::string body = out.to_json_string();
+        size_t dim = fv.cols;
+        std::string raw(fv.payload + off * dim * sizeof(float),
+                        d.sentences.size() * dim * sizeof(float));
+        auto headers = d.headers;
+        headers[symbiont::FRAME_HEADER] =
+            symbiont::frame_header_value(body.size());
+        bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                    body + symbiont::make_frame(
+                               raw, (uint32_t)d.sentences.size(),
+                               (uint32_t)dim),
+                    "", headers);
+      } else {
+        bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
+                    out.to_json_string(), "", d.headers);
+      }
       off += d.sentences.size();
-      bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
-                  out.to_json_string(), "", d.headers);
       // un-orphaned knowledge-graph feed (SURVEY.md fact #3)
       symbiont::TokenizedTextMessage tok;
       tok.original_id = d.raw.id;

@@ -39,10 +39,15 @@ const char* SERVICE = "vector_memory";
 using symbiont::engine_call;
 
 // A parsed document whose points are waiting for (or riding in) an upsert.
+// The vectors are held as RAW little-endian f32 bytes regardless of which
+// wire form delivered them (tensor frame: a straight copy of the payload;
+// legacy JSON: packed once at parse) — dispatch never touches floats again.
 struct PendingDoc {
   symbus::BusMsg delivery;
   symbiont::TextWithEmbeddingsMessage m;
   std::map<std::string, std::string> headers;
+  std::string raw_vectors;  // m.embeddings_data.size() * dim f32le values
+  size_t dim = 0;
   // set after a coalesced upsert failed: retry this doc in its own request
   // so one poison doc (e.g. dim mismatch) cannot dead-letter the healthy
   // docs batched with it
@@ -80,6 +85,12 @@ int main() try {
   uint32_t max_deliver = (uint32_t)std::atoi(
       symbiont::env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
 
+  // binary tensor frames (common.hpp / schema/frames.py): forward the
+  // vectors to engine.vector.upsert as one attached f32 block instead of
+  // base64 text. SYMBIONT_FRAMES=0 restores the b64 request form (an old
+  // engine accepts that; a new engine accepts both).
+  bool use_frames = symbiont::frames_enabled();
+
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
 
@@ -104,17 +115,19 @@ int main() try {
   std::unordered_set<std::string> pending_ids;
   bool backlog_warned = false;
 
-  // Build and send one coalesced upsert for ≥1 ready docs. The compact
-  // request form ({"ids", "payloads", "vectors_b64", "dim"}) is the engine
-  // plane's internal contract (engine_service.py::_vec_upsert); the bus
-  // wire schema (TextWithEmbeddingsMessage) is untouched.
+  // Build and send one coalesced upsert for ≥1 ready docs. The vectors go
+  // out as ONE block built by concatenating each doc's raw f32 bytes —
+  // as an attached tensor frame (default), or base64'd for an old engine
+  // (SYMBIONT_FRAMES=0). Engine-plane contract:
+  // engine_service.py::_vec_upsert; the bus wire schema
+  // (TextWithEmbeddingsMessage) is untouched.
   auto dispatch = [&]() {
     while (inflight.size() < max_inflight && !ready.empty()) {
       InflightUpsert batch;
       size_t dim = 0;
       json::Value ids = json::Value::array();
       json::Value payloads = json::Value::array();
-      std::vector<float> vecs;
+      std::string raw;
       while (!ready.empty()) {
         PendingDoc& d = ready.front();
         size_t pts = d.m.embeddings_data.size();
@@ -123,9 +136,9 @@ int main() try {
           break;
         bool was_solo = d.solo;
         uint64_t now = symbiont::now_ms();
+        if (dim == 0) dim = d.dim;
         for (size_t order = 0; order < pts; ++order) {
           const auto& se = d.m.embeddings_data[order];
-          if (dim == 0) dim = se.embedding.size();
           symbiont::QdrantPointPayload payload;
           payload.original_document_id = d.m.original_id;
           payload.source_url = d.m.source_url;
@@ -136,8 +149,8 @@ int main() try {
           ids.push_back(json::Value(
               symbiont::deterministic_point_id(d.m.original_id, order)));
           payloads.push_back(payload.to_json());
-          vecs.insert(vecs.end(), se.embedding.begin(), se.embedding.end());
         }
+        raw += d.raw_vectors;
         batch.total_points += pts;
         batch.docs.push_back(std::move(d));
         ready.pop_front();
@@ -147,15 +160,30 @@ int main() try {
       req.set("ids", std::move(ids));
       req.set("payloads", std::move(payloads));
       req.set("dim", json::Value((double)dim));
-      req.set("vectors_b64",
-              json::Value(symbiont::b64_encode(
-                  (const unsigned char*)vecs.data(),
-                  vecs.size() * sizeof(float))));
       std::string inbox = "_INBOX." + symbiont::uuid4();
       uint32_t sid = bus.subscribe(inbox);
       batch.deadline_ms = symbiont::now_ms() + (uint64_t)engine_timeout_ms;
-      bus.publish(symbiont::subjects::ENGINE_VECTOR_UPSERT, req.dump(), inbox,
-                  batch.docs.front().headers);
+      auto headers = batch.docs.front().headers;
+      std::string data;
+      // the frame path requires a consistent block (mixed-dim docs
+      // coalesced together cannot frame); the b64 fallback ships the
+      // same bytes and lets the ENGINE reject the mismatch, which routes
+      // the batch through the per-doc solo-retry isolation below
+      if (use_frames &&
+          raw.size() == (size_t)batch.total_points * dim * sizeof(float)) {
+        std::string body = req.dump();
+        headers[symbiont::FRAME_HEADER] =
+            symbiont::frame_header_value(body.size());
+        data = body + symbiont::make_frame(
+                          raw, (uint32_t)batch.total_points, (uint32_t)dim);
+      } else {
+        req.set("vectors_b64",
+                json::Value(symbiont::b64_encode(
+                    (const unsigned char*)raw.data(), raw.size())));
+        data = req.dump();
+      }
+      bus.publish(symbiont::subjects::ENGINE_VECTOR_UPSERT, data, inbox,
+                  headers);
       inflight.emplace(sid, std::move(batch));
     }
   };
@@ -233,7 +261,31 @@ int main() try {
       PendingDoc d;
       d.delivery = *msg;
       try {
-        d.m = symbiont::TextWithEmbeddingsMessage::parse(msg->data);
+        // both wire forms: a frame-bearing message (JSON metadata + f32
+        // block) or the reference's plain-JSON float lists
+        std::string json_part;
+        symbiont::FrameView fv;
+        bool framed =
+            symbiont::split_frame(msg->headers, msg->data, json_part, fv);
+        d.m = symbiont::TextWithEmbeddingsMessage::parse(
+            framed ? json_part : msg->data);
+        if (framed) {
+          if (fv.rows != d.m.embeddings_data.size())
+            throw std::runtime_error(
+                "frame holds " + std::to_string(fv.rows) + " rows for " +
+                std::to_string(d.m.embeddings_data.size()) + " sentences");
+          d.dim = fv.cols;
+          d.raw_vectors.assign(fv.payload, fv.payload_len);
+        } else {
+          for (const auto& se : d.m.embeddings_data) {
+            if (se.embedding.empty()) continue;
+            if (d.dim == 0) d.dim = se.embedding.size();
+            size_t at = d.raw_vectors.size();
+            d.raw_vectors.resize(at + se.embedding.size() * sizeof(float));
+            std::memcpy(&d.raw_vectors[at], se.embedding.data(),
+                        se.embedding.size() * sizeof(float));
+          }
+        }
       } catch (const std::exception& e) {
         symbiont::logline("WARN", SERVICE,
                           std::string("bad embeddings message: ") + e.what(),

@@ -37,7 +37,9 @@ enum Op : uint8_t {
   OP_ERR = 7,
 };
 
-constexpr uint32_t MAX_FRAME = 64 * 1024 * 1024;  // embeddings ride as JSON
+// payloads are binary-safe (length-prefixed): embeddings ride as binary
+// tensor frames (services/common.hpp) with JSON as the negotiated fallback
+constexpr uint32_t MAX_FRAME = 64 * 1024 * 1024;
 
 struct Writer {
   std::string buf;

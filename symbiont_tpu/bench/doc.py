@@ -134,6 +134,23 @@ def render_doc(r: dict, source_name: str) -> str:
          "streaming: first SSE text delta (chunk 16, engine-plane)",
          f"{f['stream_first_delta_ms']} ms"),
     ]
+    if "ser_frame_vs_json_bytes_x" in f:
+        rows += [
+            ("`ser_frame_vs_json_bytes_x`",
+             "serialization micro-tier: binary tensor frame vs JSON float "
+             f"lists on one data.text.with_embeddings hop "
+             f"({f['ser_frame_bytes_per_emb']} vs "
+             f"{f['ser_json_bytes_per_emb']} bytes/embedding, 384-d) — "
+             "deterministic, gated",
+             f"**{f['ser_frame_vs_json_bytes_x']}× smaller**"),
+            ("`ser_frame_roundtrip_emb_per_s`",
+             "host-side encode+decode of the same hop, frame vs JSON "
+             f"(JSON: {f['ser_json_roundtrip_emb_per_s']}"
+             f"{rng('ser_json_roundtrip_emb_per_s')} emb/s) — one shared "
+             "host core, informational",
+             f"{f['ser_frame_roundtrip_emb_per_s']}"
+             f"{rng('ser_frame_roundtrip_emb_per_s')} emb/s"),
+        ]
     # --- tier 2: full-stack (what a user of the running stack sees) ------
     if "e2e_search_p50_ms" in f:
         rows += [
@@ -279,8 +296,9 @@ visible here.
 - Ingest: full-stack **{f['e2e_ingest_emb_per_s']}{rng('e2e_ingest_emb_per_s')}
   emb/s** steady-state (the r4→r5 rework took this from 353: the worker
   shells are pipelined event loops that coalesce multiple documents per
-  engine hop, vectors cross the engine plane as base64 f32 blocks, and
-  f32→JSON text formatting uses ryu). The remaining gap to the engine-plane
+  engine hop; since the frame plane, vectors cross every hot hop as binary
+  tensor frames — see the data-plane section above — with base64 f32 and
+  ryu-formatted JSON as the negotiated fallbacks). The remaining gap to the engine-plane
   bulk number ({f['ingest_10k_emb_per_s']} emb/s, one in-process call) is
   the floor of this environment: every engine request-reply hop costs
   ~100 ms of tunnel RTT regardless of batch size (512-row flushes amortize
@@ -289,6 +307,64 @@ visible here.
   deployment both terms collapse.
 {decomp_bullet}{gen_bullet}
 """
+    # --- the binary tensor-frame data plane (prose is archive-agnostic;
+    # the measured paragraph appears once a run archives the micro-tier) --
+    ser_measured = ""
+    if "ser_frame_vs_json_bytes_x" in f:
+        ser_measured = (
+            f"Measured by the serialization micro-tier (`bench/serialization"
+            f".py`, gated like every perf primary): one 384-d embedding hop "
+            f"is **{f['ser_frame_bytes_per_emb']} bytes** as a frame vs "
+            f"{f['ser_json_bytes_per_emb']} bytes as wire JSON — "
+            f"**{f['ser_frame_vs_json_bytes_x']}× smaller** — and the "
+            f"host-side encode+decode round trip runs "
+            f"{f['ser_frame_roundtrip_emb_per_s']}"
+            f"{rng('ser_frame_roundtrip_emb_per_s')} emb/s vs "
+            f"{f['ser_json_roundtrip_emb_per_s']}"
+            f"{rng('ser_json_roundtrip_emb_per_s')} emb/s for JSON on the "
+            f"one shared host core. The JSON figure is below the full-stack "
+            f"ingest rate itself: before frames, serialization alone "
+            f"saturated the host.\n")
+    else:
+        ser_measured = (
+            "The serialization micro-tier (`bench/serialization.py`) "
+            "measures bytes/embedding and host encode+decode throughput for "
+            "both forms each run; this archive predates it, so its "
+            "`ser_*` fields will appear (and be gated) from the next full "
+            "run.\n")
+    frames_section = f"""## The binary tensor-frame data plane
+
+Every bulk-float hop used to JSON-encode 384 floats per sentence — and a
+f32 that rides through Python `float()` serializes as the ~17-digit
+shortest round-trip of its DOUBLE widening, ~20 bytes of text per float,
+parsed back one Python object at a time on the far side. On the one shared
+host core of this sandbox that was the ingest wall (docs/PERF.md r5:
+the 5.5× gap between full-stack and engine-plane ingest).
+
+Bulk floats now ride as **binary tensor frames** (`symbiont_tpu/schema/
+frames.py`, C++ mirror in `native/services/common.hpp`): a 16-byte header
+(magic `SYTF`, version, dtype, rows, cols) + packed little-endian f32
+rows, appended to the ordinary JSON message body and announced by the
+`X-Symbiont-Frame: tensor/f32;off=<n>` content-type header. JSON metadata
+(ids, sentence texts, source url) stays in the JSON prefix, which remains
+a schema-valid message with empty `embedding` lists. Decode is
+`np.frombuffer` — a zero-copy view; engine output reaches the vector
+store (`VectorStore.upsert_rows`) without materializing a single
+per-float Python object. Three hops carry frames: engine embed replies
+(`encoding: "frame"`), preprocessing → `data.text.with_embeddings`, and
+vector-memory → `engine.vector.upsert`.
+
+The fallback contract: on request-reply the REQUESTER opts in per call
+(an old engine ignores the unknown encoding and answers JSON float lists,
+which every caller still accepts); on pub/sub the publisher side is the
+`SYMBIONT_FRAMES` knob (default on; `0` restores the byte-exact reference
+wire for JSON-only peers), and frame-capable consumers accept both forms
+always. `frame.*` obs counters (docs/OBSERVABILITY.md) track frame bytes
+vs the JSON-equivalent bytes they displaced, plus encode/decode seconds.
+
+{ser_measured}
+"""
+
     mfu768 = ""
     if "mfu_compute_only_768_pct" in f:
         mfu768 = (
@@ -397,7 +473,7 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{e2e_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{frames_section}{e2e_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.

@@ -1,0 +1,97 @@
+"""Serialization micro-tier: the data-plane win, gated instead of anecdotal.
+
+Measures what one `data.text.with_embeddings` hop costs in BOTH wire forms
+(schema/frames) on a seeded corpus shaped like the e2e tier's documents
+(384-d MiniLM vectors, ~25 sentences/doc):
+
+- bytes per embedding on the wire — binary tensor frame vs the JSON
+  fallback (whose floats serialize as the ~17-digit shortest round-trip of
+  the f32's DOUBLE widening; this is what the stack shipped before the
+  frame plane, so the ratio IS the deployed saving);
+- encode+decode host seconds for each form, as embeddings/s (median of 5
+  with min/max — host-CPU timings on the one shared core are noisy, so
+  only the deterministic byte ratio is a gated primary).
+
+`ser_frame_vs_json_bytes_x` (primary, higher is better): how many times
+smaller the frame hop is. The acceptance bar for the frame plane is ≥4×.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from symbiont_tpu.bench import stats
+from symbiont_tpu.bench.tiers import register
+from symbiont_tpu.bench.workload import log, make_sentences
+
+N_SENTS = 2048  # ~82 e2e docs' worth of sentences
+DIM = 384
+REPEATS = 5
+
+
+@register("serialization",
+          primary_metrics=("ser_frame_vs_json_bytes_x",), quick=True)
+def tier_serialization(results: dict, ctx) -> None:
+    from symbiont_tpu.schema import frames
+
+    rng = np.random.default_rng(11)
+    sentences = [s.capitalize() for s in make_sentences(N_SENTS, rng)]
+    vectors = rng.standard_normal((N_SENTS, DIM)).astype(np.float32)
+    args = ("doc-ser-tier", "bench://serialization", sentences, vectors,
+            "minilm-384", 1700000000000)
+
+    frame_data, frame_headers = frames.encode_embeddings_message(
+        *args, use_frame=True)
+    json_data, _ = frames.encode_embeddings_message(*args, use_frame=False)
+
+    # deterministic byte accounting (the gated primary)
+    results["ser_frame_bytes_per_emb"] = round(len(frame_data) / N_SENTS, 1)
+    results["ser_json_bytes_per_emb"] = round(len(json_data) / N_SENTS, 1)
+    results["ser_frame_vs_json_bytes_x"] = round(
+        len(json_data) / len(frame_data), 2)
+    # the payload-only view (metadata — ids, sentence texts — is identical
+    # in both forms, so this isolates what the floats themselves cost)
+    meta_len = len(frame_data) - (
+        frames.FRAME_HDR_LEN + vectors.size * 4)
+    results["ser_frame_payload_bytes_per_emb"] = round(
+        (len(frame_data) - meta_len) / N_SENTS, 1)
+    results["ser_json_payload_bytes_per_emb"] = round(
+        (len(json_data) - meta_len) / N_SENTS, 1)
+
+    def timed(fn) -> list:
+        out = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            fn()
+            out.append(N_SENTS / (time.perf_counter() - t0))
+        return out
+
+    # encode+decode round trips (what the publisher and the consumer pay
+    # together per hop); decode includes the schema-strict JSON parse both
+    # forms share for the metadata
+    def frame_roundtrip():
+        data, headers = frames.encode_embeddings_message(*args,
+                                                         use_frame=True)
+        msg, rows = frames.decode_embeddings_message(data, headers)
+        assert rows is not None and rows.shape == (N_SENTS, DIM)
+
+    def json_roundtrip():
+        data, headers = frames.encode_embeddings_message(*args,
+                                                         use_frame=False)
+        msg, rows = frames.decode_embeddings_message(data, headers)
+        assert rows is None
+        # the legacy consumer's next step: float lists → ndarray block
+        np.asarray([se.embedding for se in msg.embeddings_data], np.float32)
+
+    stats.record(results, "ser_frame_roundtrip_emb_per_s",
+                 timed(frame_roundtrip), digits=0)
+    stats.record(results, "ser_json_roundtrip_emb_per_s",
+                 timed(json_roundtrip), digits=0)
+
+    log(f"serialization: frame {results['ser_frame_bytes_per_emb']} B/emb "
+        f"vs JSON {results['ser_json_bytes_per_emb']} B/emb = "
+        f"{results['ser_frame_vs_json_bytes_x']}x smaller; round-trip "
+        f"{results['ser_frame_roundtrip_emb_per_s']:.0f} vs "
+        f"{results['ser_json_roundtrip_emb_per_s']:.0f} emb/s host-side")

@@ -109,36 +109,68 @@ class VectorStore:
                         raise ValueError(
                             f"vector dim {v.shape} != collection dim {self.dim}")
                 raise ValueError(f"vectors must be [n, {self.dim}]")
-            norms = np.linalg.norm(batch, axis=1, keepdims=True)
-            batch = np.divide(batch, norms, out=batch.copy(),
-                              where=norms > 0)
-            rows = []
-            new_pos: Dict[str, int] = {}  # ids first seen in THIS call — a
-            # duplicate id within one batch (e.g. WAL replay of an update)
-            # must overwrite, not append twice
-            for j, (pid, _, payload) in enumerate(points):
-                if pid in self._id_to_row:
-                    r = self._id_to_row[pid]
-                    self._vectors[r] = batch[j]
-                    self._payloads[r] = dict(payload)
-                    self._dirty = True
-                elif pid in new_pos:
-                    rows[new_pos[pid]] = (pid, j, dict(payload))
-                else:
-                    new_pos[pid] = len(rows)
-                    rows.append((pid, j, dict(payload)))
-            if rows:
-                new_vecs = batch[[j for _, j, _ in rows]]
-                base = len(self._ids)
-                self._vectors = (np.concatenate([self._vectors, new_vecs])
-                                 if len(self._vectors) else new_vecs)
-                for i, (pid, _, payload) in enumerate(rows):
-                    self._ids.append(pid)
-                    self._id_to_row[pid] = base + i
-                    self._payloads.append(payload)
+            return self._ingest_locked([p[0] for p in points], batch,
+                                       [p[2] for p in points])
+
+    def upsert_rows(self, ids: Sequence[str], rows,
+                    payloads: Optional[Sequence[dict]] = None) -> int:
+        """Tensor-frame fast path: ingest an already-packed [n, dim] float
+        block (typically a read-only `np.frombuffer` view straight off the
+        bus — schema/frames) without ever materializing per-float Python
+        objects. Same semantics and WAL durability as upsert()."""
+        ids = list(ids)
+        if not ids:
+            return 0
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[0] != len(ids):
+            raise ValueError(
+                f"rows shape {rows.shape} does not match {len(ids)} ids")
+        if rows.shape[1] != self.dim:
+            raise ValueError(
+                f"vector dim ({rows.shape[1]},) != collection dim {self.dim}")
+        payloads = ([{}] * len(ids) if payloads is None else list(payloads))
+        if len(payloads) != len(ids):
+            # zip would silently truncate and drop points
+            raise ValueError(f"{len(payloads)} payloads for {len(ids)} ids")
+        with self._lock:
+            return self._ingest_locked(ids, rows, payloads)
+
+    def _ingest_locked(self, ids: List[str], batch: np.ndarray,
+                       payloads: List[dict]) -> int:
+        """Shared ingest tail (caller holds the lock, batch is validated
+        [n, dim] f32 — possibly a read-only view; the WAL records the RAW
+        vectors, normalization happens on the in-memory copy only)."""
+        norms = np.linalg.norm(batch, axis=1, keepdims=True)
+        normed = np.divide(batch, norms, out=batch.astype(np.float32,
+                                                          copy=True),
+                           where=norms > 0)
+        rows = []
+        new_pos: Dict[str, int] = {}  # ids first seen in THIS call — a
+        # duplicate id within one batch (e.g. WAL replay of an update)
+        # must overwrite, not append twice
+        for j, (pid, payload) in enumerate(zip(ids, payloads)):
+            if pid in self._id_to_row:
+                r = self._id_to_row[pid]
+                self._vectors[r] = normed[j]
+                self._payloads[r] = dict(payload)
                 self._dirty = True
-            self._wal_append(points)
-            return len(points)
+            elif pid in new_pos:
+                rows[new_pos[pid]] = (pid, j, dict(payload))
+            else:
+                new_pos[pid] = len(rows)
+                rows.append((pid, j, dict(payload)))
+        if rows:
+            new_vecs = normed[[j for _, j, _ in rows]]
+            base = len(self._ids)
+            self._vectors = (np.concatenate([self._vectors, new_vecs])
+                             if len(self._vectors) else new_vecs)
+            for i, (pid, _, payload) in enumerate(rows):
+                self._ids.append(pid)
+                self._id_to_row[pid] = base + i
+                self._payloads.append(payload)
+            self._dirty = True
+        self._wal_append(list(zip(ids, batch, payloads)))
+        return len(ids)
 
     # -------------------------------------------------------------- search
 

@@ -119,6 +119,16 @@ class ResilientVectorStore:
             plan.sync_fault("store.upsert", self.breaker.name)
         return self.inner.upsert(points)
 
+    def _inner_upsert_rows(self, ids, rows, payloads):
+        plan = faults.active_plan()
+        if plan is not None:
+            plan.sync_fault("store.upsert", self.breaker.name)
+        if hasattr(self.inner, "upsert_rows"):
+            return self.inner.upsert_rows(ids, rows, payloads)
+        # backends without the tensor-frame fast path (external Qdrant):
+        # hand the row views through the point-tuple surface
+        return self.inner.upsert(list(zip(ids, rows, payloads)))
+
     def _inner_search(self, query, top_k):
         plan = faults.active_plan()
         if plan is not None:
@@ -167,6 +177,38 @@ class ResilientVectorStore:
                     "(%d pending) for replay on recovery", self.breaker.name,
                     type(e).__name__, e, len(points), len(self._spill))
                 return len(points)
+
+    def upsert_rows(self, ids, rows, payloads=None) -> int:
+        """Tensor-frame ingest under the same breaker/spill policy as
+        upsert(): the packed block stays intact on the happy path and
+        degrades to per-point spill entries only when the backend is down
+        (the spill is JSONL — float lists are its durable format)."""
+        ids = list(ids)
+        if not ids:
+            return 0
+        payloads = ([{}] * len(ids) if payloads is None else list(payloads))
+        with self._lock:
+            try:
+                self._replay_pending()
+                return self.breaker.call(self._inner_upsert_rows, ids, rows,
+                                         payloads, fatal=(ValueError,))
+            except ValueError:
+                raise  # config error: spilling it would replay forever
+            except Exception as e:
+                import numpy as np
+
+                vec_lists = np.asarray(rows, np.float32).tolist()
+                self._spill.append([
+                    {"id": pid, "vector": vec, "payload": payload}
+                    for pid, vec, payload in zip(ids, vec_lists, payloads)])
+                metrics.inc("store.spilled_points", len(ids),
+                            labels={"store": self.breaker.name})
+                log.warning(
+                    "%s: upsert_rows failed (%s: %s) — %d points spilled to "
+                    "WAL (%d pending) for replay on recovery",
+                    self.breaker.name, type(e).__name__, e, len(ids),
+                    len(self._spill))
+                return len(ids)
 
     def search(self, query: Sequence[float], top_k: int):
         try:

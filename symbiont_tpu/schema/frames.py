@@ -1,0 +1,245 @@
+"""Binary tensor frames — the zero-copy bulk-float data plane on the bus.
+
+docs/PERF.md attributes the 5.5× gap between full-stack ingest and the
+engine-plane bulk number largely to host-side (de)serialization: every
+embedding hop used to JSON-encode 384 floats per sentence, and each f32
+that rode through Python `float()` serialized as the ~17-digit shortest
+round-trip of its DOUBLE widening (~19-20 bytes per float on the wire).
+The accelerator-feeding literature makes the same point (Demystifying
+BERT, arxiv 2104.08335; LightSeq, arxiv 2010.13887): for small encoder
+models, host serialization and data movement — not the forward pass — is
+where throughput dies.
+
+A tensor frame is a fixed 16-byte header + packed little-endian f32 rows:
+
+    offset 0   magic  b"SYTF"
+    offset 4   u8     version (1)
+    offset 5   u8     dtype   (1 = f32 little-endian)
+    offset 6   u16le  reserved (0)
+    offset 8   u32le  rows
+    offset 12  u32le  cols
+    offset 16  rows * cols * 4 bytes of f32le, row-major
+
+The frame rides APPENDED to the ordinary JSON message body; the
+`X-Symbiont-Frame` content-type header (`tensor/f32;off=<n>`, where `n`
+is the JSON prefix length in bytes) announces it. JSON metadata — ids,
+sentence texts, source url — stays in the JSON prefix, which remains a
+schema-valid message whose per-sentence `embedding` lists are empty.
+Decode is `np.frombuffer` — a zero-copy view, no per-float Python
+object is ever materialized.
+
+Negotiation and the fallback contract:
+
+- request-reply (engine plane): the REQUESTER opts in per call with
+  `"encoding": "frame"`; an old engine ignores the unknown value and
+  replies with JSON float lists, which every caller still accepts.
+- pub/sub (data.text.with_embeddings): a broadcast has no per-consumer
+  negotiation, so the publisher side is a deployment knob —
+  `SYMBIONT_FRAMES` (default on; set `0` when a reference-era JSON-only
+  consumer shares the subject). With frames off, the encoder emits the
+  exact reference wire shape (float lists), byte-compatible with any
+  serde_json peer. Frame-capable consumers accept BOTH forms always, so
+  mixed old/new fleets interoperate in either direction.
+
+The native C++ mirror of this codec lives in native/services/common.hpp
+(make_frame / split_frame); tests/test_frames.py pins the byte layout
+with golden fixtures shared by both implementations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from symbiont_tpu.schema import (
+    SentenceEmbedding,
+    TextWithEmbeddingsMessage,
+    from_json,
+    to_json_bytes,
+)
+from symbiont_tpu.utils.telemetry import metrics
+
+FRAME_HEADER = "X-Symbiont-Frame"
+FRAME_MAGIC = b"SYTF"
+FRAME_VERSION = 1
+DTYPE_F32 = 1
+# magic, version, dtype, reserved, rows, cols — 16 bytes, little-endian
+_HDR = struct.Struct("<4sBBHII")
+FRAME_HDR_LEN = _HDR.size
+
+_CONTENT_TYPE = "tensor/f32"
+
+
+class FrameError(ValueError):
+    """Malformed frame or frame/metadata mismatch (handler-fatal: the
+    delivery stays unacked for redelivery / DLQ, never silently dropped)."""
+
+
+def frames_enabled(default: bool = True) -> bool:
+    """Publisher-side deployment knob for the pub/sub hops (see module
+    docstring). Request-reply paths negotiate per call instead."""
+    v = os.environ.get("SYMBIONT_FRAMES", "")
+    if not v:
+        return default
+    return v not in ("0", "false", "no", "off")
+
+
+def _estimate_json_bytes_per_float() -> float:
+    """Measured-once estimate of what one embedding float costs as wire
+    JSON (the `frame.json_equiv_bytes` counter's multiplier): a seeded f32
+    sample through the exact legacy path (f32 → Python float → json.dumps),
+    which serializes as the shortest round-trip of the DOUBLE widening.
+    The serialization bench tier measures the real ratio per run; this
+    constant only feeds the obs counters."""
+    rng = np.random.default_rng(0)
+    sample = rng.standard_normal(64).astype(np.float32).tolist()
+    return (len(json.dumps(sample, separators=(",", ":"))) - 1) / len(sample)
+
+
+JSON_BYTES_PER_FLOAT_EST = _estimate_json_bytes_per_float()
+
+
+# ----------------------------------------------------------------- raw codec
+
+def encode_frame(rows: np.ndarray) -> bytes:
+    """Pack a [rows, cols] float array as one frame (header + f32le)."""
+    arr = np.ascontiguousarray(np.asarray(rows, dtype="<f4"))
+    if arr.ndim != 2:
+        raise FrameError(f"frame payload must be 2-D, got shape {arr.shape}")
+    t0 = time.perf_counter()
+    out = _HDR.pack(FRAME_MAGIC, FRAME_VERSION, DTYPE_F32, 0,
+                    arr.shape[0], arr.shape[1]) + arr.tobytes()
+    metrics.inc("frame.encoded")
+    metrics.inc("frame.bytes", len(out))
+    metrics.inc("frame.json_equiv_bytes",
+                arr.size * JSON_BYTES_PER_FLOAT_EST)
+    metrics.observe("frame.encode_s", time.perf_counter() - t0)
+    return out
+
+
+def decode_frame(buf: bytes, offset: int = 0) -> np.ndarray:
+    """Decode a frame starting at `offset` into a zero-copy read-only
+    [rows, cols] f32 view over `buf`."""
+    t0 = time.perf_counter()
+    if len(buf) - offset < FRAME_HDR_LEN:
+        raise FrameError("frame truncated before header")
+    magic, version, dtype, _, rows, cols = _HDR.unpack_from(buf, offset)
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if version != FRAME_VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    if dtype != DTYPE_F32:
+        raise FrameError(f"unsupported frame dtype {dtype}")
+    need = rows * cols * 4
+    body = offset + FRAME_HDR_LEN
+    if len(buf) - body < need:
+        raise FrameError(f"frame payload truncated: need {need} bytes, "
+                         f"have {len(buf) - body}")
+    arr = np.frombuffer(buf, dtype="<f4", count=rows * cols,
+                        offset=body).reshape(rows, cols)
+    metrics.inc("frame.decoded")
+    metrics.observe("frame.decode_s", time.perf_counter() - t0)
+    return arr
+
+
+# ------------------------------------------------------------ bus attachment
+
+def attach_frame(json_bytes: bytes, rows: np.ndarray) -> Tuple[bytes, Dict[str, str]]:
+    """JSON body + frame → (wire data, headers to merge into the publish)."""
+    data = bytes(json_bytes) + encode_frame(rows)
+    return data, {FRAME_HEADER: f"{_CONTENT_TYPE};off={len(json_bytes)}"}
+
+
+def frame_offset(headers: Optional[Dict[str, str]]) -> Optional[int]:
+    """Parse the X-Symbiont-Frame header; None when the message carries no
+    frame. Raises FrameError on a malformed header value."""
+    value = (headers or {}).get(FRAME_HEADER)
+    if value is None:
+        return None
+    parts = value.split(";")
+    if parts[0].strip() != _CONTENT_TYPE:
+        raise FrameError(f"unknown frame content type {parts[0]!r}")
+    for p in parts[1:]:
+        k, _, v = p.strip().partition("=")
+        if k == "off":
+            try:
+                off = int(v)
+            except ValueError:
+                raise FrameError(f"bad frame offset {v!r}") from None
+            if off < 0:
+                raise FrameError(f"negative frame offset {off}")
+            return off
+    raise FrameError(f"frame header missing off=: {value!r}")
+
+
+def detach_frame(data: bytes, headers: Optional[Dict[str, str]]
+                 ) -> Tuple[bytes, Optional[np.ndarray]]:
+    """Split a possibly-frame-bearing body into (json bytes, rows-or-None).
+    A frameless message passes through untouched — the JSON fallback."""
+    off = frame_offset(headers)
+    if off is None:
+        return data, None
+    if off > len(data):
+        raise FrameError(f"frame offset {off} beyond body ({len(data)} bytes)")
+    return data[:off], decode_frame(data, off)
+
+
+# ------------------------------------------- data.text.with_embeddings codec
+
+def encode_embeddings_message(original_id: str, source_url: str,
+                              sentences: Sequence[str],
+                              vectors, model_name: str, timestamp_ms: int,
+                              use_frame: Optional[bool] = None
+                              ) -> Tuple[bytes, Dict[str, str]]:
+    """Build the data.text.with_embeddings wire form. Frame mode keeps the
+    floats out of JSON entirely; fallback mode (`use_frame=False` or
+    SYMBIONT_FRAMES=0) emits the exact reference wire shape so a JSON-only
+    peer ingests it unchanged."""
+    if use_frame is None:
+        use_frame = frames_enabled()
+    arr = np.ascontiguousarray(np.asarray(vectors, dtype=np.float32))
+    if arr.ndim != 2 or arr.shape[0] != len(sentences):
+        raise FrameError(
+            f"vectors shape {arr.shape} does not match {len(sentences)} "
+            "sentences")
+    if use_frame:
+        embeddings: List[SentenceEmbedding] = [
+            SentenceEmbedding(sentence_text=s, embedding=[])
+            for s in sentences]
+    else:
+        # ndarray.tolist() converts in C — no per-float Python loop even on
+        # the fallback path (same double-widened digits as the old
+        # `[float(x) for x in v]`, so the bytes stay wire-identical)
+        embeddings = [
+            SentenceEmbedding(sentence_text=s, embedding=row)
+            for s, row in zip(sentences, arr.tolist())]
+    out = TextWithEmbeddingsMessage(
+        original_id=original_id, source_url=source_url,
+        embeddings_data=embeddings, model_name=model_name,
+        timestamp_ms=timestamp_ms)
+    body = to_json_bytes(out)
+    if not use_frame:
+        return body, {}
+    return attach_frame(body, arr)
+
+
+def decode_embeddings_message(data: bytes,
+                              headers: Optional[Dict[str, str]] = None
+                              ) -> Tuple[TextWithEmbeddingsMessage,
+                                         Optional[np.ndarray]]:
+    """Decode either wire form. Returns (message, rows): `rows` is the
+    zero-copy [n_sentences, dim] view when a frame rode along (the
+    message's `embedding` lists are empty then), or None for the JSON
+    fallback (floats live in the message as usual)."""
+    json_bytes, rows = detach_frame(data, headers)
+    msg = from_json(TextWithEmbeddingsMessage, json_bytes)
+    if rows is not None and rows.shape[0] != len(msg.embeddings_data):
+        raise FrameError(
+            f"frame carries {rows.shape[0]} rows for "
+            f"{len(msg.embeddings_data)} sentences")
+    return msg, rows

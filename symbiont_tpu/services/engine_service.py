@@ -31,20 +31,30 @@ import json
 import logging
 from typing import Optional
 
+import numpy as np
+
 from symbiont_tpu import subjects
 from symbiont_tpu.bus.core import Msg
 from symbiont_tpu.engine.batcher import MicroBatcher
 from symbiont_tpu.engine.engine import TpuEngine
 from symbiont_tpu.schema import TokenizedTextMessage, from_dict
+from symbiont_tpu.schema import frames
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.utils.telemetry import child_headers, metrics, span
 
 log = logging.getLogger(__name__)
 
+# request/reply key carrying a decoded tensor frame through the op plumbing
+# (never serialized: _handle pops it off the wire, _reply re-attaches it)
+_FRAME_KEY = "_frame"
+
 
 def _err(payload: dict) -> bytes:
     payload.setdefault("error_message", None)
-    return json.dumps(payload).encode()
+    # compact separators (matching schema.to_json): every engine reply used
+    # to carry json.dumps' default ", "/": " whitespace — pure wasted bytes
+    # on the hottest reply path of the stack
+    return json.dumps(payload, separators=(",", ":")).encode()
 
 
 class EngineService(Service):
@@ -143,20 +153,34 @@ class EngineService(Service):
     # ------------------------------------------------------------- plumbing
 
     async def _reply(self, msg: Msg, payload: dict) -> None:
-        if msg.reply:
-            await self.bus.publish(msg.reply, _err(payload),
-                                   headers=child_headers(msg.headers))
+        if not msg.reply:
+            return
+        headers = child_headers(msg.headers)
+        # an op that put an ndarray under _FRAME_KEY replies with the block
+        # as a binary tensor frame appended to the JSON metadata
+        frame = payload.pop(_FRAME_KEY, None)
+        data = _err(payload)
+        if frame is not None:
+            data, fheaders = frames.attach_frame(data, frame)
+            headers.update(fheaders)
+        await self.bus.publish(msg.reply, data, headers=headers)
 
     async def _handle(self, msg: Msg, op: str, fn) -> None:
-        """Decode → run op → reply; typed error reply on any failure."""
+        """Decode → run op → reply; typed error reply on any failure.
+        A request-side tensor frame (schema/frames) is detached here and
+        handed to the op as `req["_frame"]` (a zero-copy [n, dim] view)."""
         if not msg.reply:
             log.warning("engine op %s without reply inbox dropped", op)
             metrics.inc("engine.no_reply_inbox")
             return
         try:
-            req = json.loads(msg.data) if msg.data else {}
+            raw, frame = frames.detach_frame(msg.data or b"", msg.headers)
+            req = json.loads(raw) if raw else {}
             if not isinstance(req, dict):
                 raise ValueError("request must be a JSON object")
+            req.pop(_FRAME_KEY, None)  # reserved: only a real frame sets it
+            if frame is not None:
+                req[_FRAME_KEY] = frame
         except Exception as e:
             await self._reply(msg, {"error_message": f"bad request: {e}"})
             return
@@ -181,23 +205,35 @@ class EngineService(Service):
             if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
                 raise ValueError("texts must be a list of strings")
             vecs = await self.batcher.embed(texts)
-            if req.get("encoding") == "b64":
-                # compact reply for bulk callers (the C++ preprocessing
-                # shell): f32 little-endian rows base64'd is ~4.3 bytes per
-                # float vs ~10 digits of JSON — and skips the per-float
-                # Python float() / repr() round-trip entirely
+            encoding = req.get("encoding")
+            if encoding == "frame":
+                # zero-copy reply for frame-capable callers: the [n, dim]
+                # f32 block rides as a binary tensor frame appended to the
+                # JSON metadata (_reply attaches it; schema/frames). An old
+                # engine ignores this encoding and answers with JSON float
+                # lists — the negotiated fallback every caller accepts.
+                arr = np.ascontiguousarray(np.asarray(vecs, np.float32))
+                if arr.ndim == 1:  # zero texts edge: keep the 2-D contract
+                    arr = arr.reshape(0, 0)
+                return {"count": int(arr.shape[0]), "dim": int(arr.shape[1]),
+                        "model_name": self.engine.config.model_name,
+                        _FRAME_KEY: arr}
+            if encoding == "b64":
+                # compact reply for reference-era bulk callers: f32
+                # little-endian rows base64'd is ~4.3 bytes per float vs
+                # ~10 digits of JSON
                 import base64
 
-                import numpy as _np
-
-                arr = _np.ascontiguousarray(_np.asarray(vecs, _np.float32))
+                arr = np.ascontiguousarray(np.asarray(vecs, np.float32))
                 if arr.ndim == 1:  # zero texts edge: keep the 2-D contract
                     arr = arr.reshape(0, 0)
                 return {"vectors_b64": base64.b64encode(arr.tobytes()).decode(
                             "ascii"),
                         "count": int(arr.shape[0]), "dim": int(arr.shape[1]),
                         "model_name": self.engine.config.model_name}
-            return {"vectors": [[float(x) for x in v] for v in vecs],
+            # JSON fallback: ndarray.tolist() converts in C (no per-float
+            # Python loop), same double-widened digits as before
+            return {"vectors": np.asarray(vecs, np.float32).tolist(),
                     "model_name": self.engine.config.model_name}
         await self._handle(msg, "embed.batch", op)
 
@@ -207,7 +243,7 @@ class EngineService(Service):
             if not isinstance(text, str):
                 raise ValueError("text must be a string")
             vecs = await self.batcher.embed([text])
-            return {"vector": [float(x) for x in vecs[0]],
+            return {"vector": np.asarray(vecs[0], np.float32).tolist(),
                     "model_name": self.engine.config.model_name}
         await self._handle(msg, "embed.query", op)
 
@@ -244,17 +280,34 @@ class EngineService(Service):
 
     async def _vec_upsert(self, msg: Msg) -> None:
         async def op(req: dict) -> dict:
-            if "vectors_b64" in req:
-                # compact form from the C++ vector_memory shell: all vectors
+            rows = None
+            if _FRAME_KEY in req:
+                # tensor-frame ingest (the C++ vector_memory shell's frame
+                # hop): the [n, dim] block arrived as a zero-copy view —
+                # it goes into the store without touching JSON floats
+                rows = req[_FRAME_KEY]
+                ids = req["ids"]
+                if rows.shape[0] != len(ids):
+                    raise ValueError(
+                        f"frame holds {rows.shape[0]} rows for "
+                        f"{len(ids)} ids")
+                if "dim" in req and rows.shape[1] != int(req["dim"]):
+                    raise ValueError(
+                        f"frame dim {rows.shape[1]} != declared "
+                        f"dim {req['dim']}")
+                payloads = req.get("payloads") or [{}] * len(ids)
+                if len(payloads) != len(ids):
+                    raise ValueError(
+                        f"{len(payloads)} payloads for {len(ids)} ids")
+            elif "vectors_b64" in req:
+                # compact form from reference-era C++ shells: all vectors
                 # in one base64 f32 block (framework-internal plane; the
                 # data.text.with_embeddings wire schema is untouched)
                 import base64
 
-                import numpy as _np
-
                 dim = int(req["dim"])
-                flat = _np.frombuffer(base64.b64decode(req["vectors_b64"]),
-                                      dtype=_np.float32)
+                flat = np.frombuffer(base64.b64decode(req["vectors_b64"]),
+                                     dtype=np.float32)
                 ids = req["ids"]
                 if dim <= 0 or flat.size != len(ids) * dim:
                     raise ValueError(
@@ -266,11 +319,20 @@ class EngineService(Service):
                     # zip would silently truncate and drop points
                     raise ValueError(
                         f"{len(payloads)} payloads for {len(ids)} ids")
-                points = list(zip(ids, rows, payloads))
             else:
                 points = [(p["id"], p["vector"], p.get("payload", {}))
                           for p in req["points"]]
-            n = await self._run_blocking(self.vector_store.upsert, points)
+            if rows is not None:
+                if hasattr(self.vector_store, "upsert_rows"):
+                    n = await self._run_blocking(
+                        self.vector_store.upsert_rows, ids, rows, payloads)
+                else:
+                    n = await self._run_blocking(
+                        self.vector_store.upsert, list(zip(ids, rows,
+                                                           payloads)))
+            else:
+                n = await self._run_blocking(self.vector_store.upsert,
+                                             points)
             if self._fused_enabled() and (
                     self._warm_failed or await self._run_blocking(
                         self.vector_store.fused_warm_stale)):

@@ -21,6 +21,8 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
+import numpy as np
+
 from symbiont_tpu import subjects
 from symbiont_tpu.bus.core import Msg
 from symbiont_tpu.engine.batcher import MicroBatcher
@@ -30,12 +32,11 @@ from symbiont_tpu.schema import (
     QueryEmbeddingResult,
     QueryForEmbeddingTask,
     RawTextMessage,
-    SentenceEmbedding,
-    TextWithEmbeddingsMessage,
     TokenizedTextMessage,
     from_json,
     to_json_bytes,
 )
+from symbiont_tpu.schema import frames
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.utils.ids import current_timestamp_ms
 from symbiont_tpu.utils.telemetry import child_headers, metrics
@@ -49,13 +50,18 @@ class PreprocessingService(Service):
     def __init__(self, bus, engine: TpuEngine,
                  batcher: Optional[MicroBatcher] = None,
                  publish_tokenized: bool = True,
-                 durable_stream: Optional[str] = None):
+                 durable_stream: Optional[str] = None,
+                 use_frames: Optional[bool] = None):
         super().__init__(bus)
         self.engine = engine
         self.batcher = batcher or MicroBatcher(engine)
         self.publish_tokenized = publish_tokenized
         self.model_name = engine.config.model_name
         self.durable_stream = durable_stream
+        # binary tensor frames on data.text.with_embeddings (schema/frames);
+        # None → the SYMBIONT_FRAMES deployment knob (default on)
+        self.use_frames = (frames.frames_enabled() if use_frames is None
+                           else use_frames)
 
     async def start(self) -> None:
         await self.batcher.start()
@@ -85,19 +91,17 @@ class PreprocessingService(Service):
             return
         sentences = split_sentences(cleaned)
         vectors = await self.batcher.embed(sentences)
-        out = TextWithEmbeddingsMessage(
-            original_id=raw.id,
-            source_url=raw.source_url,
-            embeddings_data=[
-                SentenceEmbedding(sentence_text=s, embedding=[float(x) for x in v])
-                for s, v in zip(sentences, vectors)
-            ],
-            model_name=self.model_name,
-            timestamp_ms=current_timestamp_ms(),
-        )
+        # engine output → wire without a single per-float Python conversion:
+        # frame mode appends the [n, dim] f32 block to the JSON metadata
+        # (schema/frames); fallback mode emits the reference wire shape
+        data, fheaders = frames.encode_embeddings_message(
+            raw.id, raw.source_url, sentences, vectors, self.model_name,
+            current_timestamp_ms(), use_frame=self.use_frames)
         headers = child_headers(msg.headers)
+        # the frame header rides ONLY on the frame-bearing publish — the
+        # tokenized publish below shares the trace context, not the frame
         await self.bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS,
-                               to_json_bytes(out), headers=headers)
+                               data, headers={**headers, **fheaders})
         metrics.inc("preprocessing.embedded_docs")
         metrics.inc("preprocessing.embedded_sentences", len(sentences))
         if self.publish_tokenized:
@@ -127,7 +131,7 @@ class PreprocessingService(Service):
             vecs = await self.batcher.embed([task.text_to_embed])
             result = QueryEmbeddingResult(
                 request_id=task.request_id,
-                embedding=[float(x) for x in vecs[0]],
+                embedding=np.asarray(vecs[0], np.float32).tolist(),
                 model_name=self.model_name, error_message=None)
         except Exception as e:
             log.exception("query embedding failed")

@@ -22,10 +22,10 @@ from symbiont_tpu.schema import (
     SemanticSearchNatsResult,
     SemanticSearchNatsTask,
     SemanticSearchResultItem,
-    TextWithEmbeddingsMessage,
     from_json,
     to_json_bytes,
 )
+from symbiont_tpu.schema import frames
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.utils.ids import (
     current_timestamp_ms,
@@ -59,9 +59,12 @@ class VectorMemoryService(Service):
                                    queue=subjects.QUEUE_VECTOR_MEMORY)
 
     async def _handle_upsert(self, msg: Msg) -> None:
-        m = from_json(TextWithEmbeddingsMessage, msg.data)
+        # both wire forms (schema/frames): a frame-bearing message hands
+        # back a zero-copy [n, dim] view; the JSON fallback carries float
+        # lists in the message as the reference always did
+        m, rows = frames.decode_embeddings_message(msg.data, msg.headers)
         now = current_timestamp_ms()
-        points = []
+        ids, payloads = [], []
         for order, se in enumerate(m.embeddings_data):
             payload = QdrantPointPayload(
                 original_document_id=m.original_id,
@@ -74,13 +77,29 @@ class VectorMemoryService(Service):
             # content-derived id: durable redelivery overwrites the same
             # point instead of duplicating it (reference mints random uuids,
             # main.rs:142-177 — safe only at-most-once)
-            points.append((deterministic_point_id(m.original_id, order),
-                           se.embedding, dataclasses.asdict(payload)))
-        with span("vector_memory.upsert", msg.headers, points=len(points)):
+            ids.append(deterministic_point_id(m.original_id, order))
+            payloads.append(dataclasses.asdict(payload))
+        with span("vector_memory.upsert", msg.headers, points=len(ids)):
             # executor: with an external-Qdrant backend this is a blocking
             # HTTP call; it must not stall the event loop
-            n = await asyncio.get_running_loop().run_in_executor(
-                None, self.store.upsert, points)
+            loop = asyncio.get_running_loop()
+            if rows is not None and hasattr(self.store, "upsert_rows"):
+                # frame → store as one ndarray block: no per-float Python
+                # object between the engine's output and the store
+                n = await loop.run_in_executor(
+                    None, self.store.upsert_rows, ids, rows, payloads)
+            elif rows is not None:
+                # backend without the fast path (bare external Qdrant):
+                # hand the zero-copy row views through the tuple surface
+                points = list(zip(ids, rows, payloads))
+                n = await loop.run_in_executor(None, self.store.upsert,
+                                               points)
+            else:
+                points = [(pid, se.embedding, payload)
+                          for pid, se, payload in
+                          zip(ids, m.embeddings_data, payloads)]
+                n = await loop.run_in_executor(None, self.store.upsert,
+                                               points)
         metrics.inc("vector_memory.points_upserted", n)
 
     async def _handle_search(self, msg: Msg) -> None:

@@ -66,12 +66,38 @@ class OnlineLmTrainer:
         # weights train in f32 regardless of the serving dtype — the engine
         # stores params at model dtype (bf16) since r5, and optimizing bf16
         # masters directly would lose update precision.
-        params = jax.tree.map(
-            lambda a: (jnp.array(a, dtype=jnp.float32, copy=True)
-                       if jnp.issubdtype(a.dtype, jnp.floating)
-                       else jnp.copy(a)), lm.params)
+        #
+        # ADVICE r5: when the engine booted from a real checkpoint and no
+        # saved train state will be restored below, widening the engine's
+        # bf16-rounded params would bake a one-time precision loss into the
+        # masters — reload the ORIGINAL pre-cast checkpoint instead.
+        params = None
+        resuming = bool(state_path and ckpt.train_state_exists(state_path))
+        model_dir = getattr(lm.config, "model_dir", None)
+        if not resuming and model_dir:
+            try:
+                from symbiont_tpu.models.convert import load_gpt_model
+
+                ck_params, _ = load_gpt_model(model_dir)
+                params = jax.tree.map(
+                    lambda a: (jnp.asarray(a, dtype=jnp.float32)
+                               if jnp.issubdtype(np.asarray(a).dtype,
+                                                 np.floating)
+                               else jnp.asarray(a)), ck_params)
+                log.info("online LM masters initialized from the pre-cast "
+                         "checkpoint at %s", model_dir)
+            except Exception:
+                log.exception(
+                    "could not reload the checkpoint at %s for f32 masters; "
+                    "falling back to the engine's (bf16-rounded) params",
+                    model_dir)
+        if params is None:
+            params = jax.tree.map(
+                lambda a: (jnp.array(a, dtype=jnp.float32, copy=True)
+                           if jnp.issubdtype(a.dtype, jnp.floating)
+                           else jnp.copy(a)), lm.params)
         self.state, self._tx = make_lm_train_state(params, learning_rate)
-        if state_path and ckpt.train_state_exists(state_path):
+        if resuming:  # one consistent answer with the masters-init decision
             try:
                 self.state, meta = ckpt.load_train_state(state_path, self.state)
                 self.stats["train_steps"] = int(meta.get("steps", 0))

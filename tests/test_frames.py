@@ -1,0 +1,504 @@
+"""Binary tensor frames (schema/frames + native common.hpp mirror).
+
+Three contracts under test:
+
+1. the BYTE LAYOUT — golden fixtures built independently of the codec
+   (struct.pack by hand from the spec) pin both directions, and when a C++
+   toolchain is available the native encoder/decoder in
+   native/services/common.hpp is compiled and run against the same bytes
+   (Python encodes → C++ decodes, C++ encodes → Python decodes);
+2. the NEGOTIATION / fallback contract — a frame-capable publisher with
+   frames off emits byte-exact reference wire JSON a JSON-only peer
+   ingests; a frame-capable consumer accepts both forms; an engine caller
+   that does not opt in gets JSON float lists;
+3. LOSSLESSNESS through the resilience plane — a frame-bearing message
+   that dead-letters replays from the DLQ bit-for-bit, headers included.
+"""
+
+import asyncio
+import json
+import shutil
+import struct
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from symbiont_tpu import subjects
+from symbiont_tpu.bus.inproc import InprocBus
+from symbiont_tpu.schema import TextWithEmbeddingsMessage, frames, from_json
+from symbiont_tpu.utils.ids import deterministic_point_id
+
+REPO = Path(__file__).resolve().parent.parent
+
+GOLDEN_ROWS = np.array([[1.0, -2.5, 0.15625],
+                        [3.5, 65504.0, -0.0]], dtype=np.float32)
+
+
+def golden_frame_bytes() -> bytes:
+    """The spec, transcribed independently of the codec under test."""
+    out = b"SYTF"                      # magic
+    out += struct.pack("<B", 1)        # version
+    out += struct.pack("<B", 1)        # dtype f32le
+    out += struct.pack("<H", 0)        # reserved
+    out += struct.pack("<I", 2)        # rows
+    out += struct.pack("<I", 3)        # cols
+    for v in [1.0, -2.5, 0.15625, 3.5, 65504.0, -0.0]:
+        out += struct.pack("<f", v)
+    return out
+
+
+# ------------------------------------------------------------- byte layout
+
+def test_encode_matches_golden_bytes():
+    assert frames.encode_frame(GOLDEN_ROWS) == golden_frame_bytes()
+
+
+def test_decode_golden_bytes():
+    rows = frames.decode_frame(golden_frame_bytes())
+    assert rows.shape == (2, 3)
+    np.testing.assert_array_equal(rows, GOLDEN_ROWS)
+    # -0.0 sign survives (bit-exactness, not just value equality)
+    assert np.signbit(rows[1, 2])
+
+
+def test_attach_detach_roundtrip():
+    body = b'{"k":"v"}'
+    data, headers = frames.attach_frame(body, GOLDEN_ROWS)
+    assert headers[frames.FRAME_HEADER] == f"tensor/f32;off={len(body)}"
+    json_part, rows = frames.detach_frame(data, headers)
+    assert json_part == body
+    np.testing.assert_array_equal(rows, GOLDEN_ROWS)
+
+
+def test_detach_without_header_is_passthrough():
+    data, rows = frames.detach_frame(b'{"a":1}', {})
+    assert data == b'{"a":1}' and rows is None
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[:20],                          # truncated payload
+    lambda b: b"XXXX" + b[4:],                 # bad magic
+    lambda b: b[:4] + b"\x09" + b[5:],         # unknown version
+    lambda b: b[:5] + b"\x07" + b[6:],         # unknown dtype
+])
+def test_malformed_frames_raise(mutate):
+    with pytest.raises(frames.FrameError):
+        frames.decode_frame(mutate(golden_frame_bytes()))
+
+
+@pytest.mark.parametrize("value", [
+    "tensor/f64;off=2", "tensor/f32", "tensor/f32;off=x",
+    "tensor/f32;off=-1"])
+def test_malformed_header_values_raise(value):
+    with pytest.raises(frames.FrameError):
+        frames.detach_frame(b"{}" + golden_frame_bytes(),
+                            {frames.FRAME_HEADER: value})
+
+
+def test_frame_offset_beyond_body_raises():
+    with pytest.raises(frames.FrameError):
+        frames.detach_frame(b"{}", {frames.FRAME_HEADER:
+                                    "tensor/f32;off=999"})
+
+
+# --------------------------------------------------- message-level contract
+
+def _sample_args():
+    rng = np.random.default_rng(3)
+    sentences = ["The MXU does matmuls.", "HBM is the bottleneck!"]
+    vectors = rng.standard_normal((2, 8)).astype(np.float32)
+    return sentences, vectors
+
+
+def test_frame_message_roundtrip():
+    sentences, vectors = _sample_args()
+    data, headers = frames.encode_embeddings_message(
+        "doc-1", "http://d", sentences, vectors, "m", 123, use_frame=True)
+    msg, rows = frames.decode_embeddings_message(data, headers)
+    assert rows is not None
+    np.testing.assert_array_equal(rows, vectors)  # bit-exact f32
+    assert [se.sentence_text for se in msg.embeddings_data] == sentences
+    assert all(se.embedding == [] for se in msg.embeddings_data)
+    assert (msg.original_id, msg.source_url, msg.model_name,
+            msg.timestamp_ms) == ("doc-1", "http://d", "m", 123)
+
+
+def test_fallback_is_wire_json_a_json_only_peer_ingests():
+    """The negotiated fallback: frames off → the exact reference wire
+    shape, decodable by a peer that knows nothing about frames."""
+    sentences, vectors = _sample_args()
+    data, headers = frames.encode_embeddings_message(
+        "doc-1", "http://d", sentences, vectors, "m", 123, use_frame=False)
+    assert frames.FRAME_HEADER not in headers
+    # a JSON-only peer: plain strict schema decode, no frames module
+    peer_view = from_json(TextWithEmbeddingsMessage, data)
+    got = np.asarray([se.embedding for se in peer_view.embeddings_data],
+                     np.float32)
+    np.testing.assert_array_equal(got, vectors)  # f32→double→f32 is exact
+
+
+def test_frame_row_count_mismatch_raises():
+    sentences, vectors = _sample_args()
+    data, headers = frames.encode_embeddings_message(
+        "doc-1", "http://d", sentences, vectors, "m", 123, use_frame=True)
+    # clip one sentence out of the JSON metadata, keep the 2-row frame
+    off = frames.frame_offset(headers)
+    meta = json.loads(data[:off])
+    meta["embeddings_data"] = meta["embeddings_data"][:1]
+    body = json.dumps(meta, separators=(",", ":")).encode()
+    bad = body + data[off:]
+    with pytest.raises(frames.FrameError):
+        frames.decode_embeddings_message(
+            bad, {frames.FRAME_HEADER: f"tensor/f32;off={len(body)}"})
+
+
+def test_frames_enabled_env(monkeypatch):
+    monkeypatch.delenv("SYMBIONT_FRAMES", raising=False)
+    assert frames.frames_enabled()
+    for off_value in ("0", "false", "no", "off"):
+        monkeypatch.setenv("SYMBIONT_FRAMES", off_value)
+        assert not frames.frames_enabled()
+    monkeypatch.setenv("SYMBIONT_FRAMES", "1")
+    assert frames.frames_enabled()
+
+
+# ------------------------------------------------- store + service plumbing
+
+def test_upsert_rows_matches_upsert(tmp_path):
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    rng = np.random.default_rng(5)
+    rows = rng.standard_normal((6, 16)).astype(np.float32)
+    ids = [deterministic_point_id("d", i) for i in range(6)]
+    payloads = [{"sentence_text": f"s{i}"} for i in range(6)]
+
+    a = VectorStore(VectorStoreConfig(dim=16, data_dir=str(tmp_path / "a")))
+    a.upsert(list(zip(ids, rows, payloads)))
+    b = VectorStore(VectorStoreConfig(dim=16, data_dir=str(tmp_path / "b")))
+    # a read-only frombuffer view — exactly what the bus decode hands over
+    view = np.frombuffer(rows.tobytes(), dtype=np.float32).reshape(6, 16)
+    assert not view.flags.writeable
+    b.upsert_rows(ids, view, payloads)
+
+    assert a.count() == b.count() == 6
+    np.testing.assert_array_equal(a._vectors, b._vectors)
+    assert a._payloads == b._payloads
+    # WAL durability identical: a fresh load reconstructs the same store
+    b2 = VectorStore(VectorStoreConfig(dim=16, data_dir=str(tmp_path / "b")))
+    np.testing.assert_array_equal(b2._vectors, b._vectors)
+
+    # overwrite semantics shared with upsert: same ids, new vectors
+    rows2 = rng.standard_normal((6, 16)).astype(np.float32)
+    b.upsert_rows(ids, rows2, payloads)
+    assert b.count() == 6
+
+    with pytest.raises(ValueError):
+        b.upsert_rows(ids, rows2[:3], payloads)
+    with pytest.raises(ValueError):
+        b.upsert_rows(ids, rows2, payloads[:3])
+    with pytest.raises(ValueError):
+        b.upsert_rows(ids, rows2[:, :8], payloads)
+
+
+def test_vector_memory_service_ingests_both_forms(tmp_path):
+    """The same document through the frame wire and the JSON wire lands
+    identically in the store (the consumer-side half of interop)."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+    from symbiont_tpu.services.vector_memory import VectorMemoryService
+
+    sentences, vectors = _sample_args()
+
+    async def ingest(doc_id, use_frame, store):
+        bus = InprocBus()
+        svc = VectorMemoryService(bus, store)
+        await svc.start()
+        try:
+            data, fheaders = frames.encode_embeddings_message(
+                doc_id, "http://d", sentences, vectors, "m", 123,
+                use_frame=use_frame)
+            await bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS, data,
+                              headers=fheaders)
+            for _ in range(100):
+                if store.count() >= len(sentences):
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    sa = VectorStore(VectorStoreConfig(dim=8, data_dir=str(tmp_path / "f")))
+    sb = VectorStore(VectorStoreConfig(dim=8, data_dir=str(tmp_path / "j")))
+    asyncio.run(ingest("doc-x", True, sa))
+    asyncio.run(ingest("doc-x", False, sb))
+    assert sa.count() == sb.count() == len(sentences)
+    np.testing.assert_array_equal(sa._vectors, sb._vectors)
+    assert sa._ids == sb._ids
+    assert [p["sentence_text"] for p in sa._payloads] == sentences
+
+
+def test_vector_memory_frame_ingest_without_upsert_rows(tmp_path):
+    """A backend exposing only the reference upsert() surface (bare
+    external Qdrant, no resilience wrapper) must still ingest frame
+    messages — the service falls back to the point-tuple surface."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+    from symbiont_tpu.services.vector_memory import VectorMemoryService
+
+    sentences, vectors = _sample_args()
+
+    class UpsertOnlyStore:
+        def __init__(self):
+            self.inner = VectorStore(VectorStoreConfig(
+                dim=8, data_dir=str(tmp_path)))
+
+        def ensure_collection(self, dim=None):
+            self.inner.ensure_collection(dim)
+
+        def upsert(self, points):
+            return self.inner.upsert(points)
+
+        def count(self):
+            return self.inner.count()
+
+    store = UpsertOnlyStore()
+    assert not hasattr(store, "upsert_rows")
+
+    async def scenario():
+        bus = InprocBus()
+        svc = VectorMemoryService(bus, store)
+        await svc.start()
+        try:
+            data, fheaders = frames.encode_embeddings_message(
+                "doc-q", "http://d", sentences, vectors, "m", 123,
+                use_frame=True)
+            await bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS, data,
+                              headers=fheaders)
+            for _ in range(200):
+                if store.count() >= len(sentences):
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+    assert store.count() == len(sentences)
+    np.testing.assert_allclose(
+        store.inner._vectors,
+        vectors / np.linalg.norm(vectors, axis=1, keepdims=True),
+        rtol=1e-6)
+
+
+def test_engine_embed_reply_negotiation(tmp_path):
+    """Request-reply negotiation: a caller opting in gets a frame reply; a
+    caller that does not (an old peer) gets JSON float lists — and both
+    decode to the same vectors. The upsert op accepts a frame request."""
+    from symbiont_tpu.config import EngineConfig, VectorStoreConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+    from symbiont_tpu.memory.vector_store import VectorStore
+    from symbiont_tpu.services.engine_service import EngineService
+
+    async def scenario():
+        bus = InprocBus()
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], dtype="float32"))
+        store = VectorStore(VectorStoreConfig(dim=32,
+                                              data_dir=str(tmp_path)))
+        svc = EngineService(bus, engine=eng, vector_store=store)
+        await svc.start()
+        try:
+            texts = ["hello world", "tpu"]
+            # frame-capable caller
+            msg = await bus.request(
+                subjects.ENGINE_EMBED_BATCH,
+                json.dumps({"texts": texts, "encoding": "frame"}).encode(),
+                timeout=30.0)
+            meta_b, rows = frames.detach_frame(msg.data, msg.headers)
+            meta = json.loads(meta_b)
+            assert meta["error_message"] is None
+            assert rows is not None and rows.shape == (2, 32)
+            assert (meta["count"], meta["dim"]) == (2, 32)
+            assert "_frame" not in meta  # the ndarray never hits JSON
+
+            # JSON-only caller: negotiated fallback
+            msg2 = await bus.request(
+                subjects.ENGINE_EMBED_BATCH,
+                json.dumps({"texts": texts}).encode(), timeout=30.0)
+            assert frames.FRAME_HEADER not in msg2.headers
+            legacy = json.loads(msg2.data)
+            np.testing.assert_allclose(
+                np.asarray(legacy["vectors"], np.float32), rows, rtol=1e-6)
+
+            # frame REQUEST into the upsert op (the C++ shell's hop)
+            ids = [deterministic_point_id("d", i) for i in range(2)]
+            body = json.dumps({"ids": ids, "dim": 32,
+                               "payloads": [{"sentence_text": t}
+                                            for t in texts]}).encode()
+            data, fheaders = frames.attach_frame(body, rows)
+            up = await bus.request(subjects.ENGINE_VECTOR_UPSERT, data,
+                                   timeout=30.0, headers=fheaders)
+            up_r = json.loads(up.data)
+            assert up_r["error_message"] is None and up_r["upserted"] == 2
+            assert store.count() == 2
+            np.testing.assert_allclose(
+                store._vectors,
+                rows / np.linalg.norm(rows, axis=1, keepdims=True),
+                rtol=1e-6)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
+def test_dlq_replay_roundtrips_frame_losslessly(tmp_path):
+    """Resilience-plane contract: a frame-bearing delivery that exhausts
+    max_deliver dead-letters with data AND headers intact, and an operator
+    replay re-enters the durable flow with the frame decodable."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+    from symbiont_tpu.services.vector_memory import VectorMemoryService
+
+    sentences, vectors = _sample_args()
+
+    async def scenario():
+        bus = InprocBus()
+        await bus.add_stream("pipeline",
+                             [subjects.DATA_TEXT_WITH_EMBEDDINGS],
+                             ack_wait_s=0.1, max_deliver=2)
+        store = VectorStore(VectorStoreConfig(dim=8,
+                                              data_dir=str(tmp_path)))
+        svc = VectorMemoryService(bus, store, durable_stream="pipeline")
+        # poison the handler so every delivery fails → DLQ
+        real_upsert_rows = store.upsert_rows
+        fail = {"on": True}
+
+        def flaky(ids, rows, payloads=None):
+            if fail["on"]:
+                raise RuntimeError("injected store outage")
+            return real_upsert_rows(ids, rows, payloads)
+
+        store.upsert_rows = flaky
+        await svc.start()
+        try:
+            data, fheaders = frames.encode_embeddings_message(
+                "doc-dlq", "http://d", sentences, vectors, "m", 123,
+                use_frame=True)
+            await bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS, data,
+                              headers=fheaders)
+            for _ in range(200):
+                if len(bus.dlq):
+                    break
+                await asyncio.sleep(0.02)
+            entries = bus.dlq.list()
+            assert len(entries) == 1
+            parked = bus.dlq.get(entries[0].id)
+            assert parked.data == data  # bit-for-bit, frame included
+            assert parked.headers[frames.FRAME_HEADER] == \
+                fheaders[frames.FRAME_HEADER]
+            m, rows = frames.decode_embeddings_message(parked.data,
+                                                       parked.headers)
+            np.testing.assert_array_equal(rows, vectors)
+
+            # handler fixed → replay → the document lands
+            fail["on"] = False
+            assert await bus.dlq.replay(bus) == 1
+            for _ in range(200):
+                if store.count() >= len(sentences):
+                    break
+                await asyncio.sleep(0.02)
+            assert store.count() == len(sentences)
+            np.testing.assert_allclose(
+                store._vectors,
+                vectors / np.linalg.norm(vectors, axis=1, keepdims=True),
+                rtol=1e-6)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
+# ----------------------------------------------------------- C++ parity
+
+CPP_HARNESS = r"""
+#include "json.hpp"
+#include "services/common.hpp"
+#include <cstdio>
+#include <iostream>
+#include <map>
+#include <string>
+
+// stdin: full frame-bearing body; argv[1]: the X-Symbiont-Frame header
+// value. Decodes via symbiont::split_frame, prints rows/cols and every
+// float (%.9g round-trips f32), then re-encodes the payload through
+// symbiont::make_frame and prints its hex — Python asserts both ways.
+int main(int argc, char** argv) {
+  std::string body((std::istreambuf_iterator<char>(std::cin)),
+                   std::istreambuf_iterator<char>());
+  std::map<std::string, std::string> headers;
+  if (argc > 1) headers[symbiont::FRAME_HEADER] = argv[1];
+  std::string json_part;
+  symbiont::FrameView fv;
+  if (!symbiont::split_frame(headers, body, json_part, fv)) {
+    std::printf("noframe\n");
+    return 0;
+  }
+  std::printf("%u %u\n", fv.rows, fv.cols);
+  auto rows = symbiont::frame_rows(fv);
+  for (const auto& r : rows)
+    for (float v : r) std::printf("%.9g\n", (double)v);
+  std::string raw(fv.payload, fv.payload_len);
+  std::string re = symbiont::make_frame(raw, fv.rows, fv.cols);
+  for (unsigned char c : re) std::printf("%02x", c);
+  std::printf("\n");
+  return 0;
+}
+"""
+
+
+def _compile_harness(tmp: Path):
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        pytest.skip("no C++ compiler on this host")
+    src = tmp / "frame_parity.cpp"
+    src.write_text(CPP_HARNESS)
+    exe = tmp / "frame_parity"
+    proc = subprocess.run(
+        [gxx, "-std=c++17", "-O1", "-I", str(REPO / "native"),
+         str(src), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        pytest.skip("C++ toolchain cannot build the native tree here "
+                    f"(same limitation as test_codegen_cpp): {proc.stderr[:400]}")
+    return exe
+
+
+def test_cpp_frame_parity():
+    """Python encodes → the real C++ decoder decodes; the real C++ encoder
+    re-emits → bytes identical to Python's. Skips where the native tree
+    cannot compile (this sandbox's gcc lacks float to_chars)."""
+    with tempfile.TemporaryDirectory() as td:
+        exe = _compile_harness(Path(td))
+        body = b'{"meta":1}'
+        data, headers = frames.attach_frame(body, GOLDEN_ROWS)
+        out = subprocess.run(
+            [str(exe), headers[frames.FRAME_HEADER]], input=data,
+            capture_output=True, timeout=60).stdout.decode().split()
+        rows, cols = int(out[0]), int(out[1])
+        assert (rows, cols) == GOLDEN_ROWS.shape
+        got = np.array(out[2:2 + rows * cols],
+                       np.float32).reshape(rows, cols)
+        np.testing.assert_array_equal(got, GOLDEN_ROWS)
+        # C++ re-encoded frame == Python-encoded frame, byte for byte
+        assert bytes.fromhex(out[2 + rows * cols]) == \
+            frames.encode_frame(GOLDEN_ROWS)
+        # and a frameless body passes through as the JSON fallback
+        noframe = subprocess.run([str(exe)], input=body,
+                                 capture_output=True, timeout=60)
+        assert noframe.stdout.decode().strip() == "noframe"

@@ -100,6 +100,19 @@ def stop_worker(proc) -> str:
     return (err or b"").decode(errors="replace")
 
 
+def decode_emb_msg(msg):
+    """Decode a data.text.with_embeddings bus message in EITHER wire form
+    (the C++ workers publish binary tensor frames by default now) into a
+    TextWithEmbeddingsMessage with the float lists materialized."""
+    from symbiont_tpu.schema import frames
+
+    m, rows = frames.decode_embeddings_message(msg.data, msg.headers)
+    if rows is not None:
+        for se, row in zip(m.embeddings_data, rows):
+            se.embedding = row.tolist()
+    return m
+
+
 async def _tcp_bus(port):
     from symbiont_tpu.bus.tcp import TcpBus
 
@@ -221,7 +234,7 @@ def test_native_pipeline_preprocessing_vector_memory(broker):
 
                 emb_msg = await sub_emb.next(60.0)
                 assert emb_msg is not None, "no with_embeddings published"
-                emb = from_json(TextWithEmbeddingsMessage, emb_msg.data)
+                emb = decode_emb_msg(emb_msg)
                 assert [se.sentence_text for se in emb.embeddings_data] == [
                     "The MXU does matmuls.", "HBM is the bottleneck!", "ok"]
                 assert all(len(se.embedding) == 32 for se in emb.embeddings_data)
@@ -1104,7 +1117,7 @@ def test_native_preprocessing_coalesces_docs(broker):
                 for _ in range(len(docs)):
                     m = await sub_emb.next(60.0)
                     assert m is not None, f"only {len(got)}/{len(docs)} docs"
-                    out = from_json(TextWithEmbeddingsMessage, m.data)
+                    out = decode_emb_msg(m)
                     got[out.original_id] = out
                 assert set(got) == {d.id for d in docs}
 
@@ -1115,8 +1128,9 @@ def test_native_preprocessing_coalesces_docs(broker):
                     f"({calls_after - calls_before} hops for {len(docs)} docs)")
 
                 # alignment: every published vector == embedding that exact
-                # sentence directly (b64 engine hop is exact f32; the only
-                # lossy leg is the C++ float→JSON dump of the publish)
+                # sentence directly (the frame path is exact f32 end-to-end;
+                # with SYMBIONT_FRAMES=0 the only lossy leg would be the C++
+                # float→JSON dump of the publish)
                 for d in docs:
                     out = got[d.id]
                     sents = [se.sentence_text for se in out.embeddings_data]

@@ -163,6 +163,94 @@ def test_service_ingest_triggers_lm_training():
     asyncio.run(scenario())
 
 
+def test_masters_init_from_precast_checkpoint(monkeypatch, tmp_path):
+    """ADVICE r5: with the engine storing params at bf16, a fresh trainer
+    against a real checkpoint must initialize its f32 masters from the
+    ORIGINAL pre-cast weights, not from the engine's bf16-rounded copy —
+    and a resumed train state must still win over the checkpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbiont_tpu.models import convert as convert_mod
+
+    base = LmEngine(LmConfig(**dict(TINY, dtype="bfloat16")))
+    # a "checkpoint" whose f32 values differ from their bf16 rounding by
+    # less than one bf16 ulp (~0.4% relative): bf16(ck) == bf16 engine
+    # params, so only a pre-cast load can reproduce ck exactly
+    ck_params = jax.tree.map(
+        lambda a: (np.asarray(a, np.float32) * np.float32(1 + 1e-4)
+                   if jnp.issubdtype(a.dtype, jnp.floating)
+                   else np.asarray(a)), base.params)
+    model_cfg = base.model_cfg
+    calls = {"n": 0}
+
+    def fake_load(model_dir):
+        calls["n"] += 1
+        return ck_params, model_cfg
+
+    monkeypatch.setattr(convert_mod, "load_gpt_model", fake_load)
+
+    lm = LmEngine(LmConfig(**dict(TINY, dtype="bfloat16",
+                                  model_dir=str(tmp_path / "ck"))))
+    assert calls["n"] == 1  # the engine itself booted from the checkpoint
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2)
+    assert calls["n"] == 2  # the trainer re-read the pre-cast weights
+
+    ck_leaves = jax.tree.leaves(ck_params)
+    master_leaves = jax.tree.leaves(trainer.state.params)
+    engine_leaves = jax.tree.leaves(lm.params)
+    float_triples = [
+        (c, m, e) for c, m, e in zip(ck_leaves, master_leaves, engine_leaves)
+        if jnp.issubdtype(np.asarray(c).dtype, np.floating)]
+    assert float_triples
+    for ck, master, engine in float_triples:
+        assert master.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(master), np.asarray(ck))
+        # and the masters are NOT just the widened bf16 engine params
+        widened = np.asarray(engine, np.float32)
+        if not np.allclose(np.asarray(ck), widened, rtol=0, atol=0):
+            break
+    else:
+        pytest.fail("checkpoint indistinguishable from bf16 params — "
+                    "the test corpus lost its sub-ulp perturbation")
+
+    # a saved train state still wins over the checkpoint (resume path)
+    state_path = str(tmp_path / "lm_train")
+    trainer_saving = OnlineLmTrainer(lm, seq_len=16, batch_size=2,
+                                     state_path=state_path)
+    trainer_saving.train_on_texts(CORPUS, steps=1)
+    steps = trainer_saving.stats["train_steps"]
+    calls_before = calls["n"]
+    resumed = OnlineLmTrainer(lm, seq_len=16, batch_size=2,
+                              state_path=state_path)
+    assert resumed.stats["train_steps"] == steps
+    assert calls["n"] == calls_before  # resume never re-reads the checkpoint
+
+
+def test_masters_fall_back_to_engine_params_on_load_failure(monkeypatch,
+                                                            tmp_path):
+    """A vanished/corrupt checkpoint dir must degrade to the old behavior
+    (widened engine params) with a warning, never crash the service."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbiont_tpu.models import convert as convert_mod
+
+    lm = LmEngine(LmConfig(**dict(TINY, dtype="bfloat16")))
+    lm.config.model_dir = str(tmp_path / "gone")  # dir does not exist
+
+    def boom(model_dir):
+        raise FileNotFoundError(model_dir)
+
+    monkeypatch.setattr(convert_mod, "load_gpt_model", boom)
+    trainer = OnlineLmTrainer(lm, seq_len=16, batch_size=2)
+    for a, b in zip(jax.tree.leaves(trainer.state.params),
+                    jax.tree.leaves(lm.params)):
+        if jnp.issubdtype(np.asarray(b).dtype, jnp.floating):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b, np.float32))
+
+
 def test_runner_wires_trainer_when_enabled(tmp_path):
     """SymbiontStack builds the OnlineLmTrainer from LmConfig.ingest_train
     and hands it to the text generator service."""

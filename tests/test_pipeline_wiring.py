@@ -122,6 +122,65 @@ def test_pipeline_subjects_have_consumers_and_producers():
         assert name in consumers, f"pipeline subject {value} has no consumer"
 
 
+# --------------------------------------------------------------------------
+# Data-plane guard: the binary tensor-frame plane (schema/frames) exists so
+# bulk floats never pass through per-float Python conversion on the message
+# hot path. A `[float(x) for x in ...]` list comprehension inside services/
+# is exactly the regression that rebuilt the old wall — ban it statically,
+# with an allowlist for the small query-reply paths where a handful of
+# floats is not a data plane.
+
+# (file relative to repo root, enclosing function) pairs that may keep a
+# per-float conversion: bounded, latency-path payloads (top-k scores).
+# Anything new showing up here is the hot path regressing to JSON float
+# lists — route it through schema/frames (or ndarray.tolist()) instead.
+FLOAT_LIST_ALLOWED = {
+    ("symbiont_tpu/services/engine_service.py",
+     "EngineService._rerank.op"),
+}
+
+_FLOAT_LIST = re.compile(r"\[\s*float\(")
+_SCOPE = re.compile(r"^(\s*)(?:(?:async\s+)?def|class)\s+(\w+)")
+
+
+def _float_list_sites():
+    """(file, dotted-scope-path) for every `[float(` in services/ — an
+    indent stack qualifies nested scopes (`EngineService._rerank.op`), so
+    allowlist entries name one exact site, not every handler's inner
+    `op`."""
+    sites = set()
+    for f in sorted((REPO / "symbiont_tpu" / "services").glob("*.py")):
+        stack: list = []  # (indent, name)
+        for line in f.read_text().splitlines():
+            m = _SCOPE.match(line)
+            if m:
+                indent = len(m.group(1))
+                while stack and stack[-1][0] >= indent:
+                    stack.pop()
+                stack.append((indent, m.group(2)))
+            if _FLOAT_LIST.search(line):
+                path = ".".join(n for _, n in stack) or "<module>"
+                sites.add((str(f.relative_to(REPO)), path))
+    return sites
+
+
+def test_no_per_float_conversion_on_message_paths():
+    sites = _float_list_sites()
+    offenders = sites - FLOAT_LIST_ALLOWED
+    assert not offenders, (
+        "per-float Python conversion on a services/ message path — the "
+        "serialization wall the tensor-frame data plane removed "
+        "(docs/PERF.md 'data plane' section). Use schema/frames or "
+        f"ndarray.tolist() instead: {sorted(offenders)}")
+
+
+def test_float_list_allowlist_entries_still_exist():
+    """A stale allowlist entry means the conversion was removed — prune it
+    so the guard stays tight."""
+    stale = FLOAT_LIST_ALLOWED - _float_list_sites()
+    assert not stale, f"FLOAT_LIST_ALLOWED entries no longer present: {stale}"
+
+
 def test_scanner_sees_known_ground_truth():
     """Self-check so the scanner can't silently rot into vacuous passes:
     a few known call sites must classify as expected."""
