@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Export the most recent flight-recorder trace as a Perfetto-loadable
+# Chrome Trace Format file (docs/OBSERVABILITY.md, obs/chrome_trace.py).
+#
+#   ./scripts/trace_export_demo.sh [host:port] [out.json]
+#
+# Picks the newest trace from GET /api/traces/recent (errored-first,
+# slowest-first triage order), writes its export, and prints the one-line
+# critical-path verdict alongside. Open the file at https://ui.perfetto.dev
+# or chrome://tracing.
+set -euo pipefail
+API="${1:-localhost:8080}"
+OUT="${2:-trace.json}"
+
+TRACE_ID=$(curl -fsS "http://${API}/api/traces/recent" \
+  | python3 -c 'import json,sys; t=json.load(sys.stdin)["traces"]; print(t[0]["trace_id"]) if t else sys.exit("no traces recorded yet — drive some traffic first")')
+
+curl -fsS "http://${API}/api/traces/${TRACE_ID}/export?fmt=chrome" > "${OUT}"
+echo "wrote ${OUT} (trace ${TRACE_ID}) — open it at https://ui.perfetto.dev"
+curl -fsS "http://${API}/api/traces/${TRACE_ID}/critical_path" \
+  | python3 -c 'import json,sys; print("verdict:", json.load(sys.stdin)["verdict"])'

@@ -190,9 +190,10 @@ def main(argv=None) -> int:
     import jax
 
     # tier implementations register themselves on import; import order IS
-    # run order: serialization micro-tier (host-only, fastest), policy A/B,
-    # compute MFU, engine plane, decode, full stack, then the
+    # run order: obs + serialization micro-tiers (host-only, fastest),
+    # policy A/B, compute MFU, engine plane, decode, full stack, then the
     # fault-injection (loss-under-fault) tier
+    from symbiont_tpu.bench import obs  # noqa: F401
     from symbiont_tpu.bench import serialization  # noqa: F401
     from symbiont_tpu.bench import compute  # noqa: F401
     from symbiont_tpu.bench import engine_plane  # noqa: F401

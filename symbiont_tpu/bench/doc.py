@@ -15,6 +15,8 @@ presented as a different access pattern, not a ceiling.
 
 from __future__ import annotations
 
+import re
+
 from symbiont_tpu.bench import roofline
 
 # decode bench shapes (must match symbiont_tpu/bench/decode.py)
@@ -365,6 +367,8 @@ vs the JSON-equivalent bytes they displaced, plus encode/decode seconds.
 {ser_measured}
 """
 
+    attribution_section = _render_attribution(r, f)
+
     mfu768 = ""
     if "mfu_compute_only_768_pct" in f:
         mfu768 = (
@@ -473,7 +477,7 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{frames_section}{e2e_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{frames_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.
@@ -519,6 +523,58 @@ co-located.
   larger of a family floor and 1.5× the baseline's archived in-run spread;
   tunnel-bound fields are never gated) — `symbiont_tpu/bench/archive.py`.
 """
+
+
+_STAGE_KEY = re.compile(r"^(e2e_stage_(ingest|generate)_(.+)_pct)$")
+
+
+def _render_attribution(r: dict, f: dict) -> str:
+    """The "where the time goes" section, rendered from the e2e tier's
+    archived `e2e_stage_<pipeline>_<hop>_pct` fields (obs/critical_path.py
+    blocking-chain self-time shares, averaged over the run's traces). Like
+    every other section: numbers only ever come from the archive."""
+    matches = sorted(
+        (m for k in r if (m := _STAGE_KEY.match(k))
+         and isinstance(r[k], (int, float))),
+        key=lambda m: (m.group(2), -r[m.group(1)]))
+    header = """## Where the time goes (critical-path attribution)
+
+The attribution plane (`symbiont_tpu/obs/critical_path.py`) computes, for
+every recorded trace, the **blocking chain** — the parent-linked path from
+the root span to the last-ending descendant — and each hop's **self-time**
+(duration minus the merged coverage of its children). The e2e tier
+aggregates those shares across its waves' traces and archives them as
+`e2e_stage_*_pct`; live, the same report is one request away:
+`GET /api/traces/<id>/critical_path` (dominant-hop verdict included) and
+`GET /api/traces/<id>/export?fmt=chrome` renders the same trace as a
+Perfetto-loadable timeline (`scripts/trace_export_demo.sh`).
+
+"""
+    if not matches:
+        return header + (
+            "This archive predates the attribution plane (or its e2e tier "
+            "did not run), so the per-hop share table will appear from the "
+            "next full `python bench.py` run. The `gap` row, when present, "
+            "is e2e time NO recorded span claims — bus queueing, "
+            "scheduling, and hops through the span-less native workers.\n\n")
+    rows = []
+    for m in matches:
+        key, pipeline, hop = m.group(1), m.group(2), m.group(3)
+        what = ("e2e time no recorded span claims (bus queueing, "
+                "scheduling, span-less native hops)" if hop == "gap" else
+                f"blocking-chain self-time share of the {pipeline} trace")
+        rows.append(f"| `{key}` | {what} | **{f[key]} %** |")
+    counts = ", ".join(
+        f"{p}: {f[k]} traces" for p, k in
+        (("ingest", "e2e_stage_ingest_traces"),
+         ("generate", "e2e_stage_generate_traces")) if k in f)
+    return header + (
+        "| JSON field | What | Share of e2e |\n|---|---|---|\n"
+        + "\n".join(rows)
+        + f"\n\nAveraged over the archived run's traces ({counts}). "
+        "Shares are per-hop self-times on the blocking chain, so each "
+        "pipeline's rows plus its `gap` row sum to ≈100% — parallel "
+        "fan-out off the chain is deliberately not double-counted.\n\n")
 
 
 def _render_roofline(r: dict, f: dict, rng) -> str:

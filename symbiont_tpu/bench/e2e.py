@@ -422,6 +422,41 @@ def tier_e2e(results: dict, ctx) -> None:
             f"{results['e2e_first_delta_ms_max']:.0f}] (median of "
             f"{len(deltas)}, full HTTP→bus→decode→SSE path)")
         sse_stop.set()
+        # where the time goes (obs/critical_path.py): aggregate per-hop
+        # self-time shares over every trace the Python-side flight recorder
+        # captured during the waves, grouped by root span name. In THIS
+        # tier the HTTP/scrape hops run in C++ (span-less), so the recorded
+        # roots are the engine-plane handler spans — still the accelerator
+        # path the attribution is for. Archived flat as
+        # `e2e_stage_<pipeline>_<hop>_pct` (docs/PERF.md renders the
+        # table) and exported as stage.* gauges riding metrics_snapshot.
+        from symbiont_tpu.obs import critical_path as _cp
+        from symbiont_tpu.obs.trace_store import trace_store as _ts
+
+        attr = _cp.aggregate_stage_attribution(_ts)
+        _cp.export_stage_gauges(attr)
+        for pipeline, root_candidates in (
+                ("ingest", ("api.submit_url", "engine.handle")),
+                ("generate", ("api.generate_text",
+                              "text_generator.handle"))):
+            root = next((r for r in root_candidates if r in attr), None)
+            if root is None:
+                log(f"e2e stage attribution: no recorded traces rooted at "
+                    f"any of {root_candidates} for {pipeline}")
+                continue
+            agg = attr[root]
+            for hop, frac in agg["stages"].items():
+                results[f"e2e_stage_{pipeline}_{_cp.safe_key(hop)}_pct"] = \
+                    round(100.0 * frac, 1)
+            results[f"e2e_stage_{pipeline}_gap_pct"] = round(
+                100.0 * agg["gap_frac"], 1)
+            results[f"e2e_stage_{pipeline}_traces"] = agg["count"]
+            log(f"e2e stage attribution ({pipeline}, root {root}, "
+                f"{agg['count']} traces): " + ", ".join(
+                    f"{hop} {100 * frac:.1f}%"
+                    for hop, frac in sorted(agg["stages"].items(),
+                                            key=lambda kv: -kv[1])))
+
         # internal-gauge snapshot INTO the archive: BENCH_*.json carried
         # only external timings before — now the engine-plane view (batcher
         # fill ratios, padding waste, compile count/seconds, decode tok/s,

@@ -1,0 +1,125 @@
+"""Observability micro-tier: the telemetry hot path, gated like perf.
+
+The attribution plane rides INSIDE every request: each `span()` exit pays a
+histogram observe (reservoir insort + bucket count + exemplar), a
+flight-recorder ring append, and a structured log line; the critical-path
+endpoint walks and annotates a whole trace tree per call. None of that may
+silently fatten — a 10× regression in span exit cost is a pipeline-wide
+latency regression that no other tier attributes correctly (it shows up as
+"everything got slower"). This quick, host-only tier measures both on
+seeded synthetic load:
+
+- `obs_span_record_per_s` (primary, higher is better): `span()` context
+  exits per second — the full exit path (observe + record + log format)
+  against the process-global registry/ring, the way every handler pays it;
+- `obs_critical_path_512_ms` (primary, lower is better): one
+  `trace_tree` + `critical_path` compute over a 512-span synthetic trace
+  (8 services × 64 spans, fan-out 4), the `GET …/critical_path` endpoint's
+  whole cost at flight-recorder scale.
+
+Both are median-of-5 with in-run min/max (host-CPU timings on the one
+shared core are noisy; the gate's allowed delta widens with the archived
+spread).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from symbiont_tpu.bench import stats
+from symbiont_tpu.bench.tiers import register
+from symbiont_tpu.bench.workload import log
+
+N_SPANS = 2000       # span exits per throughput sample
+TRACE_SPANS = 512    # synthetic trace size for the critical-path sample
+REPEATS = 5
+
+
+def build_synthetic_trace(store, trace_id: str = "obs-bench",
+                          n_spans: int = TRACE_SPANS) -> str:
+    """A deterministic ~n_spans-span trace shaped like a real ingest fan-out:
+    a root, a backbone chain of service hops, each sprouting groups of 4
+    overlapping children. No clocks, no randomness — starts/durations are
+    arithmetic in fake milliseconds."""
+    from symbiont_tpu.obs.trace_store import SpanRecord
+
+    services = ("api", "perception", "preprocessing", "vector_memory",
+                "knowledge_graph", "engine", "text_generator", "bus")
+    store.record(SpanRecord(trace_id, "s0", None, "api.submit_url",
+                            1000.0, 2.0, "ok"))
+    made, parent = 1, "s0"
+    start = 1000.0
+    while made < n_spans:
+        svc = services[made % len(services)]
+        sid = f"s{made}"
+        start += 1.0
+        store.record(SpanRecord(trace_id, sid, parent, f"{svc}.handle",
+                                start, 8.0, "ok"))
+        made += 1
+        for j in range(4):
+            if made >= n_spans:
+                break
+            store.record(SpanRecord(
+                trace_id, f"s{made}", sid, f"{svc}.op{j}",
+                start + 0.5 + 0.25 * j, 2.0, "ok"))
+            made += 1
+        parent = sid
+    return trace_id
+
+
+@register("obs", primary_metrics=("obs_span_record_per_s",
+                                  "obs_critical_path_512_ms"), quick=True)
+def tier_obs(results: dict, ctx) -> None:
+    from symbiont_tpu.obs import critical_path
+    from symbiont_tpu.obs.trace_store import TraceStore
+    from symbiont_tpu.utils.telemetry import span
+
+    # ---- span-exit throughput: the real global path (registry + ring +
+    # log formatting), with the log handler muted so the sample measures
+    # telemetry cost, not the bench harness's stderr
+    tel_log = logging.getLogger("symbiont.trace")
+    prev_disabled = tel_log.disabled
+    tel_log.disabled = True
+    try:
+        def one_sample() -> float:
+            t0 = time.perf_counter()
+            with span("obs_bench.root", None) as root:
+                ctx_headers = root.headers
+                for _ in range(N_SPANS - 1):
+                    with span("obs_bench.hop", ctx_headers, doc="x"):
+                        pass
+            return N_SPANS / (time.perf_counter() - t0)
+
+        one_sample()  # warm allocator / logging guards
+        stats.record(results, "obs_span_record_per_s",
+                     [one_sample() for _ in range(REPEATS)], digits=0)
+    finally:
+        tel_log.disabled = prev_disabled
+
+    # ---- critical-path compute on a 512-span synthetic trace, private
+    # store (the measurement must not depend on what the suite left in the
+    # process-global ring)
+    store = TraceStore(capacity=TRACE_SPANS + 8)
+    tid = build_synthetic_trace(store)
+    report = critical_path.compute(store, tid)
+    assert report is not None and report["span_count"] == TRACE_SPANS, report
+
+    def one_cp_ms() -> float:
+        t0 = time.perf_counter()
+        out = critical_path.compute(store, tid)
+        assert out["dominant"] is not None
+        return (time.perf_counter() - t0) * 1000.0
+
+    one_cp_ms()
+    stats.record(results, "obs_critical_path_512_ms",
+                 [one_cp_ms() for _ in range(REPEATS)], digits=2)
+    results["obs_span_overhead_us"] = round(
+        1e6 / results["obs_span_record_per_s"], 1)
+    log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
+        f"({results['obs_span_overhead_us']} µs/span) "
+        f"[{results['obs_span_record_per_s_min']:.0f}–"
+        f"{results['obs_span_record_per_s_max']:.0f}]; critical path over "
+        f"{TRACE_SPANS} spans {results['obs_critical_path_512_ms']:.2f} ms "
+        f"[{results['obs_critical_path_512_ms_min']:.2f}–"
+        f"{results['obs_critical_path_512_ms_max']:.2f}]")

@@ -262,12 +262,23 @@ class ObsConfig:
     slo_interval_s: float = 10.0
     # "span_name=p99_ms" entries evaluated against span.<name>.ms histograms
     slo_p99_ms: List[str] = field(default_factory=list)
+    # cumulative-bucket upper bounds (`le`, in ms) for the span-duration
+    # histogram family on /metrics; empty keeps
+    # telemetry.DEFAULT_BUCKET_BOUNDS_MS. Applied by the runner at boot —
+    # bounds are fixed per histogram at first observation.
+    histogram_buckets_ms: List[float] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
             raise ValueError("obs.trace_capacity must be >= 1")
         if self.slo_interval_s <= 0:
             raise ValueError("obs.slo_interval_s must be positive")
+        if self.histogram_buckets_ms:
+            b = self.histogram_buckets_ms
+            if any(x <= 0 for x in b) or list(b) != sorted(set(b)):
+                raise ValueError(
+                    "obs.histogram_buckets_ms must be positive and "
+                    "strictly increasing")
         # malformed SLO entries fail at boot, not silently never fire
         from symbiont_tpu.obs.watchdog import parse_thresholds
 
@@ -398,7 +409,7 @@ def _coerce(tp: Any, raw: str) -> Any:
         return int(raw)
     if tp is float or tp == Optional[float]:
         return float(raw)
-    if tp in (List[int], List[str], Optional[List[int]]):
+    if tp in (List[int], List[str], List[float], Optional[List[int]]):
         parsed = json.loads(raw)
         return parsed
     return raw

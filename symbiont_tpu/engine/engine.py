@@ -315,7 +315,7 @@ class TpuEngine:
         else:
             raise ValueError(kind)
 
-        jitted = self._time_first_call(jax.jit(fn))
+        jitted = self._time_first_call(jax.jit(fn), key)
         with self._lock:
             # two threads can race the cold-miss check above; the loser
             # discards its wrapper and reuses the winner's, so one shape
@@ -329,14 +329,18 @@ class TpuEngine:
         self._bump(compiles=1)
         return jitted
 
-    def _time_first_call(self, jitted: Callable) -> Callable:
+    def _time_first_call(self, jitted: Callable, key=None) -> Callable:
         """Account the executable's first-call wall time as compile seconds
         (XLA compiles synchronously inside the first dispatch; subsequent
         calls skip straight to the async dispatch). The flag flips BEFORE
         dispatch: two threads can race a cold executable (see the cache-miss
         note in _get_executable), and claiming first keeps the shared
         compile from being counted twice — a lost claim under-counts one
-        dispatch, never double-counts a multi-second compile."""
+        dispatch, never double-counts a multi-second compile.
+
+        Each claimed compile also lands on the flight-recorder timeline
+        (trace id "engine-compiles", obs/device.py): a recompile storm is a
+        row of spans in the Perfetto export, not just a counter that rose."""
         first = [True]
 
         def wrapper(*args):
@@ -344,8 +348,16 @@ class TpuEngine:
                 return jitted(*args)
             first[0] = False
             t0 = time.perf_counter()
+            start_s = time.time()
             out = jitted(*args)
-            self._bump(compile_s=time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._bump(compile_s=dt)
+            from symbiont_tpu.obs.device import record_compile_event
+
+            record_compile_event(
+                "engine.compile", dt, start_s=start_s,
+                signature=(f"{key[0]}[L={key[1]},B={key[2]}]"
+                           if key is not None else "unknown"))
             return out
 
         return wrapper

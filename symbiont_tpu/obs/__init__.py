@@ -1,17 +1,27 @@
-"""Observability subsystem: flight-recorder trace store, Prometheus text
-exposition, and the SLO watchdog.
+"""Observability subsystem: flight-recorder trace store, latency
+attribution, Chrome-trace export, Prometheus text exposition, device/host
+accounting, and the SLO watchdog.
 
 Layering (import order matters — keep it acyclic):
 
 - ``obs.trace_store`` has zero symbiont imports; ``utils/telemetry.span``
   writes into its process-global ring buffer on every span exit.
+- ``obs.critical_path`` computes the blocking chain / self-time
+  attribution of a recorded trace (``GET /api/traces/<id>/critical_path``)
+  and the fleet-wide ``stage.*`` attribution series.
+- ``obs.chrome_trace`` exports a recorded trace as Perfetto-loadable
+  Chrome Trace Format (``GET /api/traces/<id>/export?fmt=chrome``).
 - ``obs.prometheus`` reads the ``utils/telemetry.metrics`` registry and
-  renders Prometheus text exposition (served at ``GET /metrics``).
+  renders Prometheus text exposition (served at ``GET /metrics``;
+  OpenMetrics with trace-id exemplars when the scraper negotiates it).
+- ``obs.device`` registers device-memory and standard ``process_*``
+  gauges, and records compile-cache events onto the flight-recorder
+  timeline (trace id ``engine-compiles``).
 - ``obs.watchdog`` evaluates p99 SLO thresholds over the span histograms
   (started by the runner when ``obs.slo_p99_ms`` is configured).
 
 This package's ``__init__`` deliberately imports only the dependency-free
-trace store; import ``obs.prometheus`` / ``obs.watchdog`` as submodules.
+trace store; import the other planes as submodules.
 """
 
 from symbiont_tpu.obs.trace_store import SpanRecord, TraceStore, trace_store

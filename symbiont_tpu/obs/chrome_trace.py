@@ -1,0 +1,85 @@
+"""Chrome Trace Format export of a recorded trace (Perfetto-loadable).
+
+The flight recorder's JSON tree is greppable; a TIMELINE is how humans find
+the 400 ms hole between two hops. This module renders any recorded trace as
+Chrome Trace Format JSON (the "JSON Array/Object format" both
+chrome://tracing and https://ui.perfetto.dev open directly):
+
+- one track (pid 1, one tid) per SERVICE — the first dot-segment of the
+  span name, same convention the Prometheus service label uses;
+- every span is a complete event (``ph: "X"``, microsecond ``ts``/``dur``)
+  carrying span/parent/trace ids and the span's recorded fields in
+  ``args``;
+- error spans are flagged: ``args.status == "error"`` plus a
+  ``cname: "terrible"`` color hint (red in chrome://tracing; Perfetto
+  ignores unknown cnames gracefully).
+
+Served at ``GET /api/traces/<id>/export?fmt=chrome`` (services/api.py);
+``scripts/trace_export_demo.sh`` is the one-liner. The exact output shape
+is pinned by a golden file (tests/goldens/chrome_trace_golden.json) — a
+format drift breaks the golden test, not an operator's tooling.
+
+Determinism contract (what the golden test relies on): events are ordered
+metadata first (process name, then thread names in tid order), then spans
+by (ts, span_id); tids are assigned to services in first-seen span-start
+order. No clocks, no randomness — the export is a pure function of the
+recorded spans.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from symbiont_tpu.obs.trace_store import SpanRecord
+
+_PID = 1
+
+
+def service_of(span_name: str) -> str:
+    return span_name.split(".", 1)[0]
+
+
+def export_spans(trace_id: str, spans: Sequence[SpanRecord]) -> dict:
+    """Render one trace's SpanRecords as a Chrome Trace Format object."""
+    ordered = sorted(spans, key=lambda r: (r.start_s, r.span_id))
+    tids: Dict[str, int] = {}
+    for r in ordered:
+        tids.setdefault(service_of(r.name), len(tids) + 1)
+
+    events: List[dict] = [{
+        "ph": "M", "name": "process_name", "pid": _PID,
+        "args": {"name": "symbiont flight recorder"},
+    }]
+    for svc, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        events.append({"ph": "M", "name": "thread_name", "pid": _PID,
+                       "tid": tid, "args": {"name": svc}})
+    for r in ordered:
+        ev = {
+            "ph": "X",
+            "name": r.name,
+            "cat": service_of(r.name),
+            "pid": _PID,
+            "tid": tids[service_of(r.name)],
+            "ts": round(r.start_s * 1e6, 1),       # µs, Chrome's unit
+            "dur": round(r.duration_ms * 1e3, 1),  # µs
+            "args": {
+                "trace_id": r.trace_id,
+                "span_id": r.span_id,
+                "parent_id": r.parent_id,
+                "status": r.status,
+                **r.fields,
+            },
+        }
+        if r.status != "ok":
+            ev["cname"] = "terrible"  # chrome://tracing red; Perfetto: noop
+        events.append(ev)
+    return {
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": trace_id,
+            "span_count": len(ordered),
+            "error_count": sum(1 for r in ordered if r.status != "ok"),
+            "generator": "symbiont_tpu/obs/chrome_trace.py",
+        },
+        "traceEvents": events,
+    }

@@ -1,0 +1,149 @@
+"""Device- and host-plane accounting: the resources UNDER the spans.
+
+Three small planes, all registered into the ordinary metrics registry so
+they ride ``GET /metrics`` with everything else:
+
+- **device memory** (``register_device_gauges``): per-device callback
+  gauges over ``jax.local_devices()[i].memory_stats()`` — bytes in use,
+  peak bytes, bytes limit. TPU/GPU runtimes expose these; CPU devices
+  return nothing, and this degrades to a clean no-op (no jax installed:
+  also a no-op). HBM pressure is the invisible half of every OOM
+  post-mortem; now it is a scrape away.
+- **compile-cache events** (``record_compile_event``): every first-call
+  XLA compile the engine accounts (engine/engine.py ``_time_first_call``)
+  also lands as a SpanRecord under the well-known trace id
+  ``engine-compiles`` — so a recompile storm shows up ON THE TIMELINE
+  (``GET /api/traces/engine-compiles/export?fmt=chrome``), not just as a
+  counter that rose.
+- **host process** (``register_process_gauges``): the standard
+  ``process_*`` family every scrape-based alert expects — RSS, virtual
+  size, open FDs, start time, uptime — read from ``/proc/self`` with a
+  platform guard (non-Linux: no-op, returns False). These render WITHOUT
+  the ``symbiont_`` prefix (obs/prometheus.py) because their names are a
+  cross-ecosystem convention, not ours.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from symbiont_tpu.obs.trace_store import SpanRecord, trace_store
+from symbiont_tpu.utils.ids import generate_uuid
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+log = logging.getLogger(__name__)
+
+# the well-known flight-recorder trace ids for process-lifetime event
+# streams (they have no request to belong to)
+COMPILE_TRACE_ID = "engine-compiles"
+PROFILE_TRACE_ID = "profiler"
+
+_DEVICE_SERIES = (
+    ("device.bytes_in_use", "bytes_in_use"),
+    ("device.peak_bytes_in_use", "peak_bytes_in_use"),
+    ("device.bytes_limit", "bytes_limit"),
+)
+
+
+def record_compile_event(name: str, duration_s: float,
+                         start_s: Optional[float] = None, **fields) -> None:
+    """Append one compile to the ``engine-compiles`` timeline trace."""
+    start = start_s if start_s is not None else time.time() - duration_s
+    trace_store.record(SpanRecord(
+        trace_id=COMPILE_TRACE_ID, span_id=generate_uuid(), parent_id=None,
+        name=name, start_s=start, duration_ms=duration_s * 1000.0,
+        status="ok", fields={k: str(v) for k, v in fields.items()}))
+
+
+def register_device_gauges(registry: Optional[Metrics] = None) -> int:
+    """Register memory gauges for every local device that reports memory
+    stats. Returns how many devices registered (0 on CPU-only or no-jax —
+    graceful, never raises: this runs on every runner boot)."""
+    registry = registry or _global_metrics
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # no jax, or backend init failed
+        log.debug("device gauges unavailable: %s", e)
+        return 0
+    n = 0
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue  # CPU (and some backends): no memory accounting
+        labels = {"device": str(i), "platform": str(dev.platform)}
+
+        def reader(dev=dev, key=None):
+            def fn():
+                # a RAISE here is skipped-for-this-scrape by the registry
+                # (telemetry._eval_gauge_fns) — deliberately not caught: a
+                # transient backend hiccup must not return None, which is
+                # the PERMANENT-retirement signal. Only a backend that
+                # stops reporting stats altogether retires the gauge.
+                s = dev.memory_stats()
+                return None if not s else s.get(key)
+            return fn
+
+        for series, key in _DEVICE_SERIES:
+            if key in stats:
+                registry.register_gauge(series, reader(dev=dev, key=key),
+                                        labels=labels)
+        n += 1
+    return n
+
+
+def register_process_gauges(registry: Optional[Metrics] = None) -> bool:
+    """Standard ``process_*`` gauges from ``/proc/self``. Platform-guarded:
+    returns False (registering nothing) where /proc is absent."""
+    registry = registry or _global_metrics
+    if not (os.path.isdir("/proc/self") and os.path.exists("/proc/stat")):
+        return False
+    page = os.sysconf("SC_PAGE_SIZE")
+    ticks = os.sysconf("SC_CLK_TCK")
+
+    def _statm_field(idx: int) -> Optional[float]:
+        try:
+            with open("/proc/self/statm") as fh:
+                return float(fh.read().split()[idx]) * page
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def open_fds() -> Optional[float]:
+        try:
+            return float(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            return None
+
+    def start_time_s() -> Optional[float]:
+        """Process start as epoch seconds: kernel boot time (btime) plus
+        the start offset from /proc/self/stat (field 22, counted after the
+        parenthesised comm field — comm may itself contain spaces)."""
+        try:
+            with open("/proc/stat") as fh:
+                btime = next(float(ln.split()[1]) for ln in fh
+                             if ln.startswith("btime"))
+            with open("/proc/self/stat") as fh:
+                after_comm = fh.read().rsplit(")", 1)[1].split()
+            return btime + float(after_comm[19]) / ticks
+        except (OSError, ValueError, IndexError, StopIteration):
+            return None
+
+    start = start_time_s()
+    registry.register_gauge("process.resident_memory_bytes",
+                            lambda: _statm_field(1))
+    registry.register_gauge("process.virtual_memory_bytes",
+                            lambda: _statm_field(0))
+    registry.register_gauge("process.open_fds", open_fds)
+    if start is not None:
+        registry.register_gauge("process.start_time_seconds",
+                                lambda: start)
+        registry.register_gauge("process.uptime_seconds",
+                                lambda: time.time() - start)
+    return True

@@ -9,6 +9,20 @@ text exposition for `GET /metrics`:
 - histograms → TYPE summary: `{quantile="0.5|0.95|0.99"}` series plus
   `_sum`/`_count`, and exact-extreme companions `_min`/`_max` gauges (the
   reservoir decimates; min/max are tracked exactly — see _Histogram).
+- span-duration series ADDITIONALLY render as a real Prometheus histogram
+  family `symbiont_span_duration_ms_hist` (`_bucket{le=...}` cumulative
+  series from the exact per-bucket counts, plus `_sum`/`_count`) — summary
+  quantiles cannot be aggregated across processes, `le` buckets can, so
+  fleet p99s come from the `_hist` family and the summary stays for
+  single-process compatibility. Bucket bounds: `ObsConfig
+  .histogram_buckets_ms` (default telemetry.DEFAULT_BUCKET_BOUNDS_MS).
+
+Exemplars: when the scraper negotiates OpenMetrics (`Accept:
+application/openmetrics-text`, or `render(..., openmetrics=True)`),
+`_hist_bucket` samples carry the latest trace-id exemplar seen in that
+bucket (`... # {trace_id="..."} <value> <ts>`) — a bad bucket links to a
+concrete flight-recorder trace (`GET /api/traces/<id>`). The default
+0.0.4 rendering omits them (that format has no exemplar syntax).
 
 Label conventions (docs/OBSERVABILITY.md): explicitly-labeled series pass
 their labels through; legacy dot-concatenated names are split so the first
@@ -16,7 +30,10 @@ segment becomes a `service` label instead of being fused into the metric
 name — `perception.scrape_failed` → `symbiont_scrape_failed_total
 {service="perception"}`. Span series get a `span` label carrying the full
 span name plus the service label: `span.api.search.ms` →
-`symbiont_span_duration_ms{service="api",span="api.search"}`.
+`symbiont_span_duration_ms{service="api",span="api.search"}`. The
+`process.*` host gauges (obs/device.py) render WITHOUT the `symbiont_`
+prefix — `process_resident_memory_bytes` etc. are a cross-ecosystem
+convention every scrape-based alert rule expects verbatim.
 """
 
 from __future__ import annotations
@@ -45,6 +62,10 @@ def _metric_name(raw: str, suffix: str = "") -> str:
     name = _INVALID_NAME_CHARS.sub("_", raw).strip("_") or "unnamed"
     if name[0].isdigit():
         name = "_" + name
+    if name.startswith("process_"):
+        # the standard process_* family (obs/device.py) keeps its
+        # ecosystem-wide names unprefixed
+        return f"{name}{suffix}"
     return f"{_NAME_PREFIX}{name}{suffix}"
 
 
@@ -124,24 +145,60 @@ def _span_labels(span_name: str, labels: Dict[str, str]) -> Dict[str, str]:
     return out
 
 
-def render(registry: Optional[Metrics] = None) -> str:
-    """Render the registry as Prometheus text exposition."""
+def _fmt_le(bound) -> str:
+    """Prometheus `le` label values: decimal floats, `+Inf` terminal."""
+    return bound if bound == "+Inf" else repr(float(bound))
+
+
+def _exemplar_suffix(ex) -> str:
+    """OpenMetrics exemplar: ` # {label="v"} value timestamp` (None → "")."""
+    if ex is None:
+        return ""
+    value, labels, ts = ex
+    inner = ",".join(f'{_label_name(k)}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f" # {{{inner}}} {_fmt_value(value)} {ts:.3f}"
+
+
+CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = ("application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
+
+
+def render(registry: Optional[Metrics] = None,
+           openmetrics: bool = False) -> str:
+    """Render the registry as Prometheus text exposition. With
+    ``openmetrics=True``, histogram bucket samples carry exemplars and the
+    output terminates with ``# EOF`` (serve it under
+    CONTENT_TYPE_OPENMETRICS; the family naming stays shared between the
+    two renderings)."""
     ex = (registry or _global_metrics).export()
     families: Dict[str, _Family] = {}
+
+    # OpenMetrics counter naming: the FAMILY (TYPE/HELP) name must not end
+    # in the reserved `_total` suffix — samples carry it, the family does
+    # not (the reference parser rejects "clashing names" otherwise, and a
+    # failed parse loses the WHOLE scrape). 0.0.4 keeps the historical
+    # family-name-includes-_total rendering byte-for-byte.
+    def counter_family(base_name: str, help_text: str) -> Tuple[_Family, str]:
+        sample_name = f"{base_name}_total"
+        fam = _family(families,
+                      base_name if openmetrics else sample_name,
+                      "counter", help_text)
+        return fam, sample_name
 
     for raw, labels, value in ex["counters"]:
         sp = _span_series(raw)
         if sp is not None and sp[0] == "errors":
-            fam = _family(families, _metric_name("span_errors", "_total"),
-                          "counter", "Errored span exits by span name.")
+            fam, sample = counter_family(_metric_name("span_errors"),
+                                         "Errored span exits by span name.")
             fam.samples.append(
-                f"{fam.name}{_fmt_labels(_span_labels(sp[1], labels))} "
+                f"{sample}{_fmt_labels(_span_labels(sp[1], labels))} "
                 f"{_fmt_value(value)}")
             continue
         name, labels = _split_legacy(raw, labels)
-        fam = _family(families, _metric_name(name, "_total"), "counter",
-                      f"Counter {raw}.")
-        fam.samples.append(f"{fam.name}{_fmt_labels(labels)} "
+        fam, sample = counter_family(_metric_name(name), f"Counter {raw}.")
+        fam.samples.append(f"{sample}{_fmt_labels(labels)} "
                            f"{_fmt_value(value)}")
 
     for raw, labels, value in ex["gauges"]:
@@ -156,6 +213,27 @@ def render(registry: Optional[Metrics] = None) -> str:
         if sp is not None and sp[0] == "ms":
             base, labels = "span_duration_ms", _span_labels(sp[1], labels)
             help_text = "Span duration in milliseconds by span name."
+            # the REAL histogram family rides alongside the summary:
+            # cumulative `le` buckets aggregate honestly across processes
+            # (quantile labels never did), exemplars link buckets to traces
+            hfam = _family(families, _metric_name(base, "_hist"),
+                           "histogram",
+                           "Span duration in milliseconds by span name "
+                           "(cumulative le buckets; fleet-aggregatable).")
+            exemplars = summary.get("exemplars") or []
+            for i, (bound, cum) in enumerate(summary.get("buckets", [])):
+                blabels = {**labels, "le": _fmt_le(bound)}
+                suffix = (_exemplar_suffix(exemplars[i])
+                          if openmetrics and i < len(exemplars) else "")
+                hfam.samples.append(
+                    f"{hfam.name}_bucket{_fmt_labels(blabels)} "
+                    f"{_fmt_value(cum)}{suffix}")
+            hfam.samples.append(
+                f"{hfam.name}_sum{_fmt_labels(labels)} "
+                f"{_fmt_value(summary['sum'])}")
+            hfam.samples.append(
+                f"{hfam.name}_count{_fmt_labels(labels)} "
+                f"{_fmt_value(summary['count'])}")
         else:
             base, labels = _split_legacy(raw, labels)
             help_text = f"Distribution of {raw}."
@@ -165,7 +243,7 @@ def render(registry: Optional[Metrics] = None) -> str:
             fam.samples.append(f"{fam.name}{_fmt_labels(qlabels)} "
                                f"{_fmt_value(summary[stat])}")
         fam.samples.append(f"{fam.name}_sum{_fmt_labels(labels)} "
-                           f"{_fmt_value(summary['mean'] * summary['count'])}")
+                           f"{_fmt_value(summary['sum'])}")
         fam.samples.append(f"{fam.name}_count{_fmt_labels(labels)} "
                            f"{_fmt_value(summary['count'])}")
         for stat in ("min", "max"):
@@ -182,4 +260,6 @@ def render(registry: Optional[Metrics] = None) -> str:
         lines.append(f"# HELP {fam.name} {fam.help}")
         lines.append(f"# TYPE {fam.name} {fam.kind}")
         lines.extend(fam.samples)
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + ("\n" if lines else "")

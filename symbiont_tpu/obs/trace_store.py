@@ -90,53 +90,28 @@ class TraceStore:
         with self._lock:
             return [r for r in self._ring if r.trace_id == trace_id]
 
-    def trace_tree(self, trace_id: str) -> Optional[dict]:
-        """Reassemble the parent-linked span tree for one trace.
+    def spans_by_trace(self) -> Dict[str, List[SpanRecord]]:
+        """ONE ring pass grouping every record by trace id (insertion
+        order preserved: oldest-recorded trace first). Bulk consumers
+        (recent(), the stage-attribution aggregator) use this instead of
+        per-trace spans_for() scans — O(traces × ring) rescans under the
+        record() lock would stall live span exits."""
+        with self._lock:
+            records = list(self._ring)
+        out: Dict[str, List[SpanRecord]] = {}
+        for r in records:
+            out.setdefault(r.trace_id, []).append(r)
+        return out
 
-        Spans whose parent was never recorded (evicted from the ring, or a
-        context hop through a process that doesn't record spans — e.g. the
-        native C++ workers) surface as top-level roots rather than being
-        dropped: a partial trace is still a trace. Returns None when the
-        ring holds nothing for this trace id."""
-        spans = self.spans_for(trace_id)
-        if not spans:
-            return None
-        spans.sort(key=lambda r: r.start_s)
-        ids = {r.span_id for r in spans}
-        nodes: Dict[str, dict] = {}
-        for r in spans:
-            node = r.to_dict()
-            node["children"] = []
-            # duplicate span ids cannot happen (uuid per span), but a
-            # defensive setdefault keeps the tree well-formed regardless
-            nodes.setdefault(r.span_id, node)
-        roots: List[dict] = []
-        for r in spans:
-            node = nodes[r.span_id]
-            if r.parent_id is not None and r.parent_id in ids:
-                nodes[r.parent_id]["children"].append(node)
-            else:
-                roots.append(node)
-        t0 = min(r.start_s for r in spans)
-        t1 = max(r.start_s + r.duration_ms / 1000.0 for r in spans)
-        return {
-            "trace_id": trace_id,
-            "span_count": len(spans),
-            "error_count": sum(1 for r in spans if r.status != "ok"),
-            "services": sorted({r.name.split(".", 1)[0] for r in spans}),
-            "duration_ms": round((t1 - t0) * 1000.0, 3),
-            "start_ms": round(t0 * 1000.0, 3),
-            "roots": roots,
-        }
+    def trace_tree(self, trace_id: str) -> Optional[dict]:
+        """Reassemble the parent-linked span tree for one trace. Returns
+        None when the ring holds nothing for this trace id."""
+        return tree_from_spans(trace_id, self.spans_for(trace_id))
 
     def recent(self, limit: int = 20) -> List[dict]:
         """Trace summaries for the flight-recorder window, errored traces
         first, then slowest-first — the triage order an operator wants."""
-        with self._lock:
-            records = list(self._ring)
-        by_trace: Dict[str, List[SpanRecord]] = {}
-        for r in records:
-            by_trace.setdefault(r.trace_id, []).append(r)
+        by_trace = self.spans_by_trace()
         summaries = []
         for trace_id, spans in by_trace.items():
             t0 = min(r.start_s for r in spans)
@@ -155,6 +130,47 @@ class TraceStore:
         summaries.sort(key=lambda s: (-(s["error_count"] > 0),
                                       -s["duration_ms"]))
         return summaries[: max(0, int(limit))]
+
+
+def tree_from_spans(trace_id: str,
+                    spans: List[SpanRecord]) -> Optional[dict]:
+    """Parent-linked span tree from one trace's records (sorts the given
+    list in place).
+
+    Spans whose parent was never recorded (evicted from the ring, or a
+    context hop through a process that doesn't record spans — e.g. the
+    native C++ workers) surface as top-level roots rather than being
+    dropped: a partial trace is still a trace. Returns None for an empty
+    span list."""
+    if not spans:
+        return None
+    spans.sort(key=lambda r: r.start_s)
+    ids = {r.span_id for r in spans}
+    nodes: Dict[str, dict] = {}
+    for r in spans:
+        node = r.to_dict()
+        node["children"] = []
+        # duplicate span ids cannot happen (uuid per span), but a
+        # defensive setdefault keeps the tree well-formed regardless
+        nodes.setdefault(r.span_id, node)
+    roots: List[dict] = []
+    for r in spans:
+        node = nodes[r.span_id]
+        if r.parent_id is not None and r.parent_id in ids:
+            nodes[r.parent_id]["children"].append(node)
+        else:
+            roots.append(node)
+    t0 = min(r.start_s for r in spans)
+    t1 = max(r.start_s + r.duration_ms / 1000.0 for r in spans)
+    return {
+        "trace_id": trace_id,
+        "span_count": len(spans),
+        "error_count": sum(1 for r in spans if r.status != "ok"),
+        "services": sorted({r.name.split(".", 1)[0] for r in spans}),
+        "duration_ms": round((t1 - t0) * 1000.0, 3),
+        "start_ms": round(t0 * 1000.0, 3),
+        "roots": roots,
+    }
 
 
 # process-global flight recorder (one per process, like the metrics registry)

@@ -66,13 +66,20 @@ class SymbiontStack:
         def on(name: str) -> bool:
             return "all" in want or name in want
 
-        # observability plane (symbiont_tpu/obs/): size the flight recorder
-        # and, when p99 thresholds are configured, run the SLO watchdog over
-        # the span histograms every service handler feeds
+        # observability plane (symbiont_tpu/obs/): size the flight recorder,
+        # apply histogram bucket bounds BEFORE any traffic observes into
+        # them, register the standard process_* host gauges, and, when p99
+        # thresholds are configured, run the SLO watchdog over the span
+        # histograms every service handler feeds
+        from symbiont_tpu.obs.device import register_process_gauges
         from symbiont_tpu.obs.trace_store import trace_store
+        from symbiont_tpu.utils.telemetry import metrics
 
         if trace_store.capacity != cfg.obs.trace_capacity:
             trace_store.set_capacity(cfg.obs.trace_capacity)
+        if cfg.obs.histogram_buckets_ms:
+            metrics.set_bucket_bounds(cfg.obs.histogram_buckets_ms)
+        register_process_gauges()  # platform-guarded no-op off Linux
         if cfg.obs.slo_p99_ms:
             from symbiont_tpu.obs.watchdog import SloWatchdog, parse_thresholds
 
@@ -168,6 +175,14 @@ class SymbiontStack:
             from symbiont_tpu.engine.batcher import MicroBatcher
 
             batcher = MicroBatcher(self.engine)
+
+        if self.engine is not None or self.lm is not None:
+            # device-plane memory gauges (bytes in use / peak / limit per
+            # local device) — only once jax is demonstrably in play; a
+            # CPU-only or api-only process registers nothing
+            from symbiont_tpu.obs.device import register_device_gauges
+
+            register_device_gauges()
 
         if on("perception"):
             self.services.append(

@@ -14,9 +14,12 @@ the reference's Next.js frontend works against this unmodified):
 - CORS on localhost origins (main.rs:555-567)
 
 Additions (SURVEY.md §5.5/§5.3 plans): GET /api/metrics (JSON snapshot),
-GET /metrics (Prometheus text exposition), GET /healthz, and the
-flight-recorder query surface GET /api/traces/recent +
-GET /api/traces/<trace_id> (obs/trace_store.py).
+GET /metrics (Prometheus text exposition; OpenMetrics with exemplars when
+negotiated), GET /healthz, and the flight-recorder query surface:
+GET /api/traces/recent, GET /api/traces/<trace_id> (span tree),
+GET /api/traces/<trace_id>/critical_path (latency attribution,
+obs/critical_path.py) and GET /api/traces/<trace_id>/export?fmt=chrome
+(Perfetto-loadable Chrome Trace Format, obs/chrome_trace.py).
 
 Server: stdlib asyncio HTTP/1.1 — no web framework; this is the Python twin of
 the native C++ gateway under native/.
@@ -221,14 +224,19 @@ class ApiService:
                     return  # SSE occupies the connection
                 if path == "/metrics" and method == "GET":
                     # Prometheus text exposition (scrapers want text/plain,
-                    # not the /api/metrics JSON snapshot)
+                    # not the /api/metrics JSON snapshot). A scraper that
+                    # negotiates OpenMetrics gets that flavor — same
+                    # families plus exemplars on histogram buckets.
                     from symbiont_tpu.obs import prometheus
 
+                    om = ("application/openmetrics-text"
+                          in headers.get("accept", ""))
                     await self._write_response(
-                        writer, 200, prometheus.render(),
+                        writer, 200, prometheus.render(openmetrics=om),
                         origin=headers.get("origin"),
-                        content_type=("text/plain; version=0.0.4; "
-                                      "charset=utf-8"),
+                        content_type=(prometheus.CONTENT_TYPE_OPENMETRICS
+                                      if om else
+                                      prometheus.CONTENT_TYPE_PROM),
                         keep_alive=keep_alive)
                     if not keep_alive:
                         break
@@ -243,7 +251,8 @@ class ApiService:
                         if not keep_alive:
                             break
                         continue
-                status, payload = await self._route(method, path, headers, body)
+                status, payload = await self._route(method, path, query,
+                                                    headers, body)
                 await self._write_response(writer, status, payload,
                                            origin=headers.get("origin"),
                                            keep_alive=keep_alive)
@@ -323,7 +332,8 @@ class ApiService:
 
     # --------------------------------------------------------------- routes
 
-    async def _route(self, method: str, path: str, headers: Dict[str, str],
+    async def _route(self, method: str, path: str, query: str,
+                     headers: Dict[str, str],
                      body: bytes) -> Tuple[int, str]:
         if method == "OPTIONS":
             return 200, ""
@@ -344,15 +354,7 @@ class ApiService:
 
                 return 200, json.dumps({"traces": trace_store.recent()})
             if path.startswith("/api/traces/") and method == "GET":
-                from symbiont_tpu.obs.trace_store import trace_store
-
-                tree = trace_store.trace_tree(path[len("/api/traces/"):])
-                if tree is None:
-                    return 404, json.dumps(
-                        {"message": "trace not found (evicted from the "
-                                    "flight recorder, or never recorded)",
-                         "task_id": None})
-                return 200, json.dumps(tree)
+                return self._trace_route(path[len("/api/traces/"):], query)
             if path == "/api/dlq" and method == "GET":
                 return self._dlq_list()
             if path == "/api/dlq/replay" and method == "POST":
@@ -373,6 +375,46 @@ class ApiService:
         except Exception:
             log.exception("route %s failed", path)
             return 500, json.dumps({"message": "internal error", "task_id": None})
+
+    def _trace_route(self, rest: str, query: str) -> Tuple[int, str]:
+        """The flight-recorder query surface under /api/traces/<trace_id>:
+
+        - ``…/<id>``                → parent-linked span tree
+        - ``…/<id>/critical_path`` → blocking chain + self-time attribution
+                                      + dominant-hop verdict
+        - ``…/<id>/export?fmt=chrome`` → Chrome Trace Format JSON (load in
+                                      Perfetto / chrome://tracing)
+        """
+        from urllib.parse import parse_qs
+
+        from symbiont_tpu.obs.trace_store import trace_store
+
+        trace_id, _, sub = rest.partition("/")
+        not_found = (404, json.dumps(
+            {"message": "trace not found (evicted from the flight "
+                        "recorder, or never recorded)", "task_id": None}))
+        if sub == "":
+            tree = trace_store.trace_tree(trace_id)
+            return not_found if tree is None else (200, json.dumps(tree))
+        if sub == "critical_path":
+            from symbiont_tpu.obs import critical_path
+
+            report = critical_path.compute(trace_store, trace_id)
+            return not_found if report is None else (200, json.dumps(report))
+        if sub == "export":
+            fmt = (parse_qs(query).get("fmt") or ["chrome"])[0]
+            if fmt != "chrome":
+                return 400, json.dumps(
+                    {"message": f"unknown export format {fmt!r} "
+                                "(supported: chrome)", "task_id": None})
+            from symbiont_tpu.obs import chrome_trace
+
+            spans = trace_store.spans_for(trace_id)
+            if not spans:
+                return not_found
+            return 200, json.dumps(chrome_trace.export_spans(trace_id,
+                                                             spans))
+        return 404, json.dumps({"message": "not found", "task_id": None})
 
     async def _submit_url(self, body: bytes) -> Tuple[int, str]:
         data = json.loads(body)

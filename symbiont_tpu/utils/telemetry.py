@@ -64,6 +64,11 @@ def child_headers(parent: Optional[Dict[str, str]]) -> Dict[str, str]:
 
 _profile_lock = threading.Lock()
 
+# flight-recorder trace id for skipped-profile markers (obs/device.py owns
+# the compile-event twin; duplicated as a literal here to keep this module
+# importable below the whole obs layer)
+_PROFILE_TRACE_ID = "profiler"
+
 
 @contextmanager
 def maybe_profile(name: str):
@@ -80,16 +85,34 @@ def maybe_profile(name: str):
     The JAX profiler is process-global and non-reentrant ("Only one profile
     may be run at a time"); embed / rerank / generate can overlap across
     threads, so a call that finds a profile already running proceeds
-    unprofiled rather than crashing the live request."""
+    unprofiled rather than crashing the live request — but no longer
+    SILENTLY: `profile.skipped{name=}` increments and a `profile.skipped`
+    span lands in the flight recorder (trace id "profiler"), so an operator
+    reading the XPlane output can tell which calls of the window it is
+    missing."""
     import os
 
     d = os.environ.get("SYMBIONT_PROFILE_DIR")
-    if not d or not _profile_lock.acquire(blocking=False):
+    if not d:
         yield
+        return
+    if not _profile_lock.acquire(blocking=False):
+        metrics.inc("profile.skipped", labels={"name": name})
+        t0 = time.perf_counter()
+        start_s = time.time()
+        try:
+            yield
+        finally:
+            trace_store.record(SpanRecord(
+                trace_id=_PROFILE_TRACE_ID, span_id=generate_uuid(),
+                parent_id=None, name="profile.skipped", start_s=start_s,
+                duration_ms=(time.perf_counter() - t0) * 1000.0,
+                status="ok", fields={"target": name}))
         return
     try:
         import jax
 
+        metrics.inc("profile.captured", labels={"name": name})
         with jax.profiler.trace(d):
             with jax.profiler.TraceAnnotation(name):
                 yield
@@ -139,7 +162,10 @@ def span(name: str, headers: Optional[Dict[str, str]] = None, **fields):
         raise
     finally:
         dur_ms = (time.perf_counter() - t0) * 1000
-        metrics.observe(f"span.{name}.ms", dur_ms)
+        # the trace id rides along as an exemplar: a bad histogram bucket
+        # on /metrics links straight to a concrete flight-recorder trace
+        metrics.observe(f"span.{name}.ms", dur_ms,
+                        exemplar={"trace_id": trace_id})
         trace_store.record(SpanRecord(
             trace_id=trace_id, span_id=handle.span_id,
             parent_id=handle.parent_id, name=name, start_s=start_s,
@@ -151,10 +177,21 @@ def span(name: str, headers: Optional[Dict[str, str]] = None, **fields):
                             default=str))
 
 
-class _Histogram:
-    __slots__ = ("values", "count", "total", "vmin", "vmax")
+# default cumulative-bucket bounds for span-duration histograms, in ms
+# (Prometheus `le` upper bounds; +Inf is implicit). Chosen to straddle the
+# measured pipeline: sub-ms bus hops up through multi-second cold compiles.
+# Override per process via ObsConfig.histogram_buckets_ms (runner applies
+# Metrics.set_bucket_bounds at boot — BEFORE traffic; bounds are fixed per
+# histogram at first observation, rebucketing recorded data is impossible).
+DEFAULT_BUCKET_BOUNDS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                            500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
-    def __init__(self) -> None:
+
+class _Histogram:
+    __slots__ = ("values", "count", "total", "vmin", "vmax",
+                 "bounds", "bucket_counts", "exemplars")
+
+    def __init__(self, bounds: tuple = DEFAULT_BUCKET_BOUNDS_MS) -> None:
         self.values: list = []  # sorted reservoir (bounded)
         self.count = 0
         self.total = 0.0
@@ -163,14 +200,29 @@ class _Histogram:
         # truncates tails — min/max must not ride the lossy reservoir
         self.vmin: Optional[float] = None
         self.vmax: Optional[float] = None
+        # real Prometheus histogram state: exact per-bucket counts (the
+        # reservoir's quantiles cannot be aggregated across processes;
+        # `_bucket`/`le` series can) + the latest exemplar seen per bucket
+        # (value, {label: v}, unix ts) — a bad bucket links to a concrete
+        # flight-recorder trace
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        self.bucket_counts: list = [0] * (len(self.bounds) + 1)
+        self.exemplars: list = [None] * (len(self.bounds) + 1)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         self.count += 1
         self.total += v
         if self.vmin is None or v < self.vmin:
             self.vmin = v
         if self.vmax is None or v > self.vmax:
             self.vmax = v
+        # non-cumulative bucket index; bisect_left keeps `le` INCLUSIVE
+        # (v == bound counts in that bound's bucket, Prometheus semantics)
+        b = bisect.bisect_left(self.bounds, v)
+        self.bucket_counts[b] += 1
+        if exemplar:
+            self.exemplars[b] = (v, dict(exemplar), time.time())
         bisect.insort(self.values, v)
         if len(self.values) > 4096:
             # drop alternating samples to stay bounded but keep the shape
@@ -182,13 +234,26 @@ class _Histogram:
         idx = min(len(self.values) - 1, int(q * len(self.values)))
         return self.values[idx]
 
+    def cumulative_buckets(self) -> list:
+        """[(le_bound, cumulative_count), ...] ending with ("+Inf", count)."""
+        out, running = [], 0
+        for bound, n in zip(self.bounds, self.bucket_counts):
+            running += n
+            out.append((bound, running))
+        out.append(("+Inf", running + self.bucket_counts[-1]))
+        return out
+
     def summary(self) -> dict:
         return {"count": self.count,
+                "sum": self.total,  # exact running total (renderers must
+                                    # not reconstruct it as mean*count)
                 "mean": self.total / self.count if self.count else 0.0,
                 "min": self.vmin if self.vmin is not None else 0.0,
                 "max": self.vmax if self.vmax is not None else 0.0,
                 "p50": self.quantile(0.50), "p95": self.quantile(0.95),
-                "p99": self.quantile(0.99)}
+                "p99": self.quantile(0.99),
+                "buckets": self.cumulative_buckets(),
+                "exemplars": list(self.exemplars)}
 
 
 # label set normalized to a sorted tuple: one canonical key per
@@ -225,6 +290,21 @@ class Metrics:
         self._hists: Dict[Tuple[str, _LabelKey], _Histogram] = {}
         self._gauges: Dict[Tuple[str, _LabelKey], float] = {}
         self._gauge_fns: Dict[Tuple[str, _LabelKey], Callable] = {}
+        self._bucket_bounds: Tuple[float, ...] = DEFAULT_BUCKET_BOUNDS_MS
+
+    def set_bucket_bounds(self, bounds) -> None:
+        """Cumulative-bucket upper bounds (`le`) for histograms created
+        AFTER this call — existing histograms keep theirs (recorded samples
+        cannot be rebucketed). The runner applies ObsConfig
+        .histogram_buckets_ms here at boot, before traffic."""
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or any(b <= 0 for b in bounds) \
+                or list(bounds) != sorted(set(bounds)):
+            raise ValueError(
+                "bucket bounds must be positive, strictly increasing and "
+                f"non-empty, got {bounds!r}")
+        with self._lock:
+            self._bucket_bounds = bounds
 
     # ------------------------------------------------------------- counters
 
@@ -242,10 +322,18 @@ class Metrics:
     # ----------------------------------------------------------- histograms
 
     def observe(self, name: str, value: float,
-                labels: Optional[Dict[str, str]] = None) -> None:
+                labels: Optional[Dict[str, str]] = None,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        """`exemplar` is a tiny label dict (by convention `{"trace_id":
+        ...}`) attached to the bucket this sample lands in — rendered as an
+        OpenMetrics exemplar so a bad bucket links to a flight-recorder
+        trace."""
         key = (name, _label_key(labels))
         with self._lock:
-            self._hists.setdefault(key, _Histogram()).observe(value)
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Histogram(self._bucket_bounds)
+            h.observe(value, exemplar=exemplar)
 
     def histogram_summary(self, name: str,
                           labels: Optional[Dict[str, str]] = None
@@ -363,7 +451,10 @@ class Metrics:
         return {
             "counters": {_render_key(n, _label_key(lb)): v
                          for n, lb, v in ex["counters"]},
-            "histograms": {_render_key(n, _label_key(lb)): s
+            # exemplars (trace-id samples) are an exposition-format detail;
+            # the JSON view keeps stats + buckets only
+            "histograms": {_render_key(n, _label_key(lb)):
+                           {k: v for k, v in s.items() if k != "exemplars"}
                            for n, lb, s in ex["histograms"]},
             "gauges": {_render_key(n, _label_key(lb)): v
                        for n, lb, v in ex["gauges"]},

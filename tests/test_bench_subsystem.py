@@ -353,7 +353,8 @@ def test_cli_main_end_to_end_stub_registry(monkeypatch, capsys):
     # pre-import the real tier modules so they land in sys.modules NOW and
     # register into the ORIGINAL registry — main()'s imports then no-op and
     # only the stubs below exist in the patched registry
-    from symbiont_tpu.bench import compute, decode, e2e, engine_plane  # noqa: F401
+    from symbiont_tpu.bench import (  # noqa: F401
+        chaos, compute, decode, e2e, engine_plane, obs, serialization)
 
     monkeypatch.setattr(tiers, "_REGISTRY", {})
 
@@ -459,7 +460,8 @@ def test_declared_primary_metrics_single_source():
     missing_primary_metrics enforces, so the two cannot drift."""
     from symbiont_tpu.bench import cli
     # the real tier modules must be registered for this check
-    from symbiont_tpu.bench import compute, decode, e2e, engine_plane  # noqa: F401
+    from symbiont_tpu.bench import (  # noqa: F401
+        chaos, compute, decode, e2e, engine_plane, obs, serialization)
 
     declared = cli.declared_primary_metrics()
     assert cli.ROOFLINE_PRIMARY in declared
@@ -498,7 +500,8 @@ def test_declared_primary_metrics_excludes_skipped_tiers():
     deliberately skipped, or the gate would flag the legitimate skip as a
     lost metric (review finding)."""
     from symbiont_tpu.bench import cli
-    from symbiont_tpu.bench import compute, decode, e2e, engine_plane  # noqa: F401
+    from symbiont_tpu.bench import (  # noqa: F401
+        chaos, compute, decode, e2e, engine_plane, obs, serialization)
 
     full = cli.declared_primary_metrics()
     no_e2e = cli.declared_primary_metrics(skips={"e2e": "skipped by flag"})

@@ -180,13 +180,13 @@ def test_prometheus_exposition_parses():
             continue
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ", 3)
-            assert kind in ("counter", "gauge", "summary")
+            assert kind in ("counter", "gauge", "summary", "histogram")
             declared_type[name] = kind
             continue
         match = _SAMPLE_RE.match(line)
         assert match, f"unparseable sample line: {line!r}"
         base = match.group(1)
-        family = re.sub(r"_(sum|count|min|max)$", "", base)
+        family = re.sub(r"_(sum|count|min|max|bucket)$", "", base)
         assert base in declared_type or family in declared_type, (
             f"sample {base} has no preceding TYPE")
         seen_samples.add(base)
@@ -198,6 +198,19 @@ def test_prometheus_exposition_parses():
     assert 'symbiont_published_total{service="perception"} 3' in out
     assert ('symbiont_span_duration_ms_count'
             '{service="api",span="api.search"} 2') in out
+    # the REAL histogram family rides alongside the summary: cumulative
+    # `le` buckets (12.0 counts by le=25, 30.0 by le=50), +Inf == count
+    assert declared_type["symbiont_span_duration_ms_hist"] == "histogram"
+    assert ('symbiont_span_duration_ms_hist_bucket'
+            '{le="25.0",service="api",span="api.search"} 1') in out
+    assert ('symbiont_span_duration_ms_hist_bucket'
+            '{le="50.0",service="api",span="api.search"} 2') in out
+    assert ('symbiont_span_duration_ms_hist_bucket'
+            '{le="+Inf",service="api",span="api.search"} 2') in out
+    assert ('symbiont_span_duration_ms_hist_count'
+            '{service="api",span="api.search"} 2') in out
+    # 0.0.4 rendering: no exemplar syntax, no EOF terminator
+    assert " # {" not in out and "# EOF" not in out
 
 
 def test_prometheus_label_escaping_roundtrip():
@@ -214,6 +227,299 @@ def test_prometheus_label_escaping_roundtrip():
     # NB: naive sequential unescape is escape-order sensitive; exact
     # equality via the library's own escape is the contract under test
     assert unescaped.count("b") == 1
+
+
+# ------------------------------------------------- histogram buckets/exemplars
+
+def test_histogram_buckets_cumulative_and_le_inclusive():
+    m = Metrics()
+    m.set_bucket_bounds([10.0, 100.0])
+    m.observe("span.x.y.ms", 10.0)   # le is INCLUSIVE: lands in le=10
+    m.observe("span.x.y.ms", 10.001)
+    m.observe("span.x.y.ms", 500.0)
+    s = m.snapshot()["histograms"]["span.x.y.ms"]
+    assert s["buckets"] == [(10.0, 1), (100.0, 2), ("+Inf", 3)]
+    assert "exemplars" not in s  # exposition detail, stripped from JSON
+    # bounds apply to NEW histograms only; invalid bounds fail loud
+    with pytest.raises(ValueError):
+        m.set_bucket_bounds([5.0, 5.0])
+    with pytest.raises(ValueError):
+        m.set_bucket_bounds([])
+
+
+def test_openmetrics_exemplar_links_bucket_to_trace():
+    m = Metrics()
+    m.observe("span.api.search.ms", 12.0, exemplar={"trace_id": "tr-42"})
+    om = prometheus.render(m, openmetrics=True)
+    (ex_line,) = [ln for ln in om.splitlines()
+                  if "_hist_bucket" in ln and " # {" in ln]
+    assert 'le="25.0"' in ex_line  # 12ms lands in the 25ms bucket
+    assert '# {trace_id="tr-42"} 12 ' in ex_line
+    assert om.rstrip().endswith("# EOF")
+    # span() itself attaches its trace id as the exemplar
+    trace_store.clear()
+    with span("obs_test.exemplar", None) as sp:
+        pass
+    om = prometheus.render()
+    assert f'trace_id="{sp.trace_id}"' in prometheus.render(
+        openmetrics=True)
+    assert f'trace_id="{sp.trace_id}"' not in om  # 0.0.4 stays exemplar-free
+
+
+def test_openmetrics_counter_families_drop_total_suffix():
+    """OpenMetrics reserves `_total`: the counter FAMILY name must not end
+    with it (samples must) — the reference parser rejects the clash and a
+    failed parse loses the whole scrape (review finding). 0.0.4 keeps the
+    historical family-name-includes-_total rendering."""
+    m = Metrics()
+    m.inc("perception.published", 3)
+    m.inc("span.api.search.errors")
+    om = prometheus.render(m, openmetrics=True)
+    assert "# TYPE symbiont_published counter" in om
+    assert "# TYPE symbiont_published_total counter" not in om
+    assert "symbiont_published_total{" in om  # the sample keeps the suffix
+    assert "# TYPE symbiont_span_errors counter" in om
+    legacy = prometheus.render(m)
+    assert "# TYPE symbiont_published_total counter" in legacy
+    try:
+        from prometheus_client.openmetrics import parser
+    except ImportError:
+        return
+    names = {f.name for f in parser.text_string_to_metric_families(om)}
+    assert {"symbiont_published", "symbiont_span_errors"} <= names
+
+
+# ----------------------------------------------------- trace store (capacity)
+
+def test_set_capacity_shrink_keeps_newest_and_len():
+    ts = TraceStore(capacity=16)
+    for i in range(12):
+        ts.record(_rec(trace=f"t{i}", sid=f"s{i}", start=float(i)))
+    ts.set_capacity(4)
+    assert ts.capacity == 4 and len(ts) == 4
+    # newest survive, eviction order is oldest-first
+    kept = {r.trace_id for tid in (f"t{i}" for i in range(12))
+            for r in ts.spans_for(tid)}
+    assert kept == {"t8", "t9", "t10", "t11"}
+    ts.record(_rec(trace="t12", sid="s12", start=12.0))
+    assert len(ts) == 4
+    assert not ts.spans_for("t8") and ts.spans_for("t12")
+
+
+def test_trace_tree_parent_evicted_from_ring():
+    # the orphan case the critical-path plane must survive: the PARENT
+    # span was evicted by the ring, the child must surface as a root
+    ts = TraceStore(capacity=2)
+    ts.record(_rec(sid="root", name="api.submit_url", start=1.0))
+    ts.record(_rec(sid="c1", parent="root", name="perception.handle",
+                   start=2.0))
+    ts.record(_rec(sid="c2", parent="c1", name="preprocessing.handle",
+                   start=3.0))  # evicts "root"
+    tree = ts.trace_tree("t1")
+    assert tree["span_count"] == 2
+    (root,) = tree["roots"]
+    assert root["name"] == "perception.handle"
+    assert [c["name"] for c in root["children"]] == ["preprocessing.handle"]
+
+
+# ------------------------------------------------------------- critical path
+
+from symbiont_tpu.obs import chrome_trace, critical_path  # noqa: E402
+
+
+def _pipeline_store() -> TraceStore:
+    """An ingest-shaped trace: causal children outliving their parents
+    (bus semantics), one parallel fan-out, dominant hop = preprocessing."""
+    ts = TraceStore(capacity=64)
+
+    def rec(sid, parent, name, start, dur, status="ok"):
+        ts.record(SpanRecord("t1", sid, parent, name, start, dur, status))
+
+    rec("r", None, "api.submit_url", 100.0, 5.0)
+    rec("c1", "r", "perception.handle", 100.010, 40.0)
+    rec("c2", "c1", "preprocessing.handle", 100.060, 100.0)
+    # parallel fan-out off preprocessing: only the blocker joins the chain;
+    # c3 outlives its parent (causal bus semantics) and ends the trace
+    rec("c3", "c2", "vector_memory.handle", 100.130, 60.0, status="error")
+    rec("c4", "c2", "knowledge_graph.handle", 100.130, 10.0)
+    return ts
+
+
+def test_critical_path_self_time_chain_and_dominant():
+    ts = _pipeline_store()
+    report = critical_path.compute(ts, "t1")
+    assert report is not None
+    # e2e: 100.000 → 100.190 (c3's end) = 190ms
+    assert report["e2e_ms"] == pytest.approx(190.0, abs=0.01)
+    assert [h["name"] for h in report["chain"]] == [
+        "api.submit_url", "perception.handle", "preprocessing.handle",
+        "vector_memory.handle"]
+    by = {h["name"]: h for h in report["chain"]}
+    # api's causal child starts AFTER api already returned (bus hop): no
+    # overlap to subtract, the full 5ms stays self-time
+    assert by["api.submit_url"]["self_ms"] == pytest.approx(5.0, abs=0.01)
+    # preprocessing [100.060, 100.160] with children covering
+    # [100.130, 100.160] once merged (c3 clipped at parent end, c4 inside
+    # c3): 100 - 30 = 70ms self
+    assert by["preprocessing.handle"]["self_ms"] == pytest.approx(
+        70.0, abs=0.01)
+    # the chain + the untraced inter-hop gaps (5ms + 10ms) tile the e2e
+    assert report["gap_ms"] == pytest.approx(15.0, abs=0.05)
+    assert report["dominant"]["name"] == "preprocessing.handle"
+    assert "preprocessing.handle" in report["verdict"]
+    assert report["chain_self_ms"] + report["gap_ms"] == pytest.approx(
+        report["e2e_ms"], abs=0.1)
+    assert critical_path.compute(ts, "missing") is None
+
+
+def test_critical_path_self_time_with_overlapping_children():
+    ts = TraceStore(capacity=8)
+    ts.record(SpanRecord("t2", "p", None, "svc.handle", 10.0, 100.0, "ok"))
+    # overlapping children inside the parent: merged coverage, not summed
+    ts.record(SpanRecord("t2", "a", "p", "svc.op_a", 10.010, 40.0, "ok"))
+    ts.record(SpanRecord("t2", "b", "p", "svc.op_b", 10.030, 40.0, "ok"))
+    tree = critical_path.annotate_self_times(ts.trace_tree("t2"))
+    (root,) = tree["roots"]
+    # union of [10,50] and [30,70] = 60ms covered, not 80
+    assert root["child_ms"] == pytest.approx(60.0, abs=0.01)
+    assert root["self_ms"] == pytest.approx(40.0, abs=0.01)
+
+
+def test_stage_attribution_aggregates_and_exports_gauges():
+    ts = _pipeline_store()
+    attr = critical_path.aggregate_stage_attribution(ts)
+    assert set(attr) == {"api.submit_url"}
+    agg = attr["api.submit_url"]
+    assert agg["count"] == 1
+    fracs = agg["stages"]
+    assert fracs["preprocessing.handle"] == pytest.approx(70 / 190,
+                                                          abs=0.005)
+    total = sum(fracs.values()) + agg["gap_frac"]
+    assert total == pytest.approx(1.0, abs=0.02)
+    m = Metrics()
+    critical_path.export_stage_gauges(attr, registry=m)
+    gauges = m.snapshot()["gauges"]
+    assert gauges[
+        'stage.fraction{pipeline="api.submit_url",'
+        'stage="preprocessing.handle"}'] == pytest.approx(70 / 190,
+                                                          abs=0.005)
+    assert 'stage.e2e_ms{pipeline="api.submit_url"}' in gauges
+    assert gauges['stage.traces{pipeline="api.submit_url"}'] == 1
+
+
+# ------------------------------------------------------- chrome trace export
+
+def _chrome_schema_check(doc: dict, expect_spans: int) -> None:
+    """The golden-file schema, reusable against live exports: top-level
+    shape, metadata-first ordering, complete events with µs timing."""
+    assert set(doc) == {"displayTimeUnit", "otherData", "traceEvents"}
+    assert doc["displayTimeUnit"] == "ms"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(meta) + len(spans) == len(doc["traceEvents"])
+    assert len(spans) == expect_spans == doc["otherData"]["span_count"]
+    assert meta[0]["name"] == "process_name"
+    tids = {e["args"]["name"]: e["tid"] for e in meta[1:]}
+    for ev in spans:
+        assert {"name", "cat", "pid", "tid", "ts", "dur",
+                "args"} <= set(ev)
+        assert ev["tid"] == tids[ev["cat"]]  # one track per service
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["dur"], (int, float))
+        assert ev["args"]["span_id"]
+        if ev["args"]["status"] != "ok":
+            assert ev["cname"] == "terrible"  # error spans flagged
+
+
+def test_chrome_trace_export_matches_golden():
+    import pathlib
+
+    ts = _pipeline_store()
+    doc = chrome_trace.export_spans("t1", ts.spans_for("t1"))
+    _chrome_schema_check(doc, expect_spans=5)
+    golden_path = (pathlib.Path(__file__).parent / "goldens"
+                   / "chrome_trace_golden.json")
+    golden = json.loads(golden_path.read_text())
+    assert doc == golden, (
+        "Chrome Trace export drifted from the pinned golden — if the "
+        "change is deliberate, regenerate: python -c \"from "
+        "tests.test_observability import _write_chrome_golden; "
+        "_write_chrome_golden()\"")
+
+
+def _write_chrome_golden() -> None:
+    import pathlib
+
+    ts = _pipeline_store()
+    doc = chrome_trace.export_spans("t1", ts.spans_for("t1"))
+    p = (pathlib.Path(__file__).parent / "goldens"
+         / "chrome_trace_golden.json")
+    p.parent.mkdir(exist_ok=True)
+    p.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+
+
+# ------------------------------------------------------ device / host planes
+
+def test_device_gauges_graceful_noop_on_cpu():
+    from symbiont_tpu.obs.device import register_device_gauges
+
+    m = Metrics()
+    n = register_device_gauges(m)  # CPU jax: memory_stats() is None
+    assert n == 0
+    assert not [k for k in m.snapshot()["gauges"] if k.startswith("device.")]
+
+
+def test_process_gauges_from_proc_self():
+    from symbiont_tpu.obs.device import register_process_gauges
+
+    m = Metrics()
+    assert register_process_gauges(m) is True  # this suite runs on Linux
+    g = m.snapshot()["gauges"]
+    assert g["process.resident_memory_bytes"] > 1 << 20
+    assert g["process.open_fds"] >= 3
+    assert 0 <= g["process.uptime_seconds"] < 7 * 24 * 3600
+    assert abs(g["process.start_time_seconds"]
+               + g["process.uptime_seconds"] - __import__("time").time()) < 5
+    out = prometheus.render(m)
+    # the standard family keeps its ecosystem names: NO symbiont_ prefix
+    assert "\nprocess_resident_memory_bytes" in out
+    assert "symbiont_process_" not in out
+
+
+def test_compile_events_land_on_the_timeline():
+    from symbiont_tpu.obs.device import (COMPILE_TRACE_ID,
+                                         record_compile_event)
+
+    trace_store.clear()
+    record_compile_event("engine.compile", 1.5, start_s=1000.0,
+                         signature="embed[L=64,B=32]")
+    (rec,) = trace_store.spans_for(COMPILE_TRACE_ID)
+    assert rec.name == "engine.compile"
+    assert rec.duration_ms == pytest.approx(1500.0)
+    assert rec.fields["signature"] == "embed[L=64,B=32]"
+    # and the timeline exports like any other trace
+    doc = chrome_trace.export_spans(
+        COMPILE_TRACE_ID, trace_store.spans_for(COMPILE_TRACE_ID))
+    _chrome_schema_check(doc, expect_spans=1)
+
+
+def test_maybe_profile_skip_is_loud(monkeypatch, tmp_path):
+    from symbiont_tpu.utils.telemetry import _profile_lock, maybe_profile
+
+    monkeypatch.setenv("SYMBIONT_PROFILE_DIR", str(tmp_path))
+    trace_store.clear()
+    before = metrics.get("profile.skipped", labels={"name": "engine.embed"})
+    assert _profile_lock.acquire(blocking=False)  # simulate a live profile
+    try:
+        with maybe_profile("engine.embed"):
+            pass  # proceeds unprofiled — but no longer silently
+    finally:
+        _profile_lock.release()
+    assert metrics.get("profile.skipped",
+                       labels={"name": "engine.embed"}) == before + 1
+    (rec,) = trace_store.spans_for("profiler")
+    assert rec.name == "profile.skipped"
+    assert rec.fields["target"] == "engine.embed"
 
 
 # ------------------------------------------------------------------ watchdog
@@ -481,6 +787,54 @@ def test_ingest_trace_spans_pipeline(tmp_path):
                     '{batcher="embed",service="engine"}') in text
             assert ('symbiont_bus_consumed_total{service="perception"'
                     in text)
+            # real histogram series ride alongside the summaries
+            # (acceptance: /metrics exposes _bucket/le for span durations)
+            assert "symbiont_span_duration_ms_hist_bucket{le=" in text
+            assert "# TYPE symbiont_span_duration_ms_hist histogram" in text
+            assert 'quantile="0.99"' in text  # summaries stay
+            # the runner registered the standard process_* host gauges
+            assert "\nprocess_resident_memory_bytes" in text
+
+            # acceptance: critical path of the live ingest trace names a
+            # dominant hop with self-time accounting
+            status, cp = await loop.run_in_executor(
+                None, http_get,
+                f"/api/traces/{summary['trace_id']}/critical_path")
+            assert status == 200
+            assert cp["e2e_ms"] > 0
+            chain_names = [h["name"] for h in cp["chain"]]
+            assert chain_names[0] == "api.submit_url"
+            assert cp["dominant"] is not None
+            assert cp["dominant"]["self_ms"] <= cp["e2e_ms"]
+            assert cp["dominant"]["name"] in chain_names
+            assert cp["verdict"].startswith(cp["dominant"]["name"])
+            for hop in cp["chain"]:
+                assert hop["self_ms"] + hop["child_ms"] <= (
+                    hop["duration_ms"] + 0.01)
+
+            # acceptance: the same trace exports as Chrome Trace Format
+            # that validates against the golden-file schema
+            status, chrome = await loop.run_in_executor(
+                None, http_get,
+                f"/api/traces/{summary['trace_id']}/export?fmt=chrome")
+            assert status == 200
+            _chrome_schema_check(chrome,
+                                 expect_spans=tree["span_count"])
+            def http_code(path):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}{path}",
+                            timeout=10) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+
+            assert await loop.run_in_executor(
+                None, http_code,
+                f"/api/traces/{summary['trace_id']}/export?fmt=bogus") == 400
+            # unknown trace: 404 on the new endpoints too
+            assert await loop.run_in_executor(
+                None, http_code, "/api/traces/nope/critical_path") == 404
         finally:
             await stack.stop()
 
