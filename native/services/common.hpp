@@ -260,6 +260,11 @@ inline std::vector<unsigned char> b64_decode(const std::string& s) {
 // codec. Both ends of this wire are little-endian (x86/arm64) — the same
 // stance the b64 vector encoding above already takes.
 inline const char* FRAME_HEADER = "X-Symbiont-Frame";
+// Reply-frame negotiation on reference-parity request-reply subjects
+// (tasks.embedding.for_query): the requester announces frame capability
+// with this header ("1"); a peer that ignores it replies JSON float lists
+// and every requester accepts both forms (schema/frames.py wants_frame).
+inline const char* ACCEPT_FRAME_HEADER = "X-Symbiont-Accept-Frame";
 constexpr size_t FRAME_HDR_LEN = 16;
 constexpr uint8_t FRAME_VERSION = 1;
 constexpr uint8_t FRAME_DTYPE_F32 = 1;

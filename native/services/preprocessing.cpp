@@ -355,8 +355,27 @@ int main() try {
         if (result.request_id.empty()) result.request_id = "unknown";
         result.error_message = e.what();
       }
-      bus.publish(msg->reply, result.to_json_string(), "",
-                  symbiont::child_headers(msg->headers));
+      auto reply_headers = symbiont::child_headers(msg->headers);
+      std::string body;
+      auto accept = msg->headers.find(symbiont::ACCEPT_FRAME_HEADER);
+      if (!result.error_message.has_value() && result.embedding &&
+          accept != msg->headers.end() && accept->second == "1") {
+        // negotiated reply frame (schema/frames.py wants_frame): the
+        // [1, dim] f32 block rides appended to a schema-valid reply whose
+        // embedding list is empty; requesters without the accept header
+        // keep getting the reference float-list reply below
+        std::vector<float> v = std::move(*result.embedding);
+        std::string raw(reinterpret_cast<const char*>(v.data()),
+                        v.size() * sizeof(float));
+        result.embedding = std::vector<float>{};
+        body = result.to_json_string();
+        reply_headers[symbiont::FRAME_HEADER] =
+            symbiont::frame_header_value(body.size());
+        body += symbiont::make_frame(raw, 1, (uint32_t)v.size());
+      } else {
+        body = result.to_json_string();
+      }
+      bus.publish(msg->reply, body, "", reply_headers);
       continue;
     }
   }

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Localize a host-overlap regression in ONE command (ROADMAP item 3, the
+# overlap-everything ingest rework — docs/PERF.md "Overlap-everything
+# ingest" section): where does e2e ingest time actually go?
+#
+#   scripts/profile_ingest.sh                  # run the bench e2e tier
+#       (full stack: native broker + C++ workers + engine plane), then
+#       print the archived "where the time goes" ingest stage shares, the
+#       e2e÷bulk ratio vs the ≥0.6 target, and the overlap/coalesce stats.
+#
+#   scripts/profile_ingest.sh localhost:8080   # against a RUNNING stack:
+#       pick the slowest recent ingest trace from GET /api/traces and print
+#       its critical path — per-hop self-times, the dominant-hop verdict,
+#       and gap_ms (untraced time: bus queueing / scheduling / span-less
+#       native hops). A growing gap_ms is host overlap regressing.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [ $# -ge 1 ]; then
+  python3 - "$1" <<'EOF'
+import json
+import sys
+import urllib.request
+
+api = sys.argv[1]
+with urllib.request.urlopen(f"http://{api}/api/traces/recent",
+                            timeout=10) as r:
+    traces = json.load(r)["traces"]
+ingest_roots = ("api.submit_url", "perception.handle", "preprocessing.handle",
+                "vector_memory.handle", "engine.handle")
+picks = [t for t in traces if t.get("root") in ingest_roots] or traces
+if not picks:
+    sys.exit("no traces recorded yet — drive some ingest first")
+tid = picks[0]["trace_id"]
+with urllib.request.urlopen(f"http://{api}/api/traces/{tid}/critical_path",
+                            timeout=10) as r:
+    cp = json.load(r)
+print(f"trace {tid} (root {picks[0].get('root')}, e2e {cp.get('e2e_ms')} ms)")
+for hop in cp.get("chain", []):
+    print("  " + hop["name"].ljust(40)
+          + f" self {hop['self_ms']:>9} ms  ({hop['share_of_e2e_pct']}%)")
+print("  " + "<untraced gap>".ljust(40)
+      + f" self {cp['gap_ms']:>9} ms  ({cp.get('gap_pct')}%)")
+print("verdict:", cp.get("verdict"))
+EOF
+  exit 0
+fi
+
+# no host given: run the bench (e2e tier included) and read its archived
+# attribution + overlap fields off the one JSON line it prints on stdout
+LINE_FILE="$(mktemp)"
+trap 'rm -f "${LINE_FILE}"' EXIT
+python bench.py --no-chaos | tee "${LINE_FILE}"
+python3 - "${LINE_FILE}" <<'EOF'
+import json, sys
+line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+r = json.loads(line)
+stages = sorted(((k, v) for k, v in r.items()
+                 if k.startswith("e2e_stage_ingest_") and k.endswith("_pct")),
+                key=lambda kv: -kv[1])
+print()
+print("== where the ingest time goes (critical-path self-time shares) ==")
+if not stages:
+    print("no e2e_stage_ingest_* fields archived — did the e2e tier run?")
+for k, v in stages:
+    hop = k[len("e2e_stage_ingest_"):-len("_pct")]
+    marker = "  <- dominant" if (k, v) == stages[0] else ""
+    if hop == "gap":
+        marker = "  (untraced: bus queueing / span-less native hops)"
+    print(f"  {hop:<32} {v:>6.1f}%{marker}")
+ratio = r.get("e2e_ingest_vs_bulk_x")
+if ratio is not None:
+    verdict = "OK" if ratio >= 0.6 else "REGRESSION (target >= 0.6)"
+    print(f"e2e ingest / bulk ingest: {ratio}x  [{verdict}]")
+ov = r.get("e2e_batcher_overlap_ratio")
+if ov is not None:
+    print(f"embed flush window overlap ratio: {ov}")
+rows = r.get("e2e_coalesce_rows_per_flush")
+if rows is not None:
+    print(f"coalesced upsert: {rows} rows/flush over "
+          f"{r.get('e2e_coalesce_flushes')} flushes")
+EOF

@@ -256,6 +256,17 @@ def tier_e2e(results: dict, ctx) -> None:
             f"{results['e2e_ingest_emb_per_s']:.0f} emb/s "
             f"[{results['e2e_ingest_emb_per_s_min']:.0f}–"
             f"{results['e2e_ingest_emb_per_s_max']:.0f}]")
+        # the overlap-everything target (ROADMAP item 3): e2e ingest as a
+        # fraction of the same run's bulk-ingest rate. Both rates ride the
+        # same tunnel in the same minutes, so link drift largely cancels —
+        # the ratio IS the host-orchestration overhead. Archived whenever
+        # the engine-plane tier ran first in this process.
+        if "ingest_10k_emb_per_s" in results:
+            ratio = (results["e2e_ingest_emb_per_s"]
+                     / results["ingest_10k_emb_per_s"])
+            results["e2e_ingest_vs_bulk_x"] = round(ratio, 3)
+            log(f"e2e ingest / bulk ingest = {ratio:.2f}× "
+                f"(overlap-everything target: ≥ 0.60×)")
 
         # ---- search over real HTTP (median-of-5 sweeps of 20 queries)
         for q in ["alpha beta", " ".join(["word"] * 40)]:
@@ -466,6 +477,20 @@ def tier_e2e(results: dict, ctx) -> None:
         # their gauges.
         from symbiont_tpu.utils.telemetry import metrics as _metrics
 
+        # first-class overlap/coalesce fields (also inside metrics_snapshot;
+        # these are the ones doc.py renders): how full the double-buffered
+        # flush window ran, and how many rows each coalesced store call
+        # carried on average
+        overlap = _metrics.gauge_get(
+            "batcher.overlap_ratio",
+            labels={"service": "engine", "batcher": "embed"})
+        results["e2e_batcher_overlap_ratio"] = round(float(overlap), 4)
+        co = _metrics.histogram_summary("coalesce.flush_rows",
+                                        labels={"service": "engine"})
+        if co is not None and co["count"]:
+            results["e2e_coalesce_flushes"] = co["count"]
+            results["e2e_coalesce_rows_per_flush"] = round(
+                co["sum"] / co["count"], 1)
         results["metrics_snapshot"] = _metrics.flat_snapshot()
         await tg.stop()
         await gen_batcher.close()

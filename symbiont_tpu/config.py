@@ -187,6 +187,23 @@ class VectorStoreConfig:
     # ApiConfig.fused_search_max_top_k (default 16) — a fused query in an
     # unwarmed bucket pays a cold XLA compile inside the probe timeout
     warm_top_k: int = 16
+    # Cross-message upsert coalescing (services/coalesce.py): the Python
+    # vector-memory worker batches rows from many data.text.with_embeddings
+    # messages into ONE upsert_rows call, acking each durable delivery only
+    # after the flush carrying its rows commits. Flush fires at
+    # coalesce_max_rows pending rows or when the oldest row has waited
+    # coalesce_max_age_ms (also on shutdown). The age bound caps the added
+    # ack latency; keep it well below bus.durable_ack_wait_s.
+    coalesce: bool = True
+    coalesce_max_rows: int = 512
+    coalesce_max_age_ms: float = 25.0
+
+    def __post_init__(self) -> None:
+        if self.coalesce_max_rows < 1:
+            raise ValueError("vector_store.coalesce_max_rows must be >= 1")
+        if self.coalesce_max_age_ms <= 0:
+            raise ValueError(
+                "vector_store.coalesce_max_age_ms must be positive")
 
 
 @dataclass

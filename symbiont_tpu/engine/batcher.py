@@ -48,9 +48,23 @@ class _BatcherBase:
     results are still materializing — on a network-attached device a flush
     tail is ~an RTT of pure waiting, so overlapping flushes keeps the chip
     fed (the engine's entry points are thread-safe by design; see
-    engine.py's concurrency contract). Generation keeps it at 1: decode
-    sessions admit newcomers at chunk boundaries instead, and two sessions
-    would only contend on the LM lock."""
+    engine.py's concurrency contract): batch N+1 tokenizes/pads/dispatches
+    on its own executor thread while batch N's forward runs. Generation
+    keeps it at 1: decode sessions admit newcomers at chunk boundaries
+    instead, and two sessions would only contend on the LM lock.
+
+    Result-order contract under overlap: each submission's future is bound
+    to its exact slice of its OWN flush, so flush N+1 completing before
+    flush N (a short batch overtaking a long one) resolves the later
+    submitters first but can never mis-route rows — per-submission results
+    are positionally exact regardless of flush completion order (pinned by
+    tests/test_coalesce.py's slow-forward ordering test).
+
+    Live accounting for the double-buffering (engine-plane gauges):
+    `batcher.inflight` is the flush count currently in the air and
+    `batcher.overlap_ratio` is the fraction of cumulative flush seconds
+    that ran concurrently with another flush — 0.0 means lockstep (no
+    overlap won), approaching 1-1/k means the window of k stayed full."""
 
     # metric label distinguishing the two policies over one registry
     kind = "batcher"
@@ -69,6 +83,14 @@ class _BatcherBase:
         self._closed = False
         self._inflight = asyncio.Semaphore(max_inflight_flushes)
         self._flushes: set = set()
+        # overlap accounting (all touched on the event-loop thread only):
+        # span = Σ individual flush durations; busy = wall seconds with ≥1
+        # flush in flight. span - busy is flush time that OVERLAPPED another
+        # flush — overlap_ratio = 1 - busy/span.
+        self._inflight_n = 0
+        self._busy_since = 0.0
+        self._flush_busy_s = 0.0
+        self._flush_span_s = 0.0
 
     async def start(self) -> None:
         if self._task is None:
@@ -95,10 +117,25 @@ class _BatcherBase:
             t = getattr(b._queue[0], "_t_submit", None)
             return 0.0 if t is None else max(0.0, time.monotonic() - t)
 
+        def inflight(b):
+            return None if b._closed else b._inflight_n
+
+        def overlap_ratio(b):
+            if b._closed:
+                return None
+            span = b._flush_span_s
+            if span <= 0.0:
+                return 0.0
+            return round(max(0.0, 1.0 - b._flush_busy_s / span), 4)
+
         metrics.register_weakref_gauge("batcher.queue_depth", self, depth,
                                        labels=labels)
         metrics.register_weakref_gauge("batcher.oldest_wait_s", self,
                                        oldest_wait_s, labels=labels)
+        metrics.register_weakref_gauge("batcher.inflight", self, inflight,
+                                       labels=labels)
+        metrics.register_weakref_gauge("batcher.overlap_ratio", self,
+                                       overlap_ratio, labels=labels)
 
     async def close(self) -> None:
         self._closed = True
@@ -186,10 +223,19 @@ class _BatcherBase:
             t.add_done_callback(self._flushes.discard)
 
     async def _flush_release(self, batch: List) -> None:
+        t0 = time.monotonic()
+        self._inflight_n += 1
+        if self._inflight_n == 1:
+            self._busy_since = t0
         try:
             await self._flush(batch)
         finally:
             self._inflight.release()
+            t1 = time.monotonic()
+            self._flush_span_s += t1 - t0
+            self._inflight_n -= 1
+            if self._inflight_n == 0:
+                self._flush_busy_s += t1 - self._busy_since
 
     async def _sleep_until_full(self) -> None:
         while self._queued < self.max_batch and not self._closed:

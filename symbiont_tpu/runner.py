@@ -193,7 +193,10 @@ class SymbiontStack:
                      durable_stream=pipeline_stream))
         if on("vector_memory"):
             self.services.append(VectorMemoryService(
-                self.bus, self.vector_store, durable_stream=pipeline_stream))
+                self.bus, self.vector_store, durable_stream=pipeline_stream,
+                coalesce=cfg.vector_store.coalesce,
+                coalesce_max_rows=cfg.vector_store.coalesce_max_rows,
+                coalesce_max_age_ms=cfg.vector_store.coalesce_max_age_ms))
         if on("knowledge_graph"):
             self.services.append(KnowledgeGraphService(
                 self.bus, self.graph_store, durable_stream=pipeline_stream))
@@ -228,7 +231,10 @@ class SymbiontStack:
             self.services.append(EngineService(
                 self.bus, engine=self.engine, batcher=batcher, lm=self.lm,
                 lm_batcher=lm_batcher,
-                vector_store=self.vector_store, graph_store=self.graph_store))
+                vector_store=self.vector_store, graph_store=self.graph_store,
+                coalesce=cfg.vector_store.coalesce,
+                coalesce_max_rows=cfg.vector_store.coalesce_max_rows,
+                coalesce_max_age_ms=cfg.vector_store.coalesce_max_age_ms))
         for s in self.services:
             # handler timeout/retry + loop-supervisor knobs (resilience
             # plane); services may further tune their own fields after

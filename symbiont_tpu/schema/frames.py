@@ -65,6 +65,15 @@ from symbiont_tpu.schema import (
 from symbiont_tpu.utils.telemetry import metrics
 
 FRAME_HEADER = "X-Symbiont-Frame"
+# Request-reply negotiation for REPLY frames on reference-parity schema
+# subjects (tasks.embedding.for_query): the requester announces frame
+# capability with this HEADER instead of a schema field — the wire body
+# stays byte-identical for reference-era peers, and a peer that has never
+# heard of the header simply ignores it and replies JSON float lists (the
+# fallback every caller accepts). Engine-plane subjects keep their in-body
+# `"encoding": "frame"` negotiation (framework-internal JSON, no parity
+# constraint).
+ACCEPT_FRAME_HEADER = "X-Symbiont-Accept-Frame"
 FRAME_MAGIC = b"SYTF"
 FRAME_VERSION = 1
 DTYPE_F32 = 1
@@ -78,6 +87,12 @@ _CONTENT_TYPE = "tensor/f32"
 class FrameError(ValueError):
     """Malformed frame or frame/metadata mismatch (handler-fatal: the
     delivery stays unacked for redelivery / DLQ, never silently dropped)."""
+
+
+def wants_frame(headers: Optional[Dict[str, str]]) -> bool:
+    """True when the requester announced frame capability for the REPLY
+    (ACCEPT_FRAME_HEADER: "1"). Absent/other → reply JSON float lists."""
+    return (headers or {}).get(ACCEPT_FRAME_HEADER) == "1"
 
 
 def frames_enabled(default: bool = True) -> bool:
@@ -226,6 +241,60 @@ def encode_embeddings_message(original_id: str, source_url: str,
     if not use_frame:
         return body, {}
     return attach_frame(body, arr)
+
+
+class LazyEmbeddingsMessage:
+    """Zero-churn view over a data.text.with_embeddings body: scalar
+    metadata + sentence texts pulled straight out of the parsed JSON dict,
+    and the embedding block as ONE [n, dim] f32 ndarray — no per-sentence
+    SentenceEmbedding/TextWithEmbeddingsMessage dataclasses are ever
+    materialized. On the ingest hot path the consumer builds store payload
+    dicts directly from these fields (services/vector_memory.py), so a
+    message costs one json.loads and one array view, not 2n+1 Python
+    object constructions."""
+
+    __slots__ = ("original_id", "source_url", "model_name", "timestamp_ms",
+                 "sentences", "rows")
+
+    def __init__(self, original_id: str, source_url: str, model_name: str,
+                 timestamp_ms: int, sentences: List[str], rows: np.ndarray):
+        self.original_id = original_id
+        self.source_url = source_url
+        self.model_name = model_name
+        self.timestamp_ms = timestamp_ms
+        self.sentences = sentences
+        self.rows = rows
+
+
+def decode_embeddings_lazy(data: bytes,
+                           headers: Optional[Dict[str, str]] = None
+                           ) -> LazyEmbeddingsMessage:
+    """Decode either wire form WITHOUT the per-sentence dataclass churn of
+    `decode_embeddings_message`. Frame-bearing messages hand back the
+    zero-copy row view; the JSON fallback converts its float lists to one
+    f32 block (a single C-level np.asarray, no per-float Python loop).
+    Malformed bodies raise (KeyError/TypeError/FrameError) — handler-fatal,
+    same stance as from_json: the delivery stays unacked for redelivery."""
+    json_bytes, rows = detach_frame(data, headers)
+    d = json.loads(json_bytes)
+    emb = d["embeddings_data"]
+    sentences = [e["sentence_text"] for e in emb]
+    if rows is None:
+        lists = [e["embedding"] for e in emb]
+        rows = (np.asarray(lists, dtype=np.float32) if lists
+                else np.zeros((0, 0), np.float32))
+        if rows.ndim != 2:
+            raise FrameError(
+                "embedding lists are ragged or non-numeric: cannot form "
+                f"a [{len(lists)}, dim] block")
+    elif rows.shape[0] != len(sentences):
+        raise FrameError(
+            f"frame carries {rows.shape[0]} rows for "
+            f"{len(sentences)} sentences")
+    return LazyEmbeddingsMessage(
+        original_id=d["original_id"], source_url=d["source_url"],
+        model_name=d["model_name"], timestamp_ms=int(d["timestamp_ms"]),
+        sentences=sentences, rows=rows)
 
 
 def decode_embeddings_message(data: bytes,

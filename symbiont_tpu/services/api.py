@@ -47,6 +47,7 @@ from symbiont_tpu.schema import (
     to_json,
     to_json_bytes,
 )
+from symbiont_tpu.schema import frames
 from symbiont_tpu.utils.ids import generate_uuid
 from symbiont_tpu.utils.telemetry import metrics, new_trace_headers, span
 
@@ -489,21 +490,34 @@ class ApiService:
             embed_task = QueryForEmbeddingTask(request_id=request_id,
                                                text_to_embed=req.query_text)
             try:
+                # frame-negotiated reply (schema/frames): the accept HEADER
+                # keeps the request body byte-identical for reference-era
+                # preprocessing peers, which simply ignore it and answer
+                # JSON float lists — both reply forms are decoded below
                 reply = await self.bus.request(
                     subjects.TASKS_EMBEDDING_FOR_QUERY,
                     to_json_bytes(embed_task),
                     timeout=self.bus_config.request_timeout_embed_s,
-                    headers=trace)
+                    headers={**trace, frames.ACCEPT_FRAME_HEADER: "1"})
             except TimeoutError as e:
                 return 503, resp([], f"Failed to get embedding from preprocessing service: {e}")
-            embed_result = from_json(QueryEmbeddingResult, reply.data)
-            if embed_result.error_message or embed_result.embedding is None:
-                return 500, resp([], embed_result.error_message
-                                 or "embedding service returned no embedding")
+            reply_json, reply_rows = frames.detach_frame(reply.data,
+                                                         reply.headers)
+            embed_result = from_json(QueryEmbeddingResult, reply_json)
+            if embed_result.error_message:
+                return 500, resp([], embed_result.error_message)
+            query_embedding = (reply_rows[0].tolist()
+                               if reply_rows is not None and len(reply_rows)
+                               else embed_result.embedding)
+            if not query_embedding:
+                # None OR empty: `embedding: []` is a legal frame-mode body,
+                # so a reply whose frame went missing must fail clean here,
+                # not as an opaque store shape error two hops later
+                return 500, resp([], "embedding service returned no embedding")
 
             search_task = SemanticSearchNatsTask(
                 request_id=request_id,
-                query_embedding=embed_result.embedding,
+                query_embedding=query_embedding,
                 top_k=req.top_k)
             try:
                 reply = await self.bus.request(

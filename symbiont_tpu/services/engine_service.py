@@ -40,6 +40,11 @@ from symbiont_tpu.engine.engine import TpuEngine
 from symbiont_tpu.schema import TokenizedTextMessage, from_dict
 from symbiont_tpu.schema import frames
 from symbiont_tpu.services.base import Service
+from symbiont_tpu.services.coalesce import (
+    UpsertCoalescer,
+    store_executor,
+    upsert_rows_or_points,
+)
 from symbiont_tpu.utils.telemetry import child_headers, metrics, span
 
 log = logging.getLogger(__name__)
@@ -62,7 +67,9 @@ class EngineService(Service):
 
     def __init__(self, bus, engine: Optional[TpuEngine] = None,
                  batcher: Optional[MicroBatcher] = None, lm=None,
-                 lm_batcher=None, vector_store=None, graph_store=None):
+                 lm_batcher=None, vector_store=None, graph_store=None,
+                 coalesce: bool = True, coalesce_max_rows: int = 512,
+                 coalesce_max_age_ms: float = 25.0):
         super().__init__(bus)
         self.engine = engine
         self.batcher = batcher or (MicroBatcher(engine) if engine else None)
@@ -72,10 +79,27 @@ class EngineService(Service):
         self.graph_store = graph_store
         self._warm_task: Optional[asyncio.Task] = None
         self._warm_failed = False  # last warm errored → next upsert retries
+        # cross-REQUEST upsert coalescing (services/coalesce.py): the native
+        # vector_memory shells each batch points per request, but N workers
+        # × M in-flight requests still cost one store call (WAL fsync +
+        # lock round-trip) each — here they merge into one. The reply to
+        # each request is held until the flush carrying its rows commits,
+        # so the shells' ack-after-reply contract is ack-after-flush
+        # end to end.
+        self._upsert_coalescer: Optional[UpsertCoalescer] = (
+            UpsertCoalescer(self._store_upsert_rows,
+                            max_rows=coalesce_max_rows,
+                            max_age_ms=coalesce_max_age_ms, name=self.name)
+            if coalesce and vector_store is not None else None)
+
+    def _store_upsert_rows(self, ids, rows, payloads) -> int:
+        return upsert_rows_or_points(self.vector_store, ids, rows, payloads)
 
     async def start(self) -> None:
         if self.batcher:
             await self.batcher.start()
+        if self._upsert_coalescer is not None:
+            await self._upsert_coalescer.start()
         await super().start()
         self._spawn_fused_warm()
 
@@ -123,6 +147,8 @@ class EngineService(Service):
         if self._warm_task is not None:
             self._warm_task.cancel()
         await super().stop()
+        if self._upsert_coalescer is not None:
+            await self._upsert_coalescer.stop()
         if self.batcher:
             await self.batcher.close()
 
@@ -196,6 +222,15 @@ class EngineService(Service):
 
     async def _run_blocking(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(None, fn, *args)
+
+    async def _run_store(self, fn, *args):
+        """Blocking vector-store WRITES ride the dedicated bounded store
+        executor (services/coalesce.py): a WAL fsync or breaker-degraded
+        upsert must not steal default-pool threads from the embed forwards
+        running concurrently. Reads (search/count) stay on the default
+        pool — the latency path must not queue behind a bulk flush."""
+        return await asyncio.get_running_loop().run_in_executor(
+            store_executor(), fn, *args)
 
     # ------------------------------------------------------------- compute
 
@@ -323,18 +358,22 @@ class EngineService(Service):
                 points = [(p["id"], p["vector"], p.get("payload", {}))
                           for p in req["points"]]
             if rows is not None:
-                if hasattr(self.vector_store, "upsert_rows"):
-                    n = await self._run_blocking(
-                        self.vector_store.upsert_rows, ids, rows, payloads)
+                if self._upsert_coalescer is not None:
+                    # reply-after-flush: resolves once the coalesced store
+                    # call carrying THESE rows committed; a flush failure
+                    # surfaces as this request's typed error reply
+                    n = await self._upsert_coalescer.add(ids, rows, payloads,
+                                                         headers=msg.headers)
                 else:
-                    n = await self._run_blocking(
-                        self.vector_store.upsert, list(zip(ids, rows,
-                                                           payloads)))
+                    n = await self._run_store(
+                        self._store_upsert_rows, ids, rows, payloads)
             else:
-                n = await self._run_blocking(self.vector_store.upsert,
-                                             points)
+                # legacy per-point JSON form (reference-era callers): rare
+                # and small — straight through, no coalescing
+                n = await self._run_store(self.vector_store.upsert,
+                                          points)
             if self._fused_enabled() and (
-                    self._warm_failed or await self._run_blocking(
+                    self._warm_failed or await self._run_store(
                         self.vector_store.fused_warm_stale)):
                 # upserts crossed a capacity block (or the last warm failed):
                 # the fused executables are keyed by capacity, so the next

@@ -129,6 +129,25 @@ class PreprocessingService(Service):
             return
         try:
             vecs = await self.batcher.embed([task.text_to_embed])
+            if frames.wants_frame(msg.headers):
+                # negotiated reply frame (X-Symbiont-Accept-Frame): the
+                # [1, dim] block rides appended to a schema-valid reply
+                # whose embedding list is empty — no per-float JSON on the
+                # reply hop. Requesters that never sent the header (the
+                # reference-era C++ gateway included) keep getting float
+                # lists below.
+                arr = np.ascontiguousarray(
+                    np.asarray(vecs[:1], np.float32))
+                result = QueryEmbeddingResult(
+                    request_id=task.request_id, embedding=[],
+                    model_name=self.model_name, error_message=None)
+                data, fheaders = frames.attach_frame(to_json_bytes(result),
+                                                     arr)
+                await self.bus.publish(
+                    msg.reply, data,
+                    headers={**child_headers(msg.headers), **fheaders})
+                metrics.inc("preprocessing.query_embeddings")
+                return
             result = QueryEmbeddingResult(
                 request_id=task.request_id,
                 embedding=np.asarray(vecs[0], np.float32).tolist(),

@@ -140,6 +140,62 @@ def test_zero_loss_under_handler_exceptions(tmp_path):
     asyncio.run(scenario())
 
 
+# ---------------------------------- fault class: crash under coalesced acks
+
+def test_zero_loss_with_coalesced_acks_under_handler_faults(tmp_path):
+    """Coalesced-ack semantics (services/coalesce.py) under chaos: rows
+    from many messages share one flush and each durable delivery acks only
+    after the flush carrying its rows commits. Injected handler crashes
+    redeliver through the coalescer — the full document set lands exactly
+    once (deterministic ids), and the coalescer demonstrably batched
+    multiple messages per store call while the faults fired."""
+    plan = FaultPlan(seed=15, rules=[
+        FaultRule(seam="handler", kind="error",
+                  match="vector_memory:data.text.with_embeddings", times=2)])
+    cfg = _stack_config(tmp_path,
+                        services="perception,preprocessing,vector_memory")
+    cfg.vector_store.coalesce_max_rows = 8
+    cfg.vector_store.coalesce_max_age_ms = 100.0
+    expected = N_DOCS * SENTENCES_PER_DOC
+    from symbiont_tpu.utils.telemetry import metrics
+
+    labels = {"service": "vector_memory"}
+    msgs0 = metrics.get("coalesce.messages", labels=labels)
+    rows0 = metrics.get("coalesce.rows", labels=labels)
+
+    async def scenario():
+        bus = InprocBus()
+        stack = SymbiontStack(cfg, bus=bus, engine=_StubEngine(),
+                              fetcher=lambda url: PAGE)
+        await stack.start()
+        try:
+            with plan.activate():
+                await _ingest_docs(bus)
+                ok = await _wait_for(
+                    lambda: stack.vector_store.count() >= expected)
+            assert ok, (f"lost ingest under coalesced acks: "
+                        f"{stack.vector_store.count()}/{expected} points")
+            assert stack.vector_store.count() == expected
+            assert plan.fired[("handler", "error")] == 2
+            assert bus.stats["redelivered"] >= 2
+            assert len(bus.dlq) == 0
+            # the coalescer really carried the load: every message went
+            # through it, and at least one flush batched several messages
+            assert metrics.get("coalesce.messages",
+                               labels=labels) - msgs0 == N_DOCS
+            assert metrics.get("coalesce.rows",
+                               labels=labels) - rows0 == expected
+            flush_hist = metrics.histogram_summary("coalesce.flush_rows",
+                                                   labels=labels)
+            assert flush_hist is not None and flush_hist["max"] >= \
+                2 * SENTENCES_PER_DOC, flush_hist
+        finally:
+            await stack.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
 # ------------------------------------------------ fault class: handler hang
 
 def test_zero_loss_under_handler_hang_past_timeout(tmp_path):

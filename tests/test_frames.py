@@ -502,3 +502,189 @@ def test_cpp_frame_parity():
         noframe = subprocess.run([str(exe)], input=body,
                                  capture_output=True, timeout=60)
         assert noframe.stdout.decode().strip() == "noframe"
+
+
+# ------------------------------------------------ reply-frame negotiation
+
+
+def test_lazy_decode_matches_dataclass_decode():
+    """decode_embeddings_lazy sees the same data as the dataclass decoder
+    on BOTH wire forms — just without the per-sentence object churn."""
+    sentences, vectors = _sample_args()
+    for use_frame in (True, False):
+        data, headers = frames.encode_embeddings_message(
+            "doc-l", "http://d", sentences, vectors, "m", 77,
+            use_frame=use_frame)
+        m, rows = frames.decode_embeddings_message(data, headers)
+        lazy = frames.decode_embeddings_lazy(data, headers)
+        assert lazy.original_id == m.original_id == "doc-l"
+        assert lazy.source_url == m.source_url
+        assert lazy.model_name == m.model_name
+        assert lazy.timestamp_ms == m.timestamp_ms == 77
+        assert lazy.sentences == [e.sentence_text
+                                  for e in m.embeddings_data] == sentences
+        np.testing.assert_allclose(lazy.rows, vectors, rtol=1e-6)
+        if use_frame:
+            # the frame path hands back the SAME zero-copy view
+            assert rows is not None
+            np.testing.assert_array_equal(lazy.rows, rows)
+
+
+def test_lazy_decode_rejects_mismatch_and_ragged():
+    sentences, vectors = _sample_args()
+    data, headers = frames.encode_embeddings_message(
+        "doc-m", "http://d", sentences, vectors, "m", 1, use_frame=True)
+    # frame row count vs sentence count mismatch
+    body = json.loads(data[:frames.frame_offset(headers)])
+    body["embeddings_data"] = body["embeddings_data"][:-1]
+    prefix = json.dumps(body, separators=(",", ":")).encode()
+    bad = prefix + data[frames.frame_offset(headers):]
+    bad_headers = {frames.FRAME_HEADER: f"tensor/f32;off={len(prefix)}"}
+    with pytest.raises(frames.FrameError):
+        frames.decode_embeddings_lazy(bad, bad_headers)
+    # ragged JSON-fallback embedding lists cannot form one block
+    ragged = json.dumps({
+        "original_id": "x", "source_url": "u", "model_name": "m",
+        "timestamp_ms": 1, "embeddings_data": [
+            {"sentence_text": "a", "embedding": [1.0, 2.0]},
+            {"sentence_text": "b", "embedding": [1.0]}]}).encode()
+    with pytest.raises(Exception):
+        frames.decode_embeddings_lazy(ragged, None)
+
+
+def test_query_embedding_reply_frame_negotiation():
+    """tasks.embedding.for_query reply path: an X-Symbiont-Accept-Frame
+    requester gets a schema-valid reply with an EMPTY embedding list and
+    the [1, dim] block appended as a frame; a requester without the header
+    (a reference-era peer) still gets the float-list reply — and both
+    decode to the same vector."""
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.schema import (
+        QueryEmbeddingResult,
+        QueryForEmbeddingTask,
+        to_json_bytes,
+    )
+    from symbiont_tpu.services.preprocessing import PreprocessingService
+
+    class _StubEngine:
+        def __init__(self):
+            self.config = EngineConfig(embedding_dim=8, max_batch=8,
+                                       flush_deadline_ms=2.0)
+
+        def embed_texts(self, texts):
+            return np.asarray([[float(len(t))] * 8 for t in texts],
+                              np.float32)
+
+    async def scenario():
+        bus = InprocBus()
+        svc = PreprocessingService(bus, _StubEngine())
+        await svc.start()
+        try:
+            task = to_json_bytes(QueryForEmbeddingTask(
+                request_id="r1", text_to_embed="hello"))
+            # frame-capable requester
+            reply = await bus.request(
+                subjects.TASKS_EMBEDDING_FOR_QUERY, task, timeout=5.0,
+                headers={frames.ACCEPT_FRAME_HEADER: "1"})
+            json_part, rows = frames.detach_frame(reply.data, reply.headers)
+            res = from_json(QueryEmbeddingResult, json_part)
+            assert res.error_message is None and res.embedding == []
+            assert rows is not None and rows.shape == (1, 8)
+            np.testing.assert_array_equal(rows[0], [5.0] * 8)
+            # reference-era requester: no header, float-list reply
+            reply = await bus.request(subjects.TASKS_EMBEDDING_FOR_QUERY,
+                                      task, timeout=5.0)
+            json_part, rows = frames.detach_frame(reply.data, reply.headers)
+            assert rows is None
+            res = from_json(QueryEmbeddingResult, json_part)
+            assert res.embedding == [5.0] * 8
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
+def test_api_two_hop_search_decodes_frame_reply(tmp_path):
+    """The Python gateway's 2-hop fallback opts into the reply frame and
+    the search still returns correct hits end-to-end (api → preprocessing
+    frame reply → vector_memory search)."""
+    import urllib.request
+
+    from symbiont_tpu.config import (
+        ApiConfig,
+        GraphStoreConfig,
+        SymbiontConfig,
+        TextGeneratorConfig,
+        VectorStoreConfig,
+    )
+    from symbiont_tpu.runner import SymbiontStack
+
+    class _StubEngine:
+        class _ModelCfg:
+            hidden_size = 8
+
+        def __init__(self):
+            from symbiont_tpu.config import EngineConfig
+
+            self.config = EngineConfig(embedding_dim=8, max_batch=8,
+                                       flush_deadline_ms=2.0)
+            self.model_cfg = self._ModelCfg()
+            self.cross_params = None
+            self.stats = {"embed_calls": 0, "compiles": 0}
+
+        def embed_texts(self, texts):
+            # deterministic unit vectors keyed by first word length
+            out = np.zeros((len(texts), 8), np.float32)
+            for i, t in enumerate(texts):
+                out[i, min(7, len(t.split()[0]))] = 1.0
+            return out
+
+    page = ("<html><body><main><p>Alpha beta gamma.</p>"
+            "<p>Delta epsilon zeta.</p></main></body></html>")
+    cfg = SymbiontConfig(
+        vector_store=VectorStoreConfig(dim=8,
+                                       data_dir=str(tmp_path / "vs"),
+                                       shard_capacity=64),
+        graph_store=GraphStoreConfig(data_dir=str(tmp_path / "gs")),
+        text_generator=TextGeneratorConfig(markov_state_path=None),
+        api=ApiConfig(host="127.0.0.1", port=0, fused_search=False),
+    )
+    cfg.runner.services = ("perception,preprocessing,vector_memory,"
+                           "knowledge_graph,text_generator,api")
+
+    async def scenario():
+        stack = SymbiontStack(cfg, bus=InprocBus(), engine=_StubEngine(),
+                              fetcher=lambda url: page)
+        await stack.start()
+        loop = asyncio.get_running_loop()
+        port = stack.api.port
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/submit-url",
+                data=json.dumps({"url": "http://fake/doc"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            assert (await loop.run_in_executor(
+                None, lambda: urllib.request.urlopen(req, timeout=10))
+                ).status == 200
+            for _ in range(200):
+                if stack.vector_store.count() >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert stack.vector_store.count() >= 2
+            sreq = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/search/semantic",
+                data=json.dumps({"query_text": "alpha beta",
+                                 "top_k": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            body = json.loads((await loop.run_in_executor(
+                None, lambda: urllib.request.urlopen(sreq, timeout=10))
+                ).read())
+            assert body["error_message"] is None
+            assert len(body["results"]) == 2
+        finally:
+            await stack.stop()
+
+    asyncio.run(scenario())

@@ -143,11 +143,12 @@ _FLOAT_LIST = re.compile(r"\[\s*float\(")
 _SCOPE = re.compile(r"^(\s*)(?:(?:async\s+)?def|class)\s+(\w+)")
 
 
-def _float_list_sites():
-    """(file, dotted-scope-path) for every `[float(` in services/ — an
+def _pattern_sites(pattern: re.Pattern):
+    """(file, dotted-scope-path) for every `pattern` hit in services/ — an
     indent stack qualifies nested scopes (`EngineService._rerank.op`), so
     allowlist entries name one exact site, not every handler's inner
-    `op`."""
+    `op`. Comment lines are skipped: a ban is about code, and the docs
+    that EXPLAIN the ban must be allowed to name it."""
     sites = set()
     for f in sorted((REPO / "symbiont_tpu" / "services").glob("*.py")):
         stack: list = []  # (indent, name)
@@ -158,10 +159,16 @@ def _float_list_sites():
                 while stack and stack[-1][0] >= indent:
                     stack.pop()
                 stack.append((indent, m.group(2)))
-            if _FLOAT_LIST.search(line):
+            if line.lstrip().startswith("#"):
+                continue
+            if pattern.search(line):
                 path = ".".join(n for _, n in stack) or "<module>"
                 sites.add((str(f.relative_to(REPO)), path))
     return sites
+
+
+def _float_list_sites():
+    return _pattern_sites(_FLOAT_LIST)
 
 
 def test_no_per_float_conversion_on_message_paths():
@@ -179,6 +186,35 @@ def test_float_list_allowlist_entries_still_exist():
     so the guard stays tight."""
     stale = FLOAT_LIST_ALLOWED - _float_list_sites()
     assert not stale, f"FLOAT_LIST_ALLOWED entries no longer present: {stale}"
+
+
+# --------------------------------------------------------------------------
+# Object-churn guard: `dataclasses.asdict` recursively materializes a dict
+# per field per call — on the ingest hot-path services that was exactly the
+# per-message churn the zero-churn decode removed (vector_memory built one
+# QdrantPointPayload dataclass + asdict dict PER SENTENCE). Payload dicts on
+# message paths are built directly now (their keys pinned by
+# tests/test_store_wire_fixtures.py); anything re-introducing asdict inside
+# services/ shows up here. `dataclasses.replace` stays fine — it is O(1)
+# per call and carries no per-row cost.
+
+ASDICT_ALLOWED: set = set()  # no current site may use it; keep it that way
+
+_ASDICT = re.compile(r"\basdict\s*\(")
+
+
+def test_no_dataclass_asdict_on_ingest_services():
+    offenders = _pattern_sites(_ASDICT) - ASDICT_ALLOWED
+    assert not offenders, (
+        "dataclasses.asdict on a services/ message path — per-message "
+        "dict churn the zero-churn ingest decode removed (schema/frames "
+        "decode_embeddings_lazy + direct payload dict build). Build the "
+        f"dict directly instead: {sorted(offenders)}")
+
+
+def test_asdict_allowlist_entries_still_exist():
+    stale = ASDICT_ALLOWED - _pattern_sites(_ASDICT)
+    assert not stale, f"ASDICT_ALLOWED entries no longer present: {stale}"
 
 
 def test_scanner_sees_known_ground_truth():
