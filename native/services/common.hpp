@@ -9,6 +9,7 @@
 #pragma once
 
 #include <array>
+#include <cctype>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -268,6 +269,53 @@ inline const char* ACCEPT_FRAME_HEADER = "X-Symbiont-Accept-Frame";
 constexpr size_t FRAME_HDR_LEN = 16;
 constexpr uint8_t FRAME_VERSION = 1;
 constexpr uint8_t FRAME_DTYPE_F32 = 1;
+// IEEE half rows — half the bytes/embedding (mirror of frames.DTYPE_F16).
+// A dtype byte outside this set throws on decode: the delivery stays
+// unacked for redelivery/DLQ instead of being misparsed.
+constexpr uint8_t FRAME_DTYPE_F16 = 2;
+
+inline size_t frame_elem_size(uint8_t dtype) {
+  if (dtype == FRAME_DTYPE_F32) return 4;
+  if (dtype == FRAME_DTYPE_F16) return 2;
+  throw std::runtime_error("unsupported frame dtype " +
+                           std::to_string((int)dtype));
+}
+
+// IEEE 754 binary16 → binary32 (bit-exact, subnormals and inf/nan
+// included) — the decode half of the f16 wire form. The ENCODE direction
+// never runs in C++: the shells either forward raw f16 payload bytes
+// (vector_memory) or re-slice an engine reply that was already f16
+// (preprocessing requested the frame16 encoding), so no C++ rounding mode
+// can ever disagree with numpy's.
+inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t mant = h & 0x3FFu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;  // ±0
+    } else {
+      // subnormal half (value = mant·2⁻²⁴) → normalized float: after s
+      // left-shifts the implicit bit lands, so the unbiased exponent is
+      // −14−s and the float field is 127−14−s = 113−s
+      int shift = 0;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FFu;
+      bits = sign | ((uint32_t)(113 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1Fu) {
+    bits = sign | 0x7F800000u | (mant << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, sizeof out);
+  return out;
+}
 
 inline void put_u16le(std::string& out, uint16_t v) {
   out.push_back((char)(v & 0xff));
@@ -284,33 +332,39 @@ inline uint32_t get_u32le(const char* p) {
          (uint32_t)(unsigned char)p[3] << 24;
 }
 
-// Header + raw payload (raw_f32 must hold rows*cols little-endian floats).
-inline std::string make_frame(const std::string& raw_f32, uint32_t rows,
-                              uint32_t cols) {
-  if (raw_f32.size() != (size_t)rows * cols * sizeof(float))
+// Header + raw payload (`raw` must hold rows*cols little-endian elements
+// of `dtype` — 4 bytes each for f32, 2 for f16).
+inline std::string make_frame(const std::string& raw, uint32_t rows,
+                              uint32_t cols,
+                              uint8_t dtype = FRAME_DTYPE_F32) {
+  if (raw.size() != (size_t)rows * cols * frame_elem_size(dtype))
     throw std::runtime_error("frame payload size mismatch");
   std::string out;
-  out.reserve(FRAME_HDR_LEN + raw_f32.size());
+  out.reserve(FRAME_HDR_LEN + raw.size());
   out += "SYTF";
   out.push_back((char)FRAME_VERSION);
-  out.push_back((char)FRAME_DTYPE_F32);
+  out.push_back((char)dtype);
   put_u16le(out, 0);  // reserved
   put_u32le(out, rows);
   put_u32le(out, cols);
-  out += raw_f32;
+  out += raw;
   return out;
 }
 
-inline std::string frame_header_value(size_t json_len) {
-  return "tensor/f32;off=" + std::to_string(json_len);
+inline std::string frame_header_value(size_t json_len,
+                                      uint8_t dtype = FRAME_DTYPE_F32) {
+  return std::string(dtype == FRAME_DTYPE_F16 ? "tensor/f16" : "tensor/f32")
+      + ";off=" + std::to_string(json_len);
 }
 
 // View into a frame-bearing body (payload points INTO the body string).
 struct FrameView {
   uint32_t rows = 0;
   uint32_t cols = 0;
+  uint8_t dtype = FRAME_DTYPE_F32;
   const char* payload = nullptr;
   size_t payload_len = 0;
+  size_t elem_size() const { return frame_elem_size(dtype); }
 };
 
 // Split a possibly-frame-bearing body. Returns false (json_part = whole
@@ -325,7 +379,7 @@ inline bool split_frame(const std::map<std::string, std::string>& headers,
     return false;
   }
   const std::string& v = it->second;
-  if (v.rfind("tensor/f32", 0) != 0)
+  if (v.rfind("tensor/f32", 0) != 0 && v.rfind("tensor/f16", 0) != 0)
     throw std::runtime_error("unknown frame content type: " + v);
   auto off_pos = v.find("off=");
   if (off_pos == std::string::npos)
@@ -338,36 +392,61 @@ inline bool split_frame(const std::map<std::string, std::string>& headers,
     throw std::runtime_error("bad frame magic");
   if ((uint8_t)p[4] != FRAME_VERSION)
     throw std::runtime_error("unsupported frame version");
-  if ((uint8_t)p[5] != FRAME_DTYPE_F32)
+  if ((uint8_t)p[5] != FRAME_DTYPE_F32 && (uint8_t)p[5] != FRAME_DTYPE_F16)
     throw std::runtime_error("unsupported frame dtype");
+  frame.dtype = (uint8_t)p[5];
   frame.rows = get_u32le(p + 8);
   frame.cols = get_u32le(p + 12);
   frame.payload = p + FRAME_HDR_LEN;
-  frame.payload_len = (size_t)frame.rows * frame.cols * sizeof(float);
+  frame.payload_len = (size_t)frame.rows * frame.cols * frame.elem_size();
   if ((size_t)off + FRAME_HDR_LEN + frame.payload_len > body.size())
     throw std::runtime_error("frame payload truncated");
   json_part.assign(body.data(), (size_t)off);
   return true;
 }
 
-// Frame payload → [rows][cols] float rows (memcpy per row, no text parse).
+// Frame payload → [rows][cols] float rows (f32: memcpy per row; f16:
+// bit-exact upconvert per element — no text parse either way).
 inline std::vector<std::vector<float>> frame_rows(const FrameView& f) {
   std::vector<std::vector<float>> rows(f.rows);
   for (uint32_t i = 0; i < f.rows; ++i) {
     rows[i].resize(f.cols);
-    std::memcpy(rows[i].data(), f.payload + (size_t)i * f.cols * sizeof(float),
-                f.cols * sizeof(float));
+    if (f.dtype == FRAME_DTYPE_F16) {
+      const char* src = f.payload + (size_t)i * f.cols * 2;
+      for (uint32_t j = 0; j < f.cols; ++j) {
+        uint16_t h = (uint16_t)(unsigned char)src[2 * j] |
+                     (uint16_t)(unsigned char)src[2 * j + 1] << 8;
+        rows[i][j] = half_to_float(h);
+      }
+    } else {
+      std::memcpy(rows[i].data(),
+                  f.payload + (size_t)i * f.cols * sizeof(float),
+                  f.cols * sizeof(float));
+    }
   }
   return rows;
 }
 
-// Frames deployment knob, mirror of schema.frames.frames_enabled (default
-// ON; set SYMBIONT_FRAMES=0 when a reference-era JSON-only peer shares the
-// pub/sub subjects).
-inline bool frames_enabled() {
+// Frames deployment knob, mirror of schema.frames.frames_mode: 0 = off
+// (reference wire JSON), FRAME_DTYPE_F32 = default frames, FRAME_DTYPE_F16
+// = half-width frames (SYMBIONT_FRAMES=f16 — deploy only when every
+// consumer on the subject decodes dtype 2).
+inline uint8_t frames_mode() {
   std::string v = env_or("SYMBIONT_FRAMES", "");
-  return !(v == "0" || v == "false" || v == "no" || v == "off");
+  // normalize exactly like frames.frames_mode (strip + lowercase): the two
+  // halves of one deployment knob must read "OFF" / " f16" / "off\r\n"
+  // (CRLF env files) identically — strip ALL whitespace, like str.strip()
+  const char* ws = " \t\r\n\f\v";
+  size_t a = v.find_first_not_of(ws);
+  size_t b = v.find_last_not_of(ws);
+  v = (a == std::string::npos) ? "" : v.substr(a, b - a + 1);
+  for (char& c : v) c = (char)std::tolower((unsigned char)c);
+  if (v == "0" || v == "false" || v == "no" || v == "off") return 0;
+  if (v == "f16") return FRAME_DTYPE_F16;
+  return FRAME_DTYPE_F32;
 }
+
+inline bool frames_enabled() { return frames_mode() != 0; }
 
 // Decode an engine embed reply into [n][dim] float rows. Accepts either the
 // compact b64 form ({"vectors_b64", "count", "dim"}) or the plain JSON

@@ -90,10 +90,14 @@ int main() try {
   uint32_t max_deliver = (uint32_t)std::atoi(
       symbiont::env_or("SYMBIONT_BUS_DURABLE_MAX_DELIVER", "5").c_str());
   // binary tensor frames (common.hpp / schema/frames.py): ask the engine
-  // for frame replies and publish data.text.with_embeddings with the f32
-  // block attached — floats never pass through text. SYMBIONT_FRAMES=0
-  // restores the reference-era JSON wire for old downstream peers.
-  bool use_frames = symbiont::frames_enabled();
+  // for frame replies and publish data.text.with_embeddings with the
+  // float block attached — floats never pass through text. SYMBIONT_FRAMES
+  // =0 restores the reference-era JSON wire for old downstream peers;
+  // =f16 negotiates the half-width dtype from the ENGINE (frame16
+  // encoding) and forwards those raw bytes — this shell never converts
+  // floats, it re-slices whatever dtype the engine framed.
+  uint8_t fmode = symbiont::frames_mode();
+  bool use_frames = fmode != 0;
 
   symbus::Client bus;
   if (!symbiont::connect_with_retry(bus, SERVICE)) return 1;
@@ -139,9 +143,12 @@ int main() try {
       }
       json::Value req = json::Value::object();
       req.set("texts", std::move(texts));
-      // an old engine ignores the unknown "frame" encoding and replies
-      // with JSON float lists — complete() accepts every reply form
-      req.set("encoding", json::Value(use_frames ? "frame" : "b64"));
+      // an old engine ignores the unknown "frame"/"frame16" encoding and
+      // replies with JSON float lists — complete() accepts every reply form
+      req.set("encoding",
+              json::Value(!use_frames ? "b64"
+                          : fmode == symbiont::FRAME_DTYPE_F16 ? "frame16"
+                                                               : "frame"));
       std::string inbox = "_INBOX." + symbiont::uuid4();
       uint32_t sid = bus.subscribe(inbox);
       batch.deadline_ms = symbiont::now_ms() + (uint64_t)engine_timeout_ms;
@@ -199,15 +206,16 @@ int main() try {
       if (publish_frame) {
         std::string body = out.to_json_string();
         size_t dim = fv.cols;
-        std::string raw(fv.payload + off * dim * sizeof(float),
-                        d.sentences.size() * dim * sizeof(float));
+        size_t elem = fv.elem_size();  // 4 (f32) or 2 (negotiated f16)
+        std::string raw(fv.payload + off * dim * elem,
+                        d.sentences.size() * dim * elem);
         auto headers = d.headers;
         headers[symbiont::FRAME_HEADER] =
-            symbiont::frame_header_value(body.size());
+            symbiont::frame_header_value(body.size(), fv.dtype);
         bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,
                     body + symbiont::make_frame(
                                raw, (uint32_t)d.sentences.size(),
-                               (uint32_t)dim),
+                               (uint32_t)dim, fv.dtype),
                     "", headers);
       } else {
         bus.publish(symbiont::subjects::DATA_TEXT_WITH_EMBEDDINGS,

@@ -39,15 +39,17 @@ const char* SERVICE = "vector_memory";
 using symbiont::engine_call;
 
 // A parsed document whose points are waiting for (or riding in) an upsert.
-// The vectors are held as RAW little-endian f32 bytes regardless of which
-// wire form delivered them (tensor frame: a straight copy of the payload;
-// legacy JSON: packed once at parse) — dispatch never touches floats again.
+// The vectors are held as RAW little-endian bytes in the dtype the wire
+// delivered (tensor frame: a straight copy of the payload — f32 or the
+// half-width f16; legacy JSON: packed f32 once at parse) — dispatch never
+// touches floats again.
 struct PendingDoc {
   symbus::BusMsg delivery;
   symbiont::TextWithEmbeddingsMessage m;
   std::map<std::string, std::string> headers;
-  std::string raw_vectors;  // m.embeddings_data.size() * dim f32le values
+  std::string raw_vectors;  // m.embeddings_data.size() * dim elements
   size_t dim = 0;
+  uint8_t dtype = symbiont::FRAME_DTYPE_F32;
   // set after a coalesced upsert failed: retry this doc in its own request
   // so one poison doc (e.g. dim mismatch) cannot dead-letter the healthy
   // docs batched with it
@@ -125,6 +127,7 @@ int main() try {
     while (inflight.size() < max_inflight && !ready.empty()) {
       InflightUpsert batch;
       size_t dim = 0;
+      uint8_t dtype = symbiont::FRAME_DTYPE_F32;
       json::Value ids = json::Value::array();
       json::Value payloads = json::Value::array();
       std::string raw;
@@ -132,11 +135,13 @@ int main() try {
         PendingDoc& d = ready.front();
         size_t pts = d.m.embeddings_data.size();
         if (!batch.docs.empty() &&
-            (d.solo || batch.total_points + pts > max_batch_points))
+            (d.solo || batch.total_points + pts > max_batch_points ||
+             d.dtype != dtype))  // dtype-pure batches: one frame, one form
           break;
         bool was_solo = d.solo;
         uint64_t now = symbiont::now_ms();
         if (dim == 0) dim = d.dim;
+        if (batch.docs.empty()) dtype = d.dtype;
         for (size_t order = 0; order < pts; ++order) {
           const auto& se = d.m.embeddings_data[order];
           symbiont::QdrantPointPayload payload;
@@ -168,15 +173,31 @@ int main() try {
       // the frame path requires a consistent block (mixed-dim docs
       // coalesced together cannot frame); the b64 fallback ships the
       // same bytes and lets the ENGINE reject the mismatch, which routes
-      // the batch through the per-doc solo-retry isolation below
+      // the batch through the per-doc solo-retry isolation below. A batch
+      // is dtype-pure by construction (the pop loop breaks on mismatch),
+      // so the frame forwards f16 payloads at half width untouched; the
+      // b64 form is an f32 contract, so a non-framable f16 batch upcasts
+      // once here (rare: only mixed-dim f16 docs take this path).
       if (use_frames &&
-          raw.size() == (size_t)batch.total_points * dim * sizeof(float)) {
+          raw.size() == (size_t)batch.total_points * dim *
+                            symbiont::frame_elem_size(dtype)) {
         std::string body = req.dump();
         headers[symbiont::FRAME_HEADER] =
-            symbiont::frame_header_value(body.size());
+            symbiont::frame_header_value(body.size(), dtype);
         data = body + symbiont::make_frame(
-                          raw, (uint32_t)batch.total_points, (uint32_t)dim);
+                          raw, (uint32_t)batch.total_points, (uint32_t)dim,
+                          dtype);
       } else {
+        if (dtype == symbiont::FRAME_DTYPE_F16) {
+          std::string wide(raw.size() * 2, '\0');
+          for (size_t i = 0; i * 2 < raw.size(); ++i) {
+            uint16_t h = (uint16_t)(unsigned char)raw[2 * i] |
+                         (uint16_t)(unsigned char)raw[2 * i + 1] << 8;
+            float f = symbiont::half_to_float(h);
+            std::memcpy(&wide[i * 4], &f, 4);
+          }
+          raw = std::move(wide);
+        }
         req.set("vectors_b64",
                 json::Value(symbiont::b64_encode(
                     (const unsigned char*)raw.data(), raw.size())));
@@ -275,6 +296,7 @@ int main() try {
                 "frame holds " + std::to_string(fv.rows) + " rows for " +
                 std::to_string(d.m.embeddings_data.size()) + " sentences");
           d.dim = fv.cols;
+          d.dtype = fv.dtype;  // forwarded as-is (f16 stays half-width)
           d.raw_vectors.assign(fv.payload, fv.payload_len);
         } else {
           for (const auto& se : d.m.embeddings_data) {

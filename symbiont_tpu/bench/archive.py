@@ -30,7 +30,15 @@ import re
 from typing import Dict, List, Optional
 
 # line-level string fields (everything else non-listed must be numeric)
-_STRING_FIELDS = {"metric", "unit", "semantic_validation"}
+_STRING_FIELDS = {"metric", "unit", "semantic_validation",
+                  # explanatory note archived alongside a null ratio when
+                  # the same-run prerequisite metric is absent (bench/e2e.py
+                  # bulk_ratio_fields)
+                  "e2e_ingest_vs_bulk_note"}
+# fields that may archive as an explicit null ("measured nothing, and here
+# is why" — the paired _note says why); everything else numeric stays
+# non-null so a silent None can never masquerade as a measurement
+_NULLABLE_FIELDS = {"e2e_ingest_vs_bulk_x"}
 _LIST_OF_STR_FIELDS = {"primary_metrics"}
 # driver wrapper shape: {n, cmd, rc, tail, parsed} with parsed possibly null
 _WRAPPER_FIELDS = {"n", "cmd", "rc", "tail", "parsed"}
@@ -128,6 +136,8 @@ def validate_line(d: dict) -> List[str]:
                             f"{type(d[key]).__name__}")
     for key, v in d.items():
         if key in _REQUIRED:
+            continue
+        if v is None and key in _NULLABLE_FIELDS:
             continue
         if key in _STRING_FIELDS:
             if not isinstance(v, str):

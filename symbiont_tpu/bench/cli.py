@@ -198,6 +198,7 @@ def main(argv=None) -> int:
     from symbiont_tpu.bench import compute  # noqa: F401
     from symbiont_tpu.bench import engine_plane  # noqa: F401
     from symbiont_tpu.bench import decode  # noqa: F401
+    from symbiont_tpu.bench import quant  # noqa: F401
     from symbiont_tpu.bench import e2e  # noqa: F401
     from symbiont_tpu.bench import chaos  # noqa: F401
 

@@ -145,6 +145,16 @@ def render_doc(r: dict, source_name: str) -> str:
              f"{f['ser_json_bytes_per_emb']} bytes/embedding, 384-d) — "
              "deterministic, gated",
              f"**{f['ser_frame_vs_json_bytes_x']}× smaller**"),
+        ]
+        if "ser_frame16_vs_json_bytes_x" in f:
+            rows += [
+                ("`ser_frame16_vs_json_bytes_x`",
+                 "the same hop in the half-width f16 frame form "
+                 f"({f['ser_frame16_bytes_per_emb']} bytes/embedding; "
+                 "SYMBIONT_FRAMES=f16, docs/QUANTIZATION.md)",
+                 f"**{f['ser_frame16_vs_json_bytes_x']}× smaller**"),
+            ]
+        rows += [
             ("`ser_frame_roundtrip_emb_per_s`",
              "host-side encode+decode of the same hop, frame vs JSON "
              f"(JSON: {f['ser_json_roundtrip_emb_per_s']}"
@@ -152,6 +162,20 @@ def render_doc(r: dict, source_name: str) -> str:
              "host core, informational",
              f"{f['ser_frame_roundtrip_emb_per_s']}"
              f"{rng('ser_frame_roundtrip_emb_per_s')} emb/s"),
+        ]
+    if "quant_embed_int8_vs_bf16_x" in f:
+        rows += [
+            ("`quant_embed_int8_vs_bf16_x`",
+             "quant tier: mixed-length embed throughput, int8 weights vs "
+             f"the f32-at-rest baseline, same geometry/corpus/run "
+             f"(parity cos {f['quant_embed_cos_int8']} ≥ 0.999, gated)",
+             f"**{f['quant_embed_int8_vs_bf16_x']}×**"),
+            ("`quant_decode_int8kv_vs_bf16_x`",
+             "quant tier: batched greedy decode tok/s with the int8 KV "
+             f"cache vs the dtype-native cache "
+             f"({f.get('quant_kv_bytes_x', '—')}× rows per HBM byte; "
+             f"greedy match {f.get('quant_kv_greedy_match_pct', '—')}%)",
+             f"**{f['quant_decode_int8kv_vs_bf16_x']}×**"),
         ]
     # --- tier 2: full-stack (what a user of the running stack sees) ------
     if "e2e_search_p50_ms" in f:
@@ -376,6 +400,7 @@ vs the JSON-equivalent bytes they displaced, plus encode/decode seconds.
 {ser_measured}
 """
 
+    quant_section = _render_quant(f)
     overlap_section = _render_overlap(f)
     attribution_section = _render_attribution(r, f)
 
@@ -487,7 +512,7 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{frames_section}{overlap_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{frames_section}{quant_section}{overlap_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.
@@ -536,6 +561,54 @@ co-located.
 
 
 _STAGE_KEY = re.compile(r"^(e2e_stage_(ingest|generate)_(.+)_pct)$")
+
+
+def _render_quant(f: dict) -> str:
+    """The quantization plane section: prose is archive-agnostic, the
+    measured paragraph appears once a run archives the quant tier."""
+    header = """## The quantization plane (int8/fp8 weights, int8 KV, f16 wire)
+
+Both remaining hot paths are bandwidth-bound, not FLOP-bound (embed MFU
+25.6%, TinyLlama decode HBM-bound), so the lever is bytes, not flops
+(docs/QUANTIZATION.md has the full knob/parity reference):
+
+- **Weights at rest** — `engine.quantize` / `lm.quantize` store rank-≥2
+  params as bf16 (`f16`), symmetric per-channel int8, or fp8 at load time
+  (`symbiont_tpu/models/quant.py`); dequant is algebraically fused into
+  the jitted matmuls (`(x @ q) * scale`), so XLA reads the narrow form
+  out of HBM and never materializes a dequantized copy.
+- **int8 KV cache** — `lm.kv_quant=int8` stores decode K/V as int8 with
+  one f32 scale per (position, head): quantize-on-append,
+  dequant-on-attend inside the compiled step. Sessions hold ~2× more
+  rows per HBM byte than bf16 slabs (~4× vs f32), reported live by the
+  dtype-labeled `lm.kv_cache_bytes` / `lm.kv_rows_per_gib` gauges.
+- **f16 wire** — the `SYTF` frame header's dtype byte grew a half-width
+  form (`SYMBIONT_FRAMES=f16`, per-hop `frame16` negotiation on the
+  engine plane), halving bytes/embedding on the three hot bus hops; the
+  store upcasts to f32 on ingest.
+
+Quality parity is a HARD BAR, enforced twice: tier-1 on tiny CPU models
+(cosine ≥ 0.999 vs the bf16 baseline for f16/int8 embeddings,
+rerank-order preservation, token-identical int8-KV greedy decode at f32)
+and re-measured at real geometry by the quant tier below.
+
+"""
+    if "quant_embed_int8_vs_bf16_x" not in f:
+        return header + (
+            "This archive predates the quant tier, so its measured fields "
+            "(`quant_embed_int8_vs_bf16_x`, `quant_decode_int8kv_vs_bf16_x`, "
+            "the `quant_embed_cos_*` parity cosines and `quant_kv_bytes_x`) "
+            "will appear — and gate — from the next full `python bench.py` "
+            "run.\n\n")
+    return header + (
+        f"Measured this run: int8 weights moved embed throughput "
+        f"**{f['quant_embed_int8_vs_bf16_x']}×** the bf16 baseline at "
+        f"parity cosine {f['quant_embed_cos_int8']} (f16 "
+        f"{f.get('quant_embed_cos_f16', '—')}, fp8 "
+        f"{f.get('quant_embed_cos_fp8', '—')}); the int8 KV cache decoded "
+        f"at **{f['quant_decode_int8kv_vs_bf16_x']}×** the dtype-native "
+        f"cache's tok/s while packing {f.get('quant_kv_bytes_x', '—')}× "
+        f"more rows per HBM byte.\n\n")
 
 
 def _render_overlap(f: dict) -> str:

@@ -45,12 +45,19 @@ def tier_serialization(results: dict, ctx) -> None:
     frame_data, frame_headers = frames.encode_embeddings_message(
         *args, use_frame=True)
     json_data, _ = frames.encode_embeddings_message(*args, use_frame=False)
+    f16_data, _ = frames.encode_embeddings_message(*args, use_frame=True,
+                                                   wire_dtype="f16")
 
     # deterministic byte accounting (the gated primary)
     results["ser_frame_bytes_per_emb"] = round(len(frame_data) / N_SENTS, 1)
     results["ser_json_bytes_per_emb"] = round(len(json_data) / N_SENTS, 1)
     results["ser_frame_vs_json_bytes_x"] = round(
         len(json_data) / len(frame_data), 2)
+    # half-width datapoint (quantization plane): the f16 wire form of the
+    # same hop — identical JSON metadata, 2-byte elements
+    results["ser_frame16_bytes_per_emb"] = round(len(f16_data) / N_SENTS, 1)
+    results["ser_frame16_vs_json_bytes_x"] = round(
+        len(json_data) / len(f16_data), 2)
     # the payload-only view (metadata — ids, sentence texts — is identical
     # in both forms, so this isolates what the floats themselves cost)
     meta_len = len(frame_data) - (
@@ -91,7 +98,9 @@ def tier_serialization(results: dict, ctx) -> None:
                  timed(json_roundtrip), digits=0)
 
     log(f"serialization: frame {results['ser_frame_bytes_per_emb']} B/emb "
+        f"(f16 {results['ser_frame16_bytes_per_emb']}) "
         f"vs JSON {results['ser_json_bytes_per_emb']} B/emb = "
-        f"{results['ser_frame_vs_json_bytes_x']}x smaller; round-trip "
+        f"{results['ser_frame_vs_json_bytes_x']}x "
+        f"({results['ser_frame16_vs_json_bytes_x']}x) smaller; round-trip "
         f"{results['ser_frame_roundtrip_emb_per_s']:.0f} vs "
         f"{results['ser_json_roundtrip_emb_per_s']:.0f} emb/s host-side")

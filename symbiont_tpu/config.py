@@ -22,6 +22,11 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, List, Optional
 
+# Weight-quantization modes (docs/QUANTIZATION.md). THE single source:
+# models/quant.py re-exports this as quant.MODES — defined here because
+# config must stay importable without jax (CPU-only doc rendering).
+QUANTIZE_MODES = ("none", "f16", "int8", "fp8")
+
 
 @dataclass
 class BusConfig:
@@ -91,6 +96,19 @@ class EngineConfig:
     # weights, embedder geometry) so the full rerank path works asset-free.
     cross_model_dir: Optional[str] = None
     rerank_enabled: bool = False
+    # Weight quantization at load time (models/quant.py, ROADMAP item 4):
+    # "none" keeps f32-at-rest storage; "f16" stores rank-≥2 params bf16
+    # (halves every weight read — the forward already computes bf16);
+    # "int8" / "fp8" store symmetric per-channel quantized kernels with
+    # dequant fused into the matmuls. Parity bars in docs/QUANTIZATION.md,
+    # gated by tests/test_quantization.py and the bench quant tier.
+    quantize: str = "none"
+
+    def __post_init__(self) -> None:
+        if self.quantize not in QUANTIZE_MODES:
+            raise ValueError(
+                f"engine.quantize must be one of {QUANTIZE_MODES}, "
+                f"got {self.quantize!r}")
 
 
 @dataclass
@@ -141,6 +159,19 @@ class LmConfig:
     # token streaming (events.text.generated.partial): decode in chunks of
     # this many tokens, emitting a text delta per chunk; 0 disables streaming
     stream_chunk: int = 16
+    # Weight quantization at load time (models/quant.py; same modes and
+    # parity bars as EngineConfig.quantize). Applied by _place_params on
+    # every parameter placement — including online fine-tune syncs, whose
+    # f32 masters re-quantize on each update_params. Single-device only:
+    # a TP mesh falls back to unquantized sharded placement with a warning.
+    quantize: str = "none"
+    # KV-cache storage for decode sessions: "none" keeps cfg.dtype slabs;
+    # "int8" stores per-(position, head)-scaled int8 K/V — quantize-on-
+    # append, dequant-on-attend inside the compiled decode step, so a
+    # session holds ~2× more rows per HBM byte vs bf16 (~4× vs f32) at the
+    # cost of ~0.4% K/V rounding (greedy-identity gate:
+    # tests/test_quantization.py).
+    kv_quant: str = "none"
     # online fine-tune over ingested text (train/online.py): the LM analog of
     # the Markov backend's continuous learning. Off by default — training
     # shares the device with serving.
@@ -157,6 +188,13 @@ class LmConfig:
             raise ValueError(
                 f"tensor_parallel must be auto|on|off, "
                 f"got {self.tensor_parallel!r}")
+        if self.quantize not in QUANTIZE_MODES:
+            raise ValueError(
+                f"lm.quantize must be one of {QUANTIZE_MODES}, "
+                f"got {self.quantize!r}")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"lm.kv_quant must be none|int8, got {self.kv_quant!r}")
         # the streaming decode loop runs whole chunks against a KV cache with
         # exactly new_bucket decode slots — a non-dividing chunk would scan
         # past the cache and rely on dynamic_update_slice clamp semantics

@@ -156,6 +156,19 @@ class TpuEngine:
             cross_cfg = dataclasses.replace(cross_cfg, dtype=self.config.dtype)
         if cross_cfg is not None and cross_cfg.attn_impl != attn_impl:
             cross_cfg = dataclasses.replace(cross_cfg, attn_impl=attn_impl)
+        if self.config.quantize != "none":
+            # ONCE on host, before device placement: rank-≥2 params become
+            # bf16 / per-channel int8 / fp8 at rest (models/quant.py), and
+            # the dequant is fused into the jitted forward — XLA reads the
+            # narrow representation out of HBM. Parity bars:
+            # docs/QUANTIZATION.md, gated in tests/test_quantization.py.
+            from symbiont_tpu.models import quant
+
+            params = quant.quantize_params(params, self.config.quantize)
+            if cross_params is not None:
+                cross_params = quant.quantize_params(cross_params,
+                                                     self.config.quantize)
+            log.info("engine params quantized: %s", self.config.quantize)
         self.model_cfg = model_cfg
         self.tokenizer = tokenizer or load_tokenizer(self.config.model_dir,
                                                      model_cfg.vocab_size)
@@ -213,6 +226,14 @@ class TpuEngine:
                       "rerank_calls": 0, "qsearch_calls": 0, "compiles": 0,
                       "compile_s": 0.0}
         self._register_gauges()
+        # dtype-labeled at-rest parameter bytes (docs/OBSERVABILITY.md):
+        # the quantization plane's byte budget, readable off /metrics
+        from symbiont_tpu.models.quant import param_bytes
+
+        storage = (self.config.quantize if self.config.quantize != "none"
+                   else "f32")
+        metrics.gauge_set("engine.param_bytes", param_bytes(self.params),
+                          labels={"service": "engine", "dtype": storage})
 
     def _register_gauges(self) -> None:
         """Engine-plane gauges (docs/OBSERVABILITY.md): compile count and

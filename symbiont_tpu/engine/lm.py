@@ -189,6 +189,10 @@ class LmEngine:
             attn_impl = "xla"
         if model_cfg.attn_impl != attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
+        if model_cfg.kv_quant != cfg.kv_quant:
+            # the cache layout is part of the frozen model config so it keys
+            # every compiled decode executable (models/gpt.py init_cache)
+            model_cfg = dataclasses.replace(model_cfg, kv_quant=cfg.kv_quant)
         self.model_cfg = model_cfg
         self.mesh = None
         if (cfg.tensor_parallel == "on"
@@ -278,13 +282,47 @@ class LmEngine:
             toks, secs = lm.stats["tokens_generated"], lm.stats["decode_s"]
             return toks / secs if secs > 0 else 0.0
 
-        labels = {"service": "lm"}
+        def kv_bytes(lm):
+            # dtype-adjusted occupancy: actual at-rest bytes of every live
+            # session's cache (int8 slabs + scale planes when kv_quant is
+            # on) — the companion to the row counts above, so capacity
+            # planning sees bytes, not just rows
+            with lm._sessions_lock:
+                sessions = list(lm._sessions)
+            return sum(gpt_mod.cache_bytes(s._cache) for s in sessions
+                       if not s.done())
+
+        def kv_rows_per_gib(lm):
+            # how many session rows one GiB of HBM holds at the live
+            # geometry and cache dtype — the "dtype-adjusted capacity"
+            # number (int8 ≈ 2× bf16's, ≈ 4× f32's)
+            with lm._sessions_lock:
+                sessions = [s for s in lm._sessions if not s.done()]
+            total = sum(gpt_mod.cache_bytes(s._cache) for s in sessions)
+            rows = sum(s.bb for s in sessions)
+            return round(rows * (1 << 30) / total, 1) if total else 0.0
+
+        labels = {"service": "lm",
+                  "kv_dtype": ("int8" if self.model_cfg.kv_quant == "int8"
+                               else self.model_cfg.dtype)}
         metrics.register_weakref_gauge("lm.kv_rows_active", self,
                                        kv_rows(True), labels=labels)
         metrics.register_weakref_gauge("lm.kv_rows_allocated", self,
                                        kv_rows(False), labels=labels)
+        metrics.register_weakref_gauge("lm.kv_cache_bytes", self,
+                                       kv_bytes, labels=labels)
+        metrics.register_weakref_gauge("lm.kv_rows_per_gib", self,
+                                       kv_rows_per_gib, labels=labels)
         metrics.register_weakref_gauge("lm.decode_tok_per_s", self,
                                        tok_per_s, labels=labels)
+
+    def _note_param_bytes(self, params, storage) -> None:
+        """Dtype-labeled at-rest parameter bytes (docs/OBSERVABILITY.md) —
+        the LM half of the quantization plane's byte budget."""
+        from symbiont_tpu.models.quant import param_bytes
+
+        metrics.gauge_set("lm.param_bytes", param_bytes(params),
+                          labels={"service": "lm", "dtype": str(storage)})
 
     def _place_params(self, params):
         """ONE home for parameter placement: megatron-sharded over the mesh's
@@ -296,16 +334,48 @@ class LmEngine:
         doubled HBM residency (TinyLlama: 4.1 GB vs 2.1 GB) and made every
         chunked-decode call re-convert the full parameter set (the fused
         generate hoists the convert once per call; a chunk loop pays it per
-        chunk)."""
+        chunk).
+
+        LmConfig.quantize != "none" quantizes here too (once per placement,
+        host-side), so online fine-tune syncs re-quantize their f32 masters
+        transparently. Quantized placement is single-device only: a TP mesh
+        shards by per-leaf PartitionSpecs that don't know QuantTensor, so
+        that combination falls back to unquantized sharding with a warning
+        — decode must not brick because the mesh grew a tensor axis."""
         import jax
         import jax.numpy as jnp
 
+        mode = self.config.quantize
+        if mode in ("int8", "fp8") and self.mesh is not None:
+            # only the QuantTensor modes can't shard (PartitionSpecs don't
+            # know the node type); f16 yields plain bf16 arrays and shards
+            # fine, so it does NOT take this fallback
+            log.warning(
+                "lm.quantize=%s is single-device only; TP-sharded decode "
+                "keeps unquantized params", mode)
+            mode = "none"
         dtype = jnp.dtype(self.model_cfg.dtype)
-        params = jax.tree.map(
-            lambda a: a.astype(dtype)
-            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-            else a, params)
+        if mode != "none":
+            from symbiont_tpu.models import quant
+
+            # cast FIRST, quantize SECOND: the other order let the model-
+            # dtype sweep undo f16's bf16-at-rest whenever the compute dtype
+            # was wider (f32 compute silently re-widened the weights while
+            # the param_bytes gauge still said f16). Quantized rank-≥2
+            # leaves now always end narrow; the trace-time entry cast
+            # upcasts them on-chip, so HBM reads stay halved regardless of
+            # compute dtype.
+            params = quant.cast_params(params, dtype)
+            params = quant.quantize_params(params, mode)
+        else:
+            params = jax.tree.map(
+                lambda a: a.astype(dtype)
+                if (hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating))
+                else a, params)
         if self.mesh is None:
+            storage = mode if mode != "none" else self.model_cfg.dtype
+            self._note_param_bytes(params, storage)
             return jax.device_put(params)
         from symbiont_tpu.parallel.sharding import (
             gpt_param_sharding,

@@ -117,11 +117,15 @@ class VectorStore:
         """Tensor-frame fast path: ingest an already-packed [n, dim] float
         block (typically a read-only `np.frombuffer` view straight off the
         bus — schema/frames) without ever materializing per-float Python
-        objects. Same semantics and WAL durability as upsert()."""
+        objects. Same semantics and WAL durability as upsert().
+
+        Non-f32 rows (the half-width f16 wire form, or bf16 engine output)
+        are upcast to f32 here — the store's in-memory matrix, WAL, and
+        search math stay f32 regardless of what dtype rode the bus."""
         ids = list(ids)
         if not ids:
             return 0
-        rows = np.asarray(rows, np.float32)
+        rows = np.asarray(rows, np.float32)  # upcasts f16/f64 views in C
         if rows.ndim != 2 or rows.shape[0] != len(ids):
             raise ValueError(
                 f"rows shape {rows.shape} does not match {len(ids)} ids")

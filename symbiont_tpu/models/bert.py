@@ -36,6 +36,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from symbiont_tpu.models import quant
+
 Params = Any  # nested dict pytree
 
 
@@ -123,7 +125,9 @@ def attention(
     hd = H // nh
 
     def proj(p):
-        return (x @ p["kernel"] + p["bias"]).reshape(B, S, nh, hd)
+        # quant.mm: plain matmul for f32/bf16 kernels, `(x @ q) * scale`
+        # for int8/fp8 QuantTensors (dequant fused — narrow HBM read)
+        return (quant.mm(x, p["kernel"]) + p["bias"]).reshape(B, S, nh, hd)
 
     q = proj(params["query"])
     k = proj(params["key"])
@@ -152,7 +156,7 @@ def attention(
             scores = scores.astype(jnp.float32) + mask_bias.astype(jnp.float32)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H)
-    out = ctx @ params["out"]["kernel"] + params["out"]["bias"]
+    out = quant.mm(ctx, params["out"]["kernel"]) + params["out"]["bias"]
     return out
 
 
@@ -161,9 +165,9 @@ def encoder_layer(params: Params, x: jax.Array, mask_bias: jax.Array, cfg: BertC
     attn_out = attention(params["attention"], x, mask_bias, cfg)
     x = layer_norm(x + attn_out, params["attention"]["ln"]["scale"],
                    params["attention"]["ln"]["bias"], cfg.layer_norm_eps)
-    h = x @ params["mlp"]["in"]["kernel"] + params["mlp"]["in"]["bias"]
+    h = quant.mm(x, params["mlp"]["in"]["kernel"]) + params["mlp"]["in"]["bias"]
     h = _act(cfg.hidden_act, x.dtype)(h)
-    h = h @ params["mlp"]["out"]["kernel"] + params["mlp"]["out"]["bias"]
+    h = quant.mm(h, params["mlp"]["out"]["kernel"]) + params["mlp"]["out"]["bias"]
     x = layer_norm(x + h, params["mlp"]["ln"]["scale"], params["mlp"]["ln"]["bias"],
                    cfg.layer_norm_eps)
     return x
@@ -177,7 +181,7 @@ def embeddings(
     token_type_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     B, S = input_ids.shape
-    tok = params["word_embeddings"][input_ids]
+    tok = quant.take(params["word_embeddings"], input_ids)
     if cfg.position_offset:
         # RoBERTa-style: positions count only non-pad tokens, offset past pad id.
         mask = attention_mask.astype(jnp.int32)
@@ -185,10 +189,10 @@ def embeddings(
         positions = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
     else:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    pos = params["position_embeddings"][positions]
+    pos = quant.take(params["position_embeddings"], positions)
     if token_type_ids is None:
         token_type_ids = jnp.zeros_like(input_ids)
-    typ = params["token_type_embeddings"][token_type_ids]
+    typ = quant.take(params["token_type_embeddings"], token_type_ids)
     x = tok + pos + typ
     x = layer_norm(x, params["ln"]["scale"], params["ln"]["bias"], cfg.layer_norm_eps)
     return x
@@ -203,9 +207,9 @@ def bert_encode(
 ) -> jax.Array:
     """Full encoder forward → last hidden state [B, S, H] in cfg.dtype."""
     dtype = jnp.dtype(cfg.dtype)
-    params = jax.tree.map(
-        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params
-    )
+    # shared leaf-aware cast: floating params → compute dtype, QuantTensor
+    # leaves untouched (their f32 scales must not be downcast)
+    params = quant.cast_params(params, dtype)
     x = embeddings(params["embeddings"], input_ids, attention_mask, cfg, token_type_ids)
     x = x.astype(dtype)
     # additive mask bias: 0 for real tokens, large negative for padding
@@ -272,8 +276,10 @@ def cross_encoder_score(
     hidden = bert_encode(params, input_ids, attention_mask, cfg, token_type_ids)
     # HF BertPooler: tanh(W @ h_cls + b), then classifier [H, num_labels=1].
     cls = hidden[:, 0, :]
-    pooled = jnp.tanh(cls @ params["pooler"]["kernel"] + params["pooler"]["bias"])
-    logits = pooled @ params["classifier"]["kernel"] + params["classifier"]["bias"]
+    pooled = jnp.tanh(quant.mm(cls, params["pooler"]["kernel"])
+                      + params["pooler"]["bias"])
+    logits = (quant.mm(pooled, params["classifier"]["kernel"])
+              + params["classifier"]["bias"])
     return logits[..., 0].astype(jnp.float32)
 
 

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from symbiont_tpu.models import quant
+
 Params = Any
 
 
@@ -49,6 +51,11 @@ class GPTConfig:
     # qualify) runs the fused pallas kernel over the fresh K/V; decode steps
     # (S==1) stay on the XLA cache-read path either way.
     attn_impl: str = "xla"
+    # KV-cache storage: "none" = cfg.dtype slabs (the default), "int8" =
+    # per-(position, head)-scaled int8 with quantize-on-append /
+    # dequant-on-attend inside the decode step (models/quant.py). Part of
+    # the frozen config so the cache layout keys the compiled executables.
+    kv_quant: str = "none"
 
     @property
     def kv_heads(self) -> int:
@@ -97,10 +104,40 @@ class KVCache(NamedTuple):
     length: jax.Array  # [] int32 — number of valid positions
 
 
-def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype) -> KVCache:
+class QuantKVCache(NamedTuple):
+    """int8 variant (cfg.kv_quant == "int8"): k/v slabs are int8 with one
+    f32 scale per (layer, batch, position, kv_head) — quantize-on-append,
+    dequant-on-attend. ~2× more session rows per HBM byte vs bf16 slabs
+    (~4× vs f32) at ≤0.4% per-vector rounding; the greedy-identity gate in
+    tests/test_quantization.py pins the decode-quality contract. Same field
+    layout conventions as KVCache (batch at axis 1, scalar length last) so
+    merge_rows and the donation-carrying decode loops treat both shapes
+    uniformly."""
+
+    k: jax.Array        # int8 [L, B, T, kv_heads, head_dim]
+    v: jax.Array
+    k_scale: jax.Array  # f32 [L, B, T, kv_heads]
+    v_scale: jax.Array
+    length: jax.Array   # [] int32
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int, dtype):
     shape = (cfg.num_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_quant == "int8":
+        sshape = shape[:-1]
+        return QuantKVCache(
+            jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+            jnp.zeros((), jnp.int32))
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                    jnp.zeros((), jnp.int32))
+
+
+def cache_bytes(cache) -> int:
+    """At-rest bytes of one cache (slabs + scale planes) — feeds the
+    dtype-adjusted `lm.kv_cache_bytes` gauge in engine/lm.py."""
+    return sum(int(leaf.nbytes) for leaf in cache
+               if hasattr(leaf, "nbytes") and getattr(leaf, "ndim", 0) > 0)
 
 
 # ---------------------------------------------------------------------------
@@ -156,9 +193,12 @@ def _attn(
     # with the fusion both in-body and pre-computed outside the scan) — the
     # post-matmul slicing into q/k/v interacts badly with the cache-write /
     # attention layout. Re-test on new hardware before "optimizing" this.
-    q = (x @ layer["q"]["kernel"] + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
-    k = (x @ layer["k"]["kernel"] + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
-    v = (x @ layer["v"]["kernel"] + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)
+    q = (quant.mm(x, layer["q"]["kernel"])
+         + layer["q"].get("bias", 0)).reshape(B, S, nh, hd)
+    k = (quant.mm(x, layer["k"]["kernel"])
+         + layer["k"].get("bias", 0)).reshape(B, S, nkv, hd)
+    v = (quant.mm(x, layer["v"]["kernel"])
+         + layer["v"].get("bias", 0)).reshape(B, S, nkv, hd)
 
     if cfg.arch == "llama":
         q = _rope(q, positions, cfg.rope_theta)
@@ -171,13 +211,31 @@ def _attn(
     # always fuse it away: decode ms/step grew linearly with cache length
     # (measured on v5e, TinyLlama geometry: +2.9 ms/step from T=192 → 576).
     start = cache.length
-    k_cache = jax.lax.dynamic_update_slice(
-        cache.k, k.astype(cache.k.dtype)[None], (layer_idx, 0, start, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        cache.v, v.astype(cache.v.dtype)[None], (layer_idx, 0, start, 0, 0))
-    new_cache = KVCache(k_cache, v_cache, cache.length)
-    k_all = k_cache[layer_idx]
-    v_all = v_cache[layer_idx]
+
+    def _dus(slab, update, rank5=True):
+        idx = (layer_idx, 0, start, 0, 0) if rank5 else (layer_idx, 0, start, 0)
+        return jax.lax.dynamic_update_slice(slab, update[None], idx)
+
+    if isinstance(cache, QuantKVCache):
+        # quantize-on-append: each fresh (position, head) K/V vector gets
+        # its own int8 scale; dequant-on-attend reads the int8 slab + the
+        # head_dim×-smaller scale plane out of HBM and upcasts in registers
+        k_q, k_s = quant.kv_channel_quantize(k)
+        v_q, v_s = quant.kv_channel_quantize(v)
+        new_cache = QuantKVCache(
+            _dus(cache.k, k_q), _dus(cache.v, v_q),
+            _dus(cache.k_scale, k_s, rank5=False),
+            _dus(cache.v_scale, v_s, rank5=False), cache.length)
+        k_all = quant.kv_dequantize(new_cache.k[layer_idx],
+                                    new_cache.k_scale[layer_idx], x.dtype)
+        v_all = quant.kv_dequantize(new_cache.v[layer_idx],
+                                    new_cache.v_scale[layer_idx], x.dtype)
+    else:
+        new_cache = KVCache(_dus(cache.k, k.astype(cache.k.dtype)),
+                            _dus(cache.v, v.astype(cache.v.dtype)),
+                            cache.length)
+        k_all = new_cache.k[layer_idx]
+        v_all = new_cache.v[layer_idx]
 
     if cfg.attn_impl == "flash" and S > 1:
         # Prefill-from-empty: attention over exactly the S fresh tokens (the
@@ -192,7 +250,7 @@ def _attn(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), kv_bias=bias, causal=True,
         ).transpose(0, 2, 1, 3).reshape(B, S, H)
-        out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
+        out = quant.mm(ctx, layer["o"]["kernel"]) + layer["o"].get("bias", 0)
         return out, new_cache
 
     T = k_all.shape[1]
@@ -223,7 +281,7 @@ def _attn(
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bngst,btnd->bsngd", probs,
                      v_all.astype(x.dtype)).reshape(B, S, H)
-    out = ctx @ layer["o"]["kernel"] + layer["o"].get("bias", 0)
+    out = quant.mm(ctx, layer["o"]["kernel"]) + layer["o"].get("bias", 0)
     return out, new_cache
 
 
@@ -233,18 +291,18 @@ def _block(layer, x, layer_idx, cache, positions, cfg, kv_valid):
                          layer_idx, cache, positions, cfg, kv_valid)
         x = x + a
         h = _ln(x, layer["ln2"], cfg.layer_norm_eps)
-        h = h @ layer["mlp"]["in"]["kernel"] + layer["mlp"]["in"]["bias"]
+        h = quant.mm(h, layer["mlp"]["in"]["kernel"]) + layer["mlp"]["in"]["bias"]
         h = jax.nn.gelu(h, approximate=True)  # GPT-2 uses gelu_new
-        h = h @ layer["mlp"]["out"]["kernel"] + layer["mlp"]["out"]["bias"]
+        h = quant.mm(h, layer["mlp"]["out"]["kernel"]) + layer["mlp"]["out"]["bias"]
         return x + h, cache
     # llama
     a, cache = _attn(layer, _rmsnorm(x, layer["ln1"], cfg.layer_norm_eps),
                      layer_idx, cache, positions, cfg, kv_valid)
     x = x + a
     h = _rmsnorm(x, layer["ln2"], cfg.layer_norm_eps)
-    gate = jax.nn.silu(h @ layer["mlp"]["gate"]["kernel"])
-    up = h @ layer["mlp"]["up"]["kernel"]
-    h = (gate * up) @ layer["mlp"]["down"]["kernel"]
+    gate = jax.nn.silu(quant.mm(h, layer["mlp"]["gate"]["kernel"]))
+    up = quant.mm(h, layer["mlp"]["up"]["kernel"])
+    h = quant.mm(gate * up, layer["mlp"]["down"]["kernel"])
     return x + h, cache
 
 
@@ -305,20 +363,23 @@ def forward(
     generate() and the trainer both satisfy this; chunked prefill against a
     partially-filled cache requires attn_impl == "xla"."""
     dtype = jnp.dtype(cfg.dtype)
-    params = jax.tree.map(
-        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a, params
-    )
-    x = params["wte"][input_ids]
+    # leaf-aware cast (models/quant.py): floating params → compute dtype,
+    # QuantTensor leaves untouched so their f32 scales survive
+    params = quant.cast_params(params, dtype)
+    x = quant.take(params["wte"], input_ids)
     if cfg.arch == "gpt2":
-        x = x + params["wpe"][positions]
+        x = x + quant.take(params["wpe"], positions)
+    x = x.astype(dtype)  # quantized gathers dequantize to f32
     for i, layer in enumerate(params["layers"]):
         x, cache = _block(layer, x, i, cache, positions, cfg, kv_valid)
     if cfg.arch == "gpt2":
         x = _ln(x, params["ln_f"], cfg.layer_norm_eps)
     else:
         x = _rmsnorm(x, params["ln_f"], cfg.layer_norm_eps)
-    head = params["wte"].T if cfg.tie_word_embeddings else params["lm_head"]["kernel"]
-    logits = (x @ head).astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        logits = quant.mm_tied(x, params["wte"]).astype(jnp.float32)
+    else:
+        logits = quant.mm(x, params["lm_head"]["kernel"]).astype(jnp.float32)
     return logits, cache
 
 
@@ -503,9 +564,12 @@ def merge_rows(cache_a, logits_a, pos_a, done_a, kv_valid_a,
     t_idx = jnp.arange(T)
     gap = (t_idx >= prompt_width) & (t_idx < cache_a.length)
     kv_b = kv_valid_b & ~gap[None, :]
-    cache = KVCache(pick(cache_a.k, cache_b.k, batch_axis=1),
-                    pick(cache_a.v, cache_b.v, batch_axis=1),
-                    cache_a.length)
+    # field-wise splice covers both cache layouts (KVCache and the int8
+    # QuantKVCache, whose scale planes ride batch axis 1 like the slabs);
+    # the scalar `length` field keeps a's value
+    cache = type(cache_a)(*[
+        fa if fa.ndim == 0 else pick(fa, fb, batch_axis=1)
+        for fa, fb in zip(cache_a, cache_b)])
     return (cache, pick(logits_a, logits_b), pick(pos_a, pos_b),
             pick(done_a, done_b), pick(kv_valid_a, kv_b))
 

@@ -14,11 +14,13 @@ A tensor frame is a fixed 16-byte header + packed little-endian f32 rows:
 
     offset 0   magic  b"SYTF"
     offset 4   u8     version (1)
-    offset 5   u8     dtype   (1 = f32 little-endian)
+    offset 5   u8     dtype   (1 = f32 little-endian, 2 = f16 little-endian)
     offset 6   u16le  reserved (0)
     offset 8   u32le  rows
     offset 12  u32le  cols
-    offset 16  rows * cols * 4 bytes of f32le, row-major
+    offset 16  rows * cols * elem_size bytes, row-major (elem_size 4 for
+               f32, 2 for the half-width f16 form; consumers upcast on
+               ingest — VectorStore.upsert_rows takes any float dtype)
 
 The frame rides APPENDED to the ordinary JSON message body; the
 `X-Symbiont-Frame` content-type header (`tensor/f32;off=<n>`, where `n`
@@ -77,11 +79,22 @@ ACCEPT_FRAME_HEADER = "X-Symbiont-Accept-Frame"
 FRAME_MAGIC = b"SYTF"
 FRAME_VERSION = 1
 DTYPE_F32 = 1
+DTYPE_F16 = 2  # IEEE half — half the bytes/embedding on every frame hop
 # magic, version, dtype, reserved, rows, cols — 16 bytes, little-endian
 _HDR = struct.Struct("<4sBBHII")
 FRAME_HDR_LEN = _HDR.size
 
-_CONTENT_TYPE = "tensor/f32"
+# ONE home for the dtype registry: name ↔ header byte ↔ numpy dtype ↔
+# content type. Services never hard-code any of these (statically banned
+# outside one allowlisted encoder — tests/test_pipeline_wiring.py); a new
+# dtype is added HERE and nowhere else.
+_DTYPE_BY_NAME = {"f32": DTYPE_F32, "f16": DTYPE_F16}
+_NAME_BY_DTYPE = {v: k for k, v in _DTYPE_BY_NAME.items()}
+_NP_BY_DTYPE = {DTYPE_F32: "<f4", DTYPE_F16: "<f2"}
+_SIZE_BY_DTYPE = {DTYPE_F32: 4, DTYPE_F16: 2}
+_CONTENT_TYPE_BY_DTYPE = {code: f"tensor/{name}"
+                          for name, code in _DTYPE_BY_NAME.items()}
+_KNOWN_CONTENT_TYPES = set(_CONTENT_TYPE_BY_DTYPE.values())
 
 
 class FrameError(ValueError):
@@ -95,13 +108,27 @@ def wants_frame(headers: Optional[Dict[str, str]]) -> bool:
     return (headers or {}).get(ACCEPT_FRAME_HEADER) == "1"
 
 
-def frames_enabled(default: bool = True) -> bool:
-    """Publisher-side deployment knob for the pub/sub hops (see module
-    docstring). Request-reply paths negotiate per call instead."""
-    v = os.environ.get("SYMBIONT_FRAMES", "")
+def frames_mode(default: str = "f32") -> str:
+    """Publisher-side deployment knob for the pub/sub hops, now three-way:
+    "off" (reference wire JSON), "f32" (the default frame form every
+    frame-capable peer decodes), or "f16" (half-width rows — deploy only
+    when every consumer on the subject decodes dtype 2; an f32-only
+    consumer FrameErrors the delivery into redelivery/DLQ rather than
+    ingesting garbage, see docs/QUANTIZATION.md). Request-reply paths
+    negotiate per call instead (`encoding` / ACCEPT_FRAME_HEADER)."""
+    v = os.environ.get("SYMBIONT_FRAMES", "").strip().lower()
     if not v:
         return default
-    return v not in ("0", "false", "no", "off")
+    if v in ("0", "false", "no", "off"):
+        return "off"
+    if v in _DTYPE_BY_NAME:
+        return v
+    return "f32"
+
+
+def frames_enabled(default: bool = True) -> bool:
+    """Back-compat boolean view of frames_mode (the pre-f16 knob)."""
+    return frames_mode("f32" if default else "off") != "off"
 
 
 def _estimate_json_bytes_per_float() -> float:
@@ -121,25 +148,47 @@ JSON_BYTES_PER_FLOAT_EST = _estimate_json_bytes_per_float()
 
 # ----------------------------------------------------------------- raw codec
 
-def encode_frame(rows: np.ndarray) -> bytes:
-    """Pack a [rows, cols] float array as one frame (header + f32le)."""
-    arr = np.ascontiguousarray(np.asarray(rows, dtype="<f4"))
+def encode_frame(rows: np.ndarray, dtype: str = "f32") -> bytes:
+    """Pack a [rows, cols] float array as one frame (header + packed
+    little-endian rows in `dtype`: "f32" or the half-width "f16")."""
+    code = _DTYPE_BY_NAME.get(dtype)
+    if code is None:
+        raise FrameError(f"unsupported frame dtype {dtype!r} "
+                         f"(known: {sorted(_DTYPE_BY_NAME)})")
+    with np.errstate(over="ignore"):  # overflow handled explicitly below
+        arr = np.ascontiguousarray(np.asarray(rows,
+                                              dtype=_NP_BY_DTYPE[code]))
     if arr.ndim != 2:
         raise FrameError(f"frame payload must be 2-D, got shape {arr.shape}")
+    if code == DTYPE_F16 and np.isinf(arr).any():
+        src = np.asarray(rows)
+        if (np.isinf(arr) & np.isfinite(src)).any():
+            # a finite value beyond ±65504 became inf in the half cast:
+            # refuse to frame rather than ship silent corruption (an inf
+            # row poisons every cosine against it downstream). Same
+            # loud-failure stance as an undecodable dtype byte.
+            raise FrameError(
+                "value(s) exceed the f16 range (|x| > 65504): refusing to "
+                "encode a half-width frame that would overflow to inf — "
+                "use the f32 form for unnormalized payloads")
     t0 = time.perf_counter()
-    out = _HDR.pack(FRAME_MAGIC, FRAME_VERSION, DTYPE_F32, 0,
+    out = _HDR.pack(FRAME_MAGIC, FRAME_VERSION, code, 0,
                     arr.shape[0], arr.shape[1]) + arr.tobytes()
-    metrics.inc("frame.encoded")
-    metrics.inc("frame.bytes", len(out))
+    labels = {"dtype": dtype}
+    metrics.inc("frame.encoded", labels=labels)
+    metrics.inc("frame.bytes", len(out), labels=labels)
     metrics.inc("frame.json_equiv_bytes",
-                arr.size * JSON_BYTES_PER_FLOAT_EST)
+                arr.size * JSON_BYTES_PER_FLOAT_EST, labels=labels)
     metrics.observe("frame.encode_s", time.perf_counter() - t0)
     return out
 
 
 def decode_frame(buf: bytes, offset: int = 0) -> np.ndarray:
     """Decode a frame starting at `offset` into a zero-copy read-only
-    [rows, cols] f32 view over `buf`."""
+    [rows, cols] view over `buf` (f32, or f16 for dtype-2 frames — the
+    store upcasts on ingest). A dtype byte this peer does not implement
+    raises FrameError — the delivery stays unacked for redelivery/DLQ,
+    never silently misparsed."""
     t0 = time.perf_counter()
     if len(buf) - offset < FRAME_HDR_LEN:
         raise FrameError("frame truncated before header")
@@ -148,36 +197,41 @@ def decode_frame(buf: bytes, offset: int = 0) -> np.ndarray:
         raise FrameError(f"bad frame magic {magic!r}")
     if version != FRAME_VERSION:
         raise FrameError(f"unsupported frame version {version}")
-    if dtype != DTYPE_F32:
-        raise FrameError(f"unsupported frame dtype {dtype}")
-    need = rows * cols * 4
+    if dtype not in _NP_BY_DTYPE:
+        raise FrameError(
+            f"unsupported frame dtype {dtype} (this peer implements "
+            f"{sorted(_NAME_BY_DTYPE.values())})")
+    need = rows * cols * _SIZE_BY_DTYPE[dtype]
     body = offset + FRAME_HDR_LEN
     if len(buf) - body < need:
         raise FrameError(f"frame payload truncated: need {need} bytes, "
                          f"have {len(buf) - body}")
-    arr = np.frombuffer(buf, dtype="<f4", count=rows * cols,
+    arr = np.frombuffer(buf, dtype=_NP_BY_DTYPE[dtype], count=rows * cols,
                         offset=body).reshape(rows, cols)
-    metrics.inc("frame.decoded")
+    metrics.inc("frame.decoded", labels={"dtype": _NAME_BY_DTYPE[dtype]})
     metrics.observe("frame.decode_s", time.perf_counter() - t0)
     return arr
 
 
 # ------------------------------------------------------------ bus attachment
 
-def attach_frame(json_bytes: bytes, rows: np.ndarray) -> Tuple[bytes, Dict[str, str]]:
+def attach_frame(json_bytes: bytes, rows: np.ndarray,
+                 dtype: str = "f32") -> Tuple[bytes, Dict[str, str]]:
     """JSON body + frame → (wire data, headers to merge into the publish)."""
-    data = bytes(json_bytes) + encode_frame(rows)
-    return data, {FRAME_HEADER: f"{_CONTENT_TYPE};off={len(json_bytes)}"}
+    data = bytes(json_bytes) + encode_frame(rows, dtype=dtype)
+    content = _CONTENT_TYPE_BY_DTYPE[_DTYPE_BY_NAME[dtype]]
+    return data, {FRAME_HEADER: f"{content};off={len(json_bytes)}"}
 
 
 def frame_offset(headers: Optional[Dict[str, str]]) -> Optional[int]:
     """Parse the X-Symbiont-Frame header; None when the message carries no
-    frame. Raises FrameError on a malformed header value."""
+    frame. Raises FrameError on a malformed header value (the binary dtype
+    byte stays authoritative — the content type only gates known names)."""
     value = (headers or {}).get(FRAME_HEADER)
     if value is None:
         return None
     parts = value.split(";")
-    if parts[0].strip() != _CONTENT_TYPE:
+    if parts[0].strip() not in _KNOWN_CONTENT_TYPES:
         raise FrameError(f"unknown frame content type {parts[0]!r}")
     for p in parts[1:]:
         k, _, v = p.strip().partition("=")
@@ -209,14 +263,19 @@ def detach_frame(data: bytes, headers: Optional[Dict[str, str]]
 def encode_embeddings_message(original_id: str, source_url: str,
                               sentences: Sequence[str],
                               vectors, model_name: str, timestamp_ms: int,
-                              use_frame: Optional[bool] = None
+                              use_frame: Optional[bool] = None,
+                              wire_dtype: Optional[str] = None
                               ) -> Tuple[bytes, Dict[str, str]]:
     """Build the data.text.with_embeddings wire form. Frame mode keeps the
-    floats out of JSON entirely; fallback mode (`use_frame=False` or
-    SYMBIONT_FRAMES=0) emits the exact reference wire shape so a JSON-only
-    peer ingests it unchanged."""
+    floats out of JSON entirely (`wire_dtype` "f32" or half-width "f16";
+    None resolves the SYMBIONT_FRAMES knob at publish time); fallback mode
+    (`use_frame=False` or SYMBIONT_FRAMES=0) emits the exact reference wire
+    shape so a JSON-only peer ingests it unchanged."""
     if use_frame is None:
         use_frame = frames_enabled()
+    if wire_dtype is None:
+        mode = frames_mode()
+        wire_dtype = mode if mode in _DTYPE_BY_NAME else "f32"
     arr = np.ascontiguousarray(np.asarray(vectors, dtype=np.float32))
     if arr.ndim != 2 or arr.shape[0] != len(sentences):
         raise FrameError(
@@ -240,7 +299,7 @@ def encode_embeddings_message(original_id: str, source_url: str,
     body = to_json_bytes(out)
     if not use_frame:
         return body, {}
-    return attach_frame(body, arr)
+    return attach_frame(body, arr, dtype=wire_dtype)
 
 
 class LazyEmbeddingsMessage:

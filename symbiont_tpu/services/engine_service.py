@@ -52,6 +52,9 @@ log = logging.getLogger(__name__)
 # request/reply key carrying a decoded tensor frame through the op plumbing
 # (never serialized: _handle pops it off the wire, _reply re-attaches it)
 _FRAME_KEY = "_frame"
+# sibling key: the wire dtype the op chose for its reply frame (defaults to
+# the full-width form when absent)
+_FRAME_DTYPE_KEY = "_frame_dtype"
 
 
 def _err(payload: dict) -> bytes:
@@ -183,11 +186,15 @@ class EngineService(Service):
             return
         headers = child_headers(msg.headers)
         # an op that put an ndarray under _FRAME_KEY replies with the block
-        # as a binary tensor frame appended to the JSON metadata
+        # as a binary tensor frame appended to the JSON metadata, in the
+        # wire dtype the op negotiated (_FRAME_DTYPE_KEY)
         frame = payload.pop(_FRAME_KEY, None)
+        dtype = payload.pop(_FRAME_DTYPE_KEY, None)
         data = _err(payload)
         if frame is not None:
-            data, fheaders = frames.attach_frame(data, frame)
+            data, fheaders = (frames.attach_frame(data, frame, dtype=dtype)
+                              if dtype is not None
+                              else frames.attach_frame(data, frame))
             headers.update(fheaders)
         await self.bus.publish(msg.reply, data, headers=headers)
 
@@ -241,18 +248,24 @@ class EngineService(Service):
                 raise ValueError("texts must be a list of strings")
             vecs = await self.batcher.embed(texts)
             encoding = req.get("encoding")
-            if encoding == "frame":
+            if encoding in ("frame", "frame16"):
                 # zero-copy reply for frame-capable callers: the [n, dim]
-                # f32 block rides as a binary tensor frame appended to the
-                # JSON metadata (_reply attaches it; schema/frames). An old
-                # engine ignores this encoding and answers with JSON float
-                # lists — the negotiated fallback every caller accepts.
+                # block rides as a binary tensor frame appended to the JSON
+                # metadata (_reply attaches it; schema/frames). encoding
+                # frame16 asks for the half-width dtype-2 form — the ONE
+                # place a service maps a negotiated encoding to a frame
+                # dtype (allowlisted in tests/test_pipeline_wiring.py; every
+                # other dtype decision lives in schema/frames.py). An old
+                # engine ignores either encoding value and answers with
+                # JSON float lists — the fallback every caller accepts.
                 arr = np.ascontiguousarray(np.asarray(vecs, np.float32))
                 if arr.ndim == 1:  # zero texts edge: keep the 2-D contract
                     arr = arr.reshape(0, 0)
                 return {"count": int(arr.shape[0]), "dim": int(arr.shape[1]),
                         "model_name": self.engine.config.model_name,
-                        _FRAME_KEY: arr}
+                        _FRAME_KEY: arr,
+                        _FRAME_DTYPE_KEY: ("f16" if encoding == "frame16"
+                                           else "f32")}
             if encoding == "b64":
                 # compact reply for reference-era bulk callers: f32
                 # little-endian rows base64'd is ~4.3 bytes per float vs

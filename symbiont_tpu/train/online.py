@@ -92,10 +92,21 @@ class OnlineLmTrainer:
                     "falling back to the engine's (bf16-rounded) params",
                     model_dir)
         if params is None:
-            params = jax.tree.map(
-                lambda a: (jnp.array(a, dtype=jnp.float32, copy=True)
-                           if jnp.issubdtype(a.dtype, jnp.floating)
-                           else jnp.copy(a)), lm.params)
+            from symbiont_tpu.models import quant as quant_mod
+
+            def widen(a):
+                # a quantized engine (lm.quantize=int8/fp8) serves
+                # QuantTensor leaves — masters must train on their f32
+                # DEQUANTIZED values, not on raw int8 codes (grad would
+                # reject integer inputs outright)
+                if quant_mod.is_quantized(a):
+                    return a.dequantize(jnp.float32)
+                return (jnp.array(a, dtype=jnp.float32, copy=True)
+                        if jnp.issubdtype(a.dtype, jnp.floating)
+                        else jnp.copy(a))
+
+            params = jax.tree.map(widen, lm.params,
+                                  is_leaf=quant_mod.is_quantized)
         self.state, self._tx = make_lm_train_state(params, learning_rate)
         if resuming:  # one consistent answer with the masters-init decision
             try:

@@ -76,9 +76,18 @@ def make_lm_train_state(params: Params, learning_rate: float = 3e-4
 
 def lm_loss(params: Params, batch: dict, cfg: gpt_mod.GPTConfig) -> jax.Array:
     """Next-token cross-entropy over [B, S] token batches (mask-weighted)."""
+    import dataclasses
+
     ids = batch["ids"]  # [B, S]
     mask = batch["mask"].astype(jnp.float32)  # [B, S]
     B, S = ids.shape
+    # the TRAINING forward always runs an unquantized cache: a serving
+    # config with kv_quant=int8 would put quantize-on-append round() in the
+    # backward path, whose zero gradient silently kills most K/V-kernel
+    # gradients (measured: grad norm 22.4 → 4.0). The cache type follows
+    # the instance, so this one replace() confines int8 KV to decode.
+    if cfg.kv_quant != "none":
+        cfg = dataclasses.replace(cfg, kv_quant="none")
     cache = gpt_mod.init_cache(cfg, B, S, jnp.dtype(cfg.dtype))
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     logits, _ = gpt_mod.forward(params, ids, cache, positions, cfg)

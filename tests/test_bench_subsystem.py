@@ -354,7 +354,7 @@ def test_cli_main_end_to_end_stub_registry(monkeypatch, capsys):
     # register into the ORIGINAL registry — main()'s imports then no-op and
     # only the stubs below exist in the patched registry
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, serialization)
+        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
 
     monkeypatch.setattr(tiers, "_REGISTRY", {})
 
@@ -461,7 +461,7 @@ def test_declared_primary_metrics_single_source():
     from symbiont_tpu.bench import cli
     # the real tier modules must be registered for this check
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, serialization)
+        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
 
     declared = cli.declared_primary_metrics()
     assert cli.ROOFLINE_PRIMARY in declared
@@ -501,7 +501,7 @@ def test_declared_primary_metrics_excludes_skipped_tiers():
     lost metric (review finding)."""
     from symbiont_tpu.bench import cli
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, serialization)
+        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
 
     full = cli.declared_primary_metrics()
     no_e2e = cli.declared_primary_metrics(skips={"e2e": "skipped by flag"})
@@ -512,3 +512,37 @@ def test_declared_primary_metrics_excludes_skipped_tiers():
         skips={"stream_ceiling": "not a TPU", "compute_mfu": "not a TPU"})
     assert cli.ROOFLINE_PRIMARY not in cpu_only
     assert "mfu_compute_only_pct" not in cpu_only
+
+
+def test_bulk_ratio_fields_decoupled_from_registration_order():
+    """The e2e÷bulk ratio no longer rides on the engine_plane tier having
+    run EARLIER IN THE SAME PROCESS (the PR 6 registration-order coupling):
+    with the prerequisite absent it archives an explicit null plus a note;
+    with it present, the ratio — and the null+note shape schema-validates."""
+    from symbiont_tpu.bench.e2e import bulk_ratio_fields
+
+    absent = bulk_ratio_fields({"e2e_ingest_emb_per_s": 1800.0})
+    assert absent["e2e_ingest_vs_bulk_x"] is None
+    assert "ingest_10k_emb_per_s absent" in absent["e2e_ingest_vs_bulk_note"]
+
+    present = bulk_ratio_fields({"e2e_ingest_emb_per_s": 1800.0,
+                                 "ingest_10k_emb_per_s": 3000.0})
+    assert present == {"e2e_ingest_vs_bulk_x": 0.6}
+
+    line = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            **absent}
+    assert archive.validate_line(line) == []
+    # null remains EXPLICIT: any other field archived as null still fails
+    bad = dict(line, e2e_search_p50_ms=None)
+    assert archive.validate_line(bad)
+
+
+def test_quant_tier_registered_with_primaries():
+    from symbiont_tpu.bench import quant  # noqa: F401
+
+    reg = tiers.registry()
+    assert "quant" in reg
+    assert set(reg["quant"].primary_metrics) == {
+        "quant_embed_cos_int8", "quant_embed_int8_vs_bf16_x",
+        "quant_decode_int8kv_vs_bf16_x"}
+    assert not reg["quant"].quick  # device tier: full runs only

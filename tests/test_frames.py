@@ -50,6 +50,21 @@ def golden_frame_bytes() -> bytes:
     return out
 
 
+def golden_f16_frame_bytes() -> bytes:
+    """The half-width form, same spec-transcription stance. Every GOLDEN_ROWS
+    value is exactly representable in binary16 (65504.0 is the f16 max), so
+    the f16 frame is lossless for this fixture."""
+    out = b"SYTF"
+    out += struct.pack("<B", 1)        # version
+    out += struct.pack("<B", 2)        # dtype f16le
+    out += struct.pack("<H", 0)        # reserved
+    out += struct.pack("<I", 2)        # rows
+    out += struct.pack("<I", 3)        # cols
+    for v in [1.0, -2.5, 0.15625, 3.5, 65504.0, -0.0]:
+        out += struct.pack("<e", v)
+    return out
+
+
 # ------------------------------------------------------------- byte layout
 
 def test_encode_matches_golden_bytes():
@@ -87,6 +102,69 @@ def test_detach_without_header_is_passthrough():
 def test_malformed_frames_raise(mutate):
     with pytest.raises(frames.FrameError):
         frames.decode_frame(mutate(golden_frame_bytes()))
+
+
+# ----------------------------------------------------------- f16 wire form
+
+def test_encode_f16_matches_golden_bytes():
+    assert frames.encode_frame(GOLDEN_ROWS, dtype="f16") == \
+        golden_f16_frame_bytes()
+
+
+def test_decode_f16_golden_bytes():
+    rows = frames.decode_frame(golden_f16_frame_bytes())
+    assert rows.dtype == np.float16 and rows.shape == (2, 3)
+    np.testing.assert_array_equal(rows.astype(np.float32), GOLDEN_ROWS)
+    assert np.signbit(rows[1, 2])  # -0.0 survives the half form too
+
+
+def test_attach_detach_f16_roundtrip():
+    body = b'{"k":"v"}'
+    data, headers = frames.attach_frame(body, GOLDEN_ROWS, dtype="f16")
+    assert headers[frames.FRAME_HEADER] == f"tensor/f16;off={len(body)}"
+    json_part, rows = frames.detach_frame(data, headers)
+    assert json_part == body and rows.dtype == np.float16
+    np.testing.assert_array_equal(rows.astype(np.float32), GOLDEN_ROWS)
+    # halving check: same rows, ~half the frame payload bytes
+    f32_len = len(frames.encode_frame(GOLDEN_ROWS))
+    f16_len = len(frames.encode_frame(GOLDEN_ROWS, dtype="f16"))
+    assert f16_len - frames.FRAME_HDR_LEN == (f32_len
+                                              - frames.FRAME_HDR_LEN) // 2
+
+
+def test_unsupported_dtype_byte_raises_not_garbage():
+    """An f32/f16-only consumer receiving a future dtype byte must
+    FrameError (delivery stays unacked for redelivery/DLQ) — never
+    misparse the payload at the wrong element width."""
+    fut = golden_f16_frame_bytes()
+    fut = fut[:5] + struct.pack("<B", 3) + fut[6:]  # hypothetical dtype 3
+    with pytest.raises(frames.FrameError, match="dtype"):
+        frames.decode_frame(fut)
+
+
+def test_f16_encode_refuses_overflow():
+    """A finite value beyond the binary16 range (±65504) must FrameError at
+    encode, not ship as ±inf (one inf row poisons every cosine against it
+    downstream — review finding). The exact f16 max still frames."""
+    ok = np.array([[65504.0, -65504.0]], np.float32)
+    assert frames.decode_frame(frames.encode_frame(ok, dtype="f16")) is not None
+    with pytest.raises(frames.FrameError, match="f16 range"):
+        frames.encode_frame(np.array([[1e10, 1.0]], np.float32), dtype="f16")
+    # the f32 form takes the same payload unchanged
+    assert frames.encode_frame(np.array([[1e10, 1.0]], np.float32))
+
+
+def test_frames_mode_env(monkeypatch):
+    monkeypatch.delenv("SYMBIONT_FRAMES", raising=False)
+    assert frames.frames_mode() == "f32"
+    monkeypatch.setenv("SYMBIONT_FRAMES", "f16")
+    assert frames.frames_mode() == "f16"
+    assert frames.frames_enabled()
+    monkeypatch.setenv("SYMBIONT_FRAMES", "0")
+    assert frames.frames_mode() == "off"
+    assert not frames.frames_enabled()
+    monkeypatch.setenv("SYMBIONT_FRAMES", "1")
+    assert frames.frames_mode() == "f32"
 
 
 @pytest.mark.parametrize("value", [
@@ -294,6 +372,63 @@ def test_vector_memory_frame_ingest_without_upsert_rows(tmp_path):
         rtol=1e-6)
 
 
+def test_vector_memory_ingests_f16_wire(tmp_path):
+    """SYMBIONT_FRAMES=f16 publisher → consumer: the half-width rows land
+    in the store upcast to f32, matching the f32 wire within f16 rounding
+    (the store's matrix/WAL stay f32 — upsert_rows upcasts on ingest)."""
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+    from symbiont_tpu.services.vector_memory import VectorMemoryService
+
+    sentences, vectors = _sample_args()
+
+    async def ingest(use_dtype, store):
+        bus = InprocBus()
+        svc = VectorMemoryService(bus, store)
+        await svc.start()
+        try:
+            data, fheaders = frames.encode_embeddings_message(
+                "doc-h", "http://d", sentences, vectors, "m", 123,
+                use_frame=True, wire_dtype=use_dtype)
+            await bus.publish(subjects.DATA_TEXT_WITH_EMBEDDINGS, data,
+                              headers=fheaders)
+            for _ in range(200):
+                if store.count() >= len(sentences):
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    sa = VectorStore(VectorStoreConfig(dim=8, data_dir=str(tmp_path / "16")))
+    sb = VectorStore(VectorStoreConfig(dim=8, data_dir=str(tmp_path / "32")))
+    asyncio.run(ingest("f16", sa))
+    asyncio.run(ingest("f32", sb))
+    assert sa.count() == sb.count() == len(sentences)
+    assert sa._vectors.dtype == np.float32
+    # f16 rounding is the only difference (~2^-11 relative)
+    np.testing.assert_allclose(sa._vectors, sb._vectors, atol=2e-3)
+
+
+def test_upsert_rows_upcasts_f16_view(tmp_path):
+    from symbiont_tpu.config import VectorStoreConfig
+    from symbiont_tpu.memory.vector_store import VectorStore
+
+    rng = np.random.default_rng(9)
+    rows32 = rng.standard_normal((4, 16)).astype(np.float32)
+    rows16 = np.frombuffer(rows32.astype("<f2").tobytes(),
+                           dtype="<f2").reshape(4, 16)
+    assert not rows16.flags.writeable  # the zero-copy bus view shape
+    store = VectorStore(VectorStoreConfig(dim=16, data_dir=str(tmp_path)))
+    ids = [deterministic_point_id("d", i) for i in range(4)]
+    store.upsert_rows(ids, rows16, [{"sentence_text": str(i)}
+                                    for i in range(4)])
+    assert store._vectors.dtype == np.float32
+    want = rows16.astype(np.float32)
+    want = want / np.linalg.norm(want, axis=1, keepdims=True)
+    np.testing.assert_allclose(store._vectors, want, rtol=1e-6)
+
+
 def test_engine_embed_reply_negotiation(tmp_path):
     """Request-reply negotiation: a caller opting in gets a frame reply; a
     caller that does not (an old peer) gets JSON float lists — and both
@@ -351,6 +486,83 @@ def test_engine_embed_reply_negotiation(tmp_path):
                 rtol=1e-6)
         finally:
             await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
+def test_engine_embed_reply_frame16_negotiation(tmp_path):
+    """Per-hop dtype negotiation, both directions: a frame16 caller gets a
+    half-width reply from a NEW engine; the same request to an engine that
+    has never heard of frame16 (reference-era peer, simulated by a stub
+    that ignores `encoding`) degrades to the JSON float-list path every
+    caller accepts."""
+    from symbiont_tpu.config import EngineConfig
+    from symbiont_tpu.engine.engine import TpuEngine
+    from symbiont_tpu.services.engine_service import EngineService
+
+    async def scenario():
+        bus = InprocBus()
+        eng = TpuEngine(EngineConfig(embedding_dim=32, length_buckets=[8, 16],
+                                     batch_buckets=[2, 4], dtype="float32"))
+        svc = EngineService(bus, engine=eng)
+        await svc.start()
+        try:
+            texts = ["hello world", "tpu"]
+            msg = await bus.request(
+                subjects.ENGINE_EMBED_BATCH,
+                json.dumps({"texts": texts,
+                            "encoding": "frame16"}).encode(), timeout=30.0)
+            meta_b, rows = frames.detach_frame(msg.data, msg.headers)
+            meta = json.loads(meta_b)
+            assert meta["error_message"] is None
+            assert rows is not None and rows.dtype == np.float16
+            assert rows.shape == (2, 32)
+            assert msg.headers[frames.FRAME_HEADER].startswith("tensor/f16")
+
+            # f32 baseline from the same engine: f16 reply == f32 reply
+            # within half rounding
+            msg2 = await bus.request(
+                subjects.ENGINE_EMBED_BATCH,
+                json.dumps({"texts": texts,
+                            "encoding": "frame"}).encode(), timeout=30.0)
+            _, rows32 = frames.detach_frame(msg2.data, msg2.headers)
+            np.testing.assert_allclose(rows.astype(np.float32), rows32,
+                                       atol=2e-3)
+        finally:
+            await svc.stop()
+            await bus.close()
+
+    asyncio.run(scenario())
+
+
+def test_frame16_request_to_old_engine_degrades_to_json():
+    """The old-peer half of the negotiation: a reference-era engine that
+    ignores the `encoding` field replies JSON float lists, and the
+    frame-capable caller's detach_frame path handles it unchanged."""
+    async def scenario():
+        bus = InprocBus()
+        sub = await bus.subscribe(subjects.ENGINE_EMBED_BATCH)
+
+        async def old_engine():
+            msg = await sub.next(5.0)
+            req = json.loads(msg.data)  # ignores req["encoding"] entirely
+            await bus.publish(msg.reply, json.dumps(
+                {"vectors": [[1.0, 2.0]] * len(req["texts"]),
+                 "error_message": None}).encode())
+
+        task = asyncio.ensure_future(old_engine())
+        try:
+            msg = await bus.request(
+                subjects.ENGINE_EMBED_BATCH,
+                json.dumps({"texts": ["a"],
+                            "encoding": "frame16"}).encode(), timeout=5.0)
+            meta_b, rows = frames.detach_frame(msg.data, msg.headers)
+            assert rows is None  # JSON fallback — no frame rode along
+            assert json.loads(meta_b)["vectors"] == [[1.0, 2.0]]
+            await task
+        finally:
+            sub.close()
             await bus.close()
 
     asyncio.run(scenario())
@@ -435,9 +647,11 @@ CPP_HARNESS = r"""
 #include <string>
 
 // stdin: full frame-bearing body; argv[1]: the X-Symbiont-Frame header
-// value. Decodes via symbiont::split_frame, prints rows/cols and every
-// float (%.9g round-trips f32), then re-encodes the payload through
-// symbiont::make_frame and prints its hex — Python asserts both ways.
+// value. Decodes via symbiont::split_frame, prints rows/cols/dtype and
+// every float (%.9g round-trips f32; f16 payloads upconvert through
+// symbiont::half_to_float), then re-encodes the payload through
+// symbiont::make_frame AT ITS WIRE DTYPE and prints its hex — Python
+// asserts both ways, for the f32 and the half-width f16 form alike.
 int main(int argc, char** argv) {
   std::string body((std::istreambuf_iterator<char>(std::cin)),
                    std::istreambuf_iterator<char>());
@@ -449,12 +663,12 @@ int main(int argc, char** argv) {
     std::printf("noframe\n");
     return 0;
   }
-  std::printf("%u %u\n", fv.rows, fv.cols);
+  std::printf("%u %u %u\n", fv.rows, fv.cols, (unsigned)fv.dtype);
   auto rows = symbiont::frame_rows(fv);
   for (const auto& r : rows)
     for (float v : r) std::printf("%.9g\n", (double)v);
   std::string raw(fv.payload, fv.payload_len);
-  std::string re = symbiont::make_frame(raw, fv.rows, fv.cols);
+  std::string re = symbiont::make_frame(raw, fv.rows, fv.cols, fv.dtype);
   for (unsigned char c : re) std::printf("%02x", c);
   std::printf("\n");
   return 0;
@@ -481,23 +695,29 @@ def _compile_harness(tmp: Path):
 
 def test_cpp_frame_parity():
     """Python encodes → the real C++ decoder decodes; the real C++ encoder
-    re-emits → bytes identical to Python's. Skips where the native tree
-    cannot compile (this sandbox's gcc lacks float to_chars)."""
+    re-emits → bytes identical to Python's. Covers BOTH wire dtypes (the
+    f16 golden-byte parity satellite rides the same harness). Skips where
+    the native tree cannot compile (this sandbox's gcc lacks float
+    to_chars)."""
     with tempfile.TemporaryDirectory() as td:
         exe = _compile_harness(Path(td))
         body = b'{"meta":1}'
-        data, headers = frames.attach_frame(body, GOLDEN_ROWS)
-        out = subprocess.run(
-            [str(exe), headers[frames.FRAME_HEADER]], input=data,
-            capture_output=True, timeout=60).stdout.decode().split()
-        rows, cols = int(out[0]), int(out[1])
-        assert (rows, cols) == GOLDEN_ROWS.shape
-        got = np.array(out[2:2 + rows * cols],
-                       np.float32).reshape(rows, cols)
-        np.testing.assert_array_equal(got, GOLDEN_ROWS)
-        # C++ re-encoded frame == Python-encoded frame, byte for byte
-        assert bytes.fromhex(out[2 + rows * cols]) == \
-            frames.encode_frame(GOLDEN_ROWS)
+        for dtype, code in (("f32", 1), ("f16", 2)):
+            data, headers = frames.attach_frame(body, GOLDEN_ROWS,
+                                                dtype=dtype)
+            out = subprocess.run(
+                [str(exe), headers[frames.FRAME_HEADER]], input=data,
+                capture_output=True, timeout=60).stdout.decode().split()
+            rows, cols, dt = int(out[0]), int(out[1]), int(out[2])
+            assert (rows, cols) == GOLDEN_ROWS.shape and dt == code
+            got = np.array(out[3:3 + rows * cols],
+                           np.float32).reshape(rows, cols)
+            # GOLDEN_ROWS is exactly representable in f16, so both forms
+            # decode to identical f32 values
+            np.testing.assert_array_equal(got, GOLDEN_ROWS)
+            # C++ re-encoded frame == Python-encoded frame, byte for byte
+            assert bytes.fromhex(out[3 + rows * cols]) == \
+                frames.encode_frame(GOLDEN_ROWS, dtype=dtype)
         # and a frameless body passes through as the JSON fallback
         noframe = subprocess.run([str(exe)], input=body,
                                  capture_output=True, timeout=60)

@@ -217,6 +217,40 @@ def test_asdict_allowlist_entries_still_exist():
     assert not stale, f"ASDICT_ALLOWED entries no longer present: {stale}"
 
 
+# --------------------------------------------------------------------------
+# Frame-dtype guard: the SYTF dtype registry (name ↔ header byte ↔ numpy
+# dtype ↔ content type) lives in schema/frames.py and NOWHERE else. A
+# service hand-rolling a frame header, magic, dtype byte, or dtype-name
+# literal is how a future dtype ends up half-wired (decodable on one hop,
+# garbage on another). One allowlisted encoder may map a negotiated
+# encoding value to a dtype name; everything else calls frames helpers
+# with no dtype knowledge at all.
+
+FRAME_DTYPE_ALLOWED = {
+    ("symbiont_tpu/services/engine_service.py",
+     "EngineService._embed_batch.op"),
+}
+
+# hand-rolled content types, the frame magic, dtype-constant references,
+# or quoted dtype-name literals — anywhere in services/
+_FRAME_DTYPE = re.compile(
+    r"""tensor/f|SYTF|DTYPE_F|["']f(?:16|32)["']""")
+
+
+def test_no_hardcoded_frame_dtype_in_services():
+    offenders = _pattern_sites(_FRAME_DTYPE) - FRAME_DTYPE_ALLOWED
+    assert not offenders, (
+        "hard-coded frame dtype outside schema/frames.py — the dtype "
+        "registry is centralized there so new dtypes (f16 was the first) "
+        "wire every hop at once. Call frames.attach_frame/encode_frame "
+        f"with a negotiated name instead: {sorted(offenders)}")
+
+
+def test_frame_dtype_allowlist_entries_still_exist():
+    stale = FRAME_DTYPE_ALLOWED - _pattern_sites(_FRAME_DTYPE)
+    assert not stale, f"FRAME_DTYPE_ALLOWED entries no longer present: {stale}"
+
+
 def test_scanner_sees_known_ground_truth():
     """Self-check so the scanner can't silently rot into vacuous passes:
     a few known call sites must classify as expected."""
