@@ -4,6 +4,8 @@
     python bench.py --quick         # embed-policy tier only (~1 min)
     python bench.py --no-e2e        # skip the full-stack tier
     python bench.py --no-chaos      # skip the fault-injection tier
+    python bench.py --only multichip           # one tier (no persist)
+    python bench.py --mesh dp4xtp2             # multichip tier mesh shape
     python bench.py --render-doc BENCH_rNN.json > docs/PERF.md
     python bench.py --gate NEW.json BASELINE.json   # regression gate
     python bench.py --validate ARCHIVE.json [...]   # schema check
@@ -191,20 +193,38 @@ def main(argv=None) -> int:
 
     # tier implementations register themselves on import; import order IS
     # run order: obs + serialization micro-tiers (host-only, fastest),
-    # policy A/B, compute MFU, engine plane, decode, full stack, then the
-    # fault-injection (loss-under-fault) tier
+    # policy A/B, compute MFU, engine plane, decode, multi-chip scale,
+    # full stack, then the fault-injection (loss-under-fault) tier
     from symbiont_tpu.bench import obs  # noqa: F401
     from symbiont_tpu.bench import serialization  # noqa: F401
     from symbiont_tpu.bench import compute  # noqa: F401
     from symbiont_tpu.bench import engine_plane  # noqa: F401
     from symbiont_tpu.bench import decode  # noqa: F401
     from symbiont_tpu.bench import quant  # noqa: F401
+    from symbiont_tpu.bench import multichip  # noqa: F401
     from symbiont_tpu.bench import e2e  # noqa: F401
     from symbiont_tpu.bench import chaos  # noqa: F401
 
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
-    ctx = types.SimpleNamespace(device=dev, peak=chip_peak_flops(dev))
+    mesh_shape = None
+    if "--mesh" in argv:
+        # "--mesh dp4xtp2" → [4, 2]: the multichip tier's mesh shape (the
+        # CLI spelling of SYMBIONT_PARALLEL_MESH_SHAPE, shared parser in
+        # parallel/mesh.py)
+        from symbiont_tpu.parallel.mesh import parse_mesh_spec
+
+        try:
+            mesh_shape = parse_mesh_spec(argv[argv.index("--mesh") + 1])
+        except IndexError:
+            log("usage: bench.py --mesh dp4xtp2")
+            return 2
+        except ValueError as e:  # unparseable spec: usage, not a traceback
+            log(f"--mesh: {e}")
+            log("usage: bench.py --mesh dp4xtp2")
+            return 2
+    ctx = types.SimpleNamespace(device=dev, peak=chip_peak_flops(dev),
+                                mesh_shape=mesh_shape)
     _maybe_register_injection()
 
     quick = "--quick" in argv
@@ -214,6 +234,23 @@ def main(argv=None) -> int:
         skip.append("e2e")
     if "--no-chaos" in argv:
         skip.append("chaos")
+    only = None
+    if "--only" in argv:
+        # run just the named tier(s): everything else lands in tier_skips,
+        # which exempts their declared primaries — and the partial line is
+        # NOT persisted as BENCH_LATEST.json (it is not a full run)
+        try:
+            only = {t.strip()
+                    for t in argv[argv.index("--only") + 1].split(",")}
+        except IndexError:
+            log("usage: bench.py --only TIER[,TIER...]")
+            return 2
+        unknown = only - set(tiers.registry())
+        if unknown:
+            log(f"--only: unknown tier(s) {sorted(unknown)}; "
+                f"registered: {sorted(tiers.registry())}")
+            return 2
+        skip.extend(name for name in tiers.registry() if name not in only)
     run = tiers.run_tiers(results, ctx, quick=quick, skip=tuple(skip),
                           log=log)
     # dual-ceiling utilization over every decode point, after ALL tiers:
@@ -241,7 +278,7 @@ def main(argv=None) -> int:
     for p in schema_problems:
         log(f"SCHEMA (emitted line): {p}")
     print(json.dumps(line))
-    if not quick:
+    if not quick and only is None:
         _persist_latest(line)
     for fail in run.failures:
         log(f"TIER FAILURE: {fail['tier']}: {fail['exc']}")

@@ -162,8 +162,10 @@ class LmConfig:
     # Weight quantization at load time (models/quant.py; same modes and
     # parity bars as EngineConfig.quantize). Applied by _place_params on
     # every parameter placement — including online fine-tune syncs, whose
-    # f32 masters re-quantize on each update_params. Single-device only:
-    # a TP mesh falls back to unquantized sharded placement with a warning.
+    # f32 masters re-quantize on each update_params. Composes with TP
+    # decode: QuantTensor codes shard on the kernel's own axes and the
+    # per-output-channel scales ride the same axis (parallel/sharding.py),
+    # so `quantize=int8` + `tensor>1` serves sharded AND narrow.
     quantize: str = "none"
     # KV-cache storage for decode sessions: "none" keeps cfg.dtype slabs;
     # "int8" stores per-(position, head)-scaled int8 K/V — quantize-on-
@@ -299,9 +301,32 @@ class PerceptionConfig:
 
 @dataclass
 class ParallelConfig:
+    """The live stack's device mesh (docs/SCALING.md, ROADMAP item 1).
+
+    The runner builds ONE mesh from this section at stack start and threads
+    it through TpuEngine (DP embed over 'data'), LmEngine (TP decode over
+    'tensor') and the embedded vector store (corpus rows sharded over
+    'data') — going multi-chip is a config change, not a code change.
+    SYMBIONT_PARALLEL_MESH_SHAPE='[4, 2]' is the env spelling of dp4xtp2."""
+
+    # serve from a mesh at all; off → every engine gets mesh=None (the
+    # pre-mesh single-chip behavior, byte-identical executables)
+    enabled: bool = True
     # Mesh axes: data / tensor. PP/SP axes are pluggable (SURVEY.md §2 table).
     mesh_shape: Optional[List[int]] = None  # None → (n_devices, 1)
     axis_names: List[str] = field(default_factory=lambda: ["data", "tensor"])
+
+    def __post_init__(self) -> None:
+        if self.mesh_shape is not None:
+            if (not self.mesh_shape
+                    or any(int(s) < 1 for s in self.mesh_shape)):
+                raise ValueError(
+                    f"parallel.mesh_shape must be positive ints, "
+                    f"got {self.mesh_shape!r}")
+            if len(self.mesh_shape) != len(self.axis_names):
+                raise ValueError(
+                    f"parallel.mesh_shape {self.mesh_shape} must name one "
+                    f"size per axis in {self.axis_names}")
 
 
 @dataclass

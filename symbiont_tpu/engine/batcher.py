@@ -267,7 +267,16 @@ class MicroBatcher(_BatcherBase):
                     else engine.config.flush_deadline_ms) / 1000.0
         from symbiont_tpu.config import EngineConfig
 
-        super().__init__(max_batch or engine.config.max_batch, deadline,
+        mb = max_batch or engine.config.max_batch
+        # mesh-aware flush sizing (docs/SCALING.md): round the flush cap up
+        # to a multiple of the mesh 'data' axis so a full flush splits into
+        # EVEN replica shards — a cap of, say, 100 over 8 replicas would
+        # batch-bucket to 104 and ship 4 permanent pad rows per full flush.
+        # Stub engines without DP accounting (tests) default to 1.
+        nd = getattr(engine, "_n_data", 1)
+        if nd > 1:
+            mb = ((mb + nd - 1) // nd) * nd
+        super().__init__(mb, deadline,
                          max_inflight_flushes=(
                              max_inflight_flushes
                              if max_inflight_flushes is not None

@@ -303,17 +303,27 @@ class TpuEngine:
             # cosine scores against the device-resident corpus + top-k, ONE
             # compiled program — the whole search hop is a single device
             # round-trip (the split embed→search path pays ≥2; on a
-            # network-attached chip each costs ~100ms).
+            # network-attached chip each costs ~100ms). With a mesh whose
+            # 'data' axis > 1 the corpus arrives row-sharded: each shard
+            # scores its own rows and keeps a local top-k, and only the
+            # [n_shards × k] candidates cross the interconnect for the
+            # global merge (parallel/sharding.corpus_topk — result order
+            # identical to the unsharded path, pinned in tests).
             import jax.numpy as jnp
 
             cfg, pooling = self._attn_cfg(self.model_cfg, L), self.pooling
             cap, k = B  # for qsearch the batch slot carries (capacity, top_k)
+            mesh = self.mesh if self._corpus_sharded(cap) else None
 
             def fn(params, ids, mask, corpus, n_valid):
                 ids = ids.astype(jnp.int32)
                 emb = bert_mod.embed_sentences(params, ids, mask, cfg,
                                                pooling=pooling, normalize=True)
                 q = emb[0].astype(jnp.bfloat16)  # [D]
+                if mesh is not None:
+                    from symbiont_tpu.parallel.sharding import corpus_topk
+
+                    return corpus_topk(mesh, corpus, q, n_valid, k)
                 scores = (corpus.astype(jnp.bfloat16) @ q).astype(jnp.float32)
                 valid = jnp.arange(cap) < n_valid
                 scores = jnp.where(valid, scores, -jnp.inf)
@@ -397,6 +407,27 @@ class TpuEngine:
         metrics.gauge_set("engine.bucket_pad_waste_ratio",
                           round(1.0 - real / total, 4) if total else 0.0,
                           labels=labels)
+        if self._n_data > 1 and batch_rows:
+            # DP accounting (docs/SCALING.md): rows shard contiguously over
+            # the 'data' axis, real rows first, so the trailing replicas
+            # carry the padding. Per-replica padding waste names WHICH
+            # replicas burn cycles on pad rows, and the balance gauge
+            # (min real rows ÷ max real rows) reads 1.0 when every replica
+            # does equal useful work.
+            per = batch_rows // self._n_data
+            real_rows = [min(max(n_real - r * per, 0), per)
+                         for r in range(self._n_data)]
+            for r, rr in enumerate(real_rows):
+                metrics.gauge_set(
+                    "batcher.padding_waste",
+                    round(1.0 - rr / per, 4) if per else 0.0,
+                    labels={"service": "engine", "replica": str(r)})
+            mx = max(real_rows)
+            metrics.gauge_set("engine.dp_shard_balance",
+                              round(min(real_rows) / mx, 4) if mx else 0.0,
+                              labels=labels)
+            metrics.gauge_set("engine.dp_replicas", self._n_data,
+                              labels=labels)
 
     def _device_batch(self, *arrays: np.ndarray):
         """Move batch-dim-0 arrays to the device (sharded over 'data' when
@@ -429,6 +460,14 @@ class TpuEngine:
             b = max(b, self._n_data)
             b = ((b + self._n_data - 1) // self._n_data) * self._n_data
         return b
+
+    def _corpus_sharded(self, cap: int) -> bool:
+        """Whether a [cap, D] corpus operand rides the mesh row-sharded —
+        the store shards whenever it holds the same mesh with 'data' > 1
+        (its capacity blocks are rounded to the axis size)."""
+        return (self.mesh is not None
+                and self.mesh.shape.get("data", 1) > 1
+                and cap % self.mesh.shape["data"] == 0)
 
     # ---------------------------------------------------------------- embed
 

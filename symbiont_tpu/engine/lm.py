@@ -338,22 +338,16 @@ class LmEngine:
 
         LmConfig.quantize != "none" quantizes here too (once per placement,
         host-side), so online fine-tune syncs re-quantize their f32 masters
-        transparently. Quantized placement is single-device only: a TP mesh
-        shards by per-leaf PartitionSpecs that don't know QuantTensor, so
-        that combination falls back to unquantized sharding with a warning
-        — decode must not brick because the mesh grew a tensor axis."""
+        transparently. Quantized placement composes with TP: shard_params
+        places QuantTensor codes by the kernel's own PartitionSpec and the
+        per-output-channel scales on the kernel's last-axis entry
+        (parallel/sharding.py), so `quantize=int8` + `tensor>1` decodes
+        sharded AND narrow — the PR 7 fallback (unquantized params on any
+        mesh, with a warning) is gone."""
         import jax
         import jax.numpy as jnp
 
         mode = self.config.quantize
-        if mode in ("int8", "fp8") and self.mesh is not None:
-            # only the QuantTensor modes can't shard (PartitionSpecs don't
-            # know the node type); f16 yields plain bf16 arrays and shards
-            # fine, so it does NOT take this fallback
-            log.warning(
-                "lm.quantize=%s is single-device only; TP-sharded decode "
-                "keeps unquantized params", mode)
-            mode = "none"
         dtype = jnp.dtype(self.model_cfg.dtype)
         if mode != "none":
             from symbiont_tpu.models import quant
@@ -373,9 +367,9 @@ class LmEngine:
                 if (hasattr(a, "dtype")
                     and jnp.issubdtype(a.dtype, jnp.floating))
                 else a, params)
+        storage = mode if mode != "none" else self.model_cfg.dtype
+        self._note_param_bytes(params, storage)
         if self.mesh is None:
-            storage = mode if mode != "none" else self.model_cfg.dtype
-            self._note_param_bytes(params, storage)
             return jax.device_put(params)
         from symbiont_tpu.parallel.sharding import (
             gpt_param_sharding,

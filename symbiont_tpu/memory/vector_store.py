@@ -209,15 +209,34 @@ class VectorStore:
         self._device_rows = n
         self._dirty = False
 
+    def _sharded(self, cap: int) -> bool:
+        """Corpus rows live sharded over the mesh 'data' axis (capacity is
+        rounded to the axis size in _capacity, so this holds whenever a
+        multi-device mesh was threaded in)."""
+        return (self.mesh is not None
+                and self.mesh.shape.get("data", 1) > 1
+                and cap % self.mesh.shape["data"] == 0)
+
     def _get_search_fn(self, cap: int, k: int):
         import jax
         import jax.numpy as jnp
 
         key = (cap, k)
         if key not in self._search_fns:
+            mesh = self.mesh if self._sharded(cap) else None
+
             def fn(corpus, query, n_valid):
                 # cosine == dot product (rows and query pre-normalized);
-                # bf16 matmul on the MXU, fp32 scores.
+                # bf16 matmul on the MXU, fp32 scores. Sharded corpora do a
+                # per-shard top-k + global merge so only k candidates per
+                # shard cross the interconnect — result order identical to
+                # the single-device path (parallel/sharding.corpus_topk,
+                # pinned in tests/test_multichip_serving.py).
+                if mesh is not None:
+                    from symbiont_tpu.parallel.sharding import corpus_topk
+
+                    return corpus_topk(mesh, corpus,
+                                       query.astype(jnp.bfloat16), n_valid, k)
                 q = query.astype(jnp.bfloat16)
                 c = corpus.astype(jnp.bfloat16)
                 scores = (c @ q).astype(jnp.float32)

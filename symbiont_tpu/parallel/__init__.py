@@ -34,9 +34,12 @@ from symbiont_tpu.parallel.mesh import (
     build_mesh,
     init_distributed,
     local_device_count,
+    mesh_from_config,
+    parse_mesh_spec,
 )
 from symbiont_tpu.parallel.sharding import (
     batch_sharding,
+    corpus_topk,
     gpt_param_sharding,
     replicate,
     shard_params,

@@ -1,0 +1,36 @@
+"""jax version compatibility for the parallel plane.
+
+The serving mesh is now load-bearing for the LIVE stack (runner builds it
+at boot), so `parallel/` must import and run on every jax this project
+meets: the newer toolchains where `shard_map`/`axis_size`/`pcast` are
+top-level stable API, AND the 0.4.x line where shard_map lives in
+`jax.experimental`, the in-collective axis size comes from
+`jax.core.axis_frame`, and pcast does not exist (everything inside
+shard_map is implicitly device-varying there, so it is a no-op).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5 promoted shard_map to the top-level API
+    from jax import shard_map  # type: ignore  # noqa: F401
+except ImportError:  # the 0.4.x toolchain keeps it in experimental
+    from jax.experimental.shard_map import shard_map  # type: ignore # noqa: F401
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis, inside a collective context."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)  # 0.4.x: int (or frame w/ .size)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def pcast(x, axis_name, to: str = "varying"):
+    """Mark values device-varying over an axis (newer shard_map's explicit
+    varying-manual-axes tracking). On 0.4.x shard_map every value already
+    is, so the cast is the identity."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

@@ -73,3 +73,36 @@ def build_mesh(
         raise ValueError(f"mesh shape {shape} does not cover {n} devices")
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, tuple(axis_names))
+
+
+def parse_mesh_spec(spec: str) -> list:
+    """`"dp4xtp2"` → [4, 2] (also plain `"4x2"`, or `"8"` for pure DP).
+
+    The human-facing mesh shorthand of the bench CLI's `--mesh` knob and
+    docs/SCALING.md: `dp<N>` is the 'data' axis, `tp<N>` the 'tensor' axis,
+    in that order. Kept here (not in bench/) so deployment tooling can share
+    the exact same parse."""
+    import re
+
+    s = spec.strip().lower()
+    m = re.fullmatch(r"dp(\d+)(?:xtp(\d+))?", s)
+    if m:
+        return [int(m.group(1)), int(m.group(2) or 1)]
+    m = re.fullmatch(r"tp(\d+)", s)
+    if m:
+        return [1, int(m.group(1))]
+    m = re.fullmatch(r"(\d+)(?:x(\d+))?", s)
+    if m:
+        return [int(m.group(1)), int(m.group(2) or 1)]
+    raise ValueError(
+        f"mesh spec {spec!r} not understood: use dpNxtpM, dpN, tpM, NxM or N")
+
+
+def mesh_from_config(parallel_cfg) -> Mesh:
+    """THE production mesh constructor (ROADMAP item 1): build the serving
+    mesh purely from `ParallelConfig` — `mesh_shape` unset means all local
+    devices on the 'data' axis, 1 on the rest. The runner calls this once at
+    stack start and threads the result through TpuEngine, LmEngine, and the
+    vector store; no caller ever hands a mesh in by hand to go multi-chip."""
+    return build_mesh(parallel_cfg.mesh_shape,
+                      tuple(parallel_cfg.axis_names))

@@ -31,7 +31,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from symbiont_tpu.parallel.compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from symbiont_tpu.models.gpt import (
@@ -178,7 +178,7 @@ def lm_loss_pp(params: Params, batch: dict, cfg: GPTConfig, mesh: Mesh,
         #                                    not drift from weak to strong
         # the carry becomes device-varying after the first tick (axis_index
         # select + ppermute), so the initial value must be marked varying too
-        x0, zero_ce, zero_w = jax.lax.pcast((x0, zero, zero), (axis,),
+        x0, zero_ce, zero_w = pcast((x0, zero, zero), (axis,),
                                             to="varying")
         (x, ce_acc, w_acc), _ = jax.lax.scan(
             tick, (x0, zero_ce, zero_w), jnp.arange(M + n_stages - 1))

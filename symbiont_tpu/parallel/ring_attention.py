@@ -23,7 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from symbiont_tpu.parallel.compat import axis_size, pcast, shard_map
 
 
 def ring_attention(
@@ -39,7 +39,7 @@ def ring_attention(
     blocks are what rotates over the ring — expanding to NH happens only at
     the local score computation, so grouped-query models don't pay
     NH/KVH × the necessary ICI bandwidth."""
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, S, NH, D = q.shape
     KVH = k.shape[2]
@@ -90,7 +90,7 @@ def ring_attention(
     # the ring axis so the fori_loop carry type is stable under shard_map's
     # varying-axis tracking.
     def vary(x):
-        return jax.lax.pcast(x, axis_name, to="varying")
+        return pcast(x, axis_name, to="varying")
 
     m0 = vary(jnp.full((B, NH, S), -jnp.inf, jnp.float32))
     l0 = vary(jnp.zeros((B, NH, S), jnp.float32))

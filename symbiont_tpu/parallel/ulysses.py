@@ -32,7 +32,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from symbiont_tpu.parallel.compat import axis_size, shard_map
 
 
 def _full_attention(q, k, v, causal: bool) -> jax.Array:
@@ -57,7 +57,7 @@ def ulysses_attention(
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence; call inside
     shard_map. Requires NH % axis_size == 0."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     NH = q.shape[2]
     if NH % n != 0:
         raise ValueError(f"num_heads {NH} not divisible by axis size {n}")

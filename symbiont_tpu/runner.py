@@ -90,6 +90,45 @@ class SymbiontStack:
         self.services = []
         self.bus = self._bus_override or await connect(cfg.bus.url)
 
+        # Multi-chip serving plane (ROADMAP item 1): the mesh is a first-
+        # class, config-driven property of the live stack. When this process
+        # is about to construct a real device engine (embed or LM) and no
+        # caller handed a mesh in, build one from cfg.parallel —
+        # mesh_shape unset means all local devices on the 'data' axis, so a
+        # multi-chip host serves DP out of the box and a single-chip host
+        # gets an inert (1, 1) mesh with byte-identical executables. The
+        # same mesh reaches the vector store (corpus rows shard over 'data')
+        # and LmEngine (TP decode when 'tensor' > 1). Stub-engine test
+        # stacks (engine override) skip it: no real device work, no mesh.
+        builds_real_engine = (
+            self._engine_override is None
+            and (on("preprocessing") or on("engine")))
+        builds_real_lm = cfg.lm.enabled and (on("text_generator")
+                                             or on("engine"))
+        # a standalone vector_memory worker (store in this process, engine
+        # elsewhere) still owns a device-resident corpus — it needs the
+        # mesh too, or corpus-sharded search silently degrades to one chip.
+        # The engine-override guard keeps stub-engine test stacks meshless.
+        builds_embedded_store = (
+            self._engine_override is None
+            and (on("vector_memory") or on("engine"))
+            and not cfg.vector_store.uri
+            and cfg.vector_store.device_resident)
+        if (cfg.parallel.enabled and self._mesh is None
+                and (builds_real_engine or builds_real_lm
+                     or builds_embedded_store)):
+            from symbiont_tpu.parallel.mesh import mesh_from_config
+
+            self._mesh = mesh_from_config(cfg.parallel)
+            log.info("serving mesh: %s",
+                     dict(self._mesh.shape))
+        if self._mesh is not None:
+            # mesh.devices{axis}: the serving topology, readable off
+            # /metrics (docs/OBSERVABILITY.md)
+            for axis, size in dict(self._mesh.shape).items():
+                metrics.gauge_set("mesh.devices", size,
+                                  labels={"axis": str(axis)})
+
         # at-least-once pipeline (SURVEY.md §5.3): one durable stream captures
         # the fire-and-forget subjects; each consumer acks after its side
         # effect lands. Request-reply subjects stay core (their failure mode

@@ -354,7 +354,8 @@ def test_cli_main_end_to_end_stub_registry(monkeypatch, capsys):
     # register into the ORIGINAL registry — main()'s imports then no-op and
     # only the stubs below exist in the patched registry
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
+        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
+        serialization)
 
     monkeypatch.setattr(tiers, "_REGISTRY", {})
 
@@ -379,6 +380,42 @@ def test_cli_main_end_to_end_stub_registry(monkeypatch, capsys):
     assert any(f["tier"] == "stub_bomb" and "kaboom" in f["exc"]
                for f in line["tier_failures"])
     assert archive.validate_line(line) == []
+
+
+def test_cli_only_runs_named_tier_and_never_persists(monkeypatch, capsys):
+    """`--only TIER` (scripts/multichip.sh's fast loop) runs just the named
+    tier, archives every other tier under tier_skips (exempting their
+    primaries), rejects unknown names, and NEVER overwrites
+    BENCH_LATEST.json — a partial line must not become the doc's source."""
+    from symbiont_tpu.bench import cli
+    from symbiont_tpu.bench import (  # noqa: F401
+        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
+        serialization)
+
+    monkeypatch.setattr(tiers, "_REGISTRY", {})
+
+    @tiers.register("stub_a", primary_metrics=("a_metric",))
+    def stub_a(results, ctx):
+        results["a_metric"] = 1.0
+
+    @tiers.register("stub_b", primary_metrics=("b_metric",))
+    def stub_b(results, ctx):
+        raise RuntimeError("must never run under --only stub_a")
+
+    persisted = []
+    monkeypatch.setattr(cli, "_persist_latest",
+                        lambda line: persisted.append(line))
+    rc = cli.main(["--only", "stub_a"])
+    line = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert line["tier_failures"] == []
+    assert line["a_metric"] == 1.0
+    assert "stub_b" in line["tier_skips"]
+    assert "b_metric" not in line["primary_metrics"]
+    assert persisted == []  # --only is a partial run: no BENCH_LATEST
+
+    assert cli.main(["--only", "no_such_tier"]) == 2
+    capsys.readouterr()
 
 
 def test_gate_rejects_null_parsed_on_either_side(tmp_path):
@@ -461,7 +498,8 @@ def test_declared_primary_metrics_single_source():
     from symbiont_tpu.bench import cli
     # the real tier modules must be registered for this check
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
+        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
+        serialization)
 
     declared = cli.declared_primary_metrics()
     assert cli.ROOFLINE_PRIMARY in declared
@@ -501,7 +539,8 @@ def test_declared_primary_metrics_excludes_skipped_tiers():
     lost metric (review finding)."""
     from symbiont_tpu.bench import cli
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, obs, quant, serialization)
+        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
+        serialization)
 
     full = cli.declared_primary_metrics()
     no_e2e = cli.declared_primary_metrics(skips={"e2e": "skipped by flag"})
