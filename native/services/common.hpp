@@ -34,8 +34,10 @@ inline const char* DATA_PROCESSED_TEXT_TOKENIZED = "data.processed_text.tokenize
 inline const char* TASKS_GENERATION_TEXT = "tasks.generation.text";
 inline const char* EVENTS_TEXT_GENERATED = "events.text.generated";
 inline const char* EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial";
+inline const char* TASKS_GENERATION_CANCEL = "tasks.generation.cancel";
 inline const char* TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query";
 inline const char* TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request";
+inline const char* TASKS_SEARCH_GRAPH_REQUEST = "tasks.search.graph.request";
 inline const char* ENGINE_EMBED_BATCH = "engine.embed.batch";
 inline const char* ENGINE_EMBED_QUERY = "engine.embed.query";
 inline const char* ENGINE_RERANK = "engine.rerank";
@@ -54,6 +56,11 @@ inline const char* Q_TEXT_GENERATOR = "q.text_generator";
 
 inline const char* TRACE_HEADER = "X-Trace-Id";
 inline const char* SPAN_HEADER = "X-Span-Id";
+// overload-protection plane (telemetry.py parity): absolute epoch-ms
+// deadline + tenant identity, threaded verbatim through child_headers so a
+// native hop in a mixed pipeline never strips the admission context
+inline const char* DEADLINE_HEADER = "X-Symbiont-Deadline";
+inline const char* TENANT_HEADER = "X-Symbiont-Tenant";
 
 inline std::string env_or(const char* key, const std::string& dflt) {
   const char* v = std::getenv(key);
@@ -115,11 +122,17 @@ inline std::map<std::string, std::string> child_headers(
   if (it == parent.end()) {  // no context: start a fresh trace
     h[TRACE_HEADER] = uuid4();
     h[SPAN_HEADER] = uuid4();
-    return h;
+  } else {
+    h[TRACE_HEADER] = it->second;
+    auto sp = parent.find(SPAN_HEADER);
+    h[SPAN_HEADER] = sp != parent.end() ? sp->second : uuid4();
   }
-  h[TRACE_HEADER] = it->second;
-  auto sp = parent.find(SPAN_HEADER);
-  h[SPAN_HEADER] = sp != parent.end() ? sp->second : uuid4();
+  // admission context threads verbatim (telemetry.child_headers parity):
+  // the deadline minted at the API edge must reach the LAST hop
+  for (const char* key : {DEADLINE_HEADER, TENANT_HEADER}) {
+    auto v = parent.find(key);
+    if (v != parent.end()) h[key] = v->second;
+  }
   return h;
 }
 

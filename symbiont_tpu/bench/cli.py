@@ -123,6 +123,18 @@ def _validate_cmd(argv: list) -> int:
     return rc
 
 
+def parse_seed_flag(argv: list, flag: str) -> int:
+    """`--load-seed N` / `--chaos-seed N` → int (default 0). Raises
+    ValueError with a usage-shaped message on a missing or non-integer
+    value — a typo'd seed must not silently run seed 0."""
+    if flag not in argv:
+        return 0
+    try:
+        return int(argv[argv.index(flag) + 1])
+    except (IndexError, ValueError):
+        raise ValueError(f"{flag}: expected an integer seed") from None
+
+
 def _maybe_register_injection() -> None:
     """SYMBIONT_BENCH_INJECT_FAILURE=1 registers a tier that always throws —
     the one-command arms-length proof that a tier failure is LOUD:
@@ -203,10 +215,21 @@ def main(argv=None) -> int:
     from symbiont_tpu.bench import quant  # noqa: F401
     from symbiont_tpu.bench import multichip  # noqa: F401
     from symbiont_tpu.bench import e2e  # noqa: F401
+    from symbiont_tpu.bench import load  # noqa: F401
     from symbiont_tpu.bench import chaos  # noqa: F401
 
     dev = jax.devices()[0]
     log(f"device: {dev.device_kind} ({dev.platform})")
+    # load-tier reproducibility: the seeds drive the workload mix and the
+    # FaultPlan, and are ARCHIVED in the tier line (load_seed/chaos_seed)
+    # so any red run replays bit-for-bit
+    try:
+        load_seed = parse_seed_flag(argv, "--load-seed")
+        chaos_seed = parse_seed_flag(argv, "--chaos-seed")
+    except ValueError as e:
+        log(str(e))
+        log("usage: bench.py --load-seed N --chaos-seed N")
+        return 2
     mesh_shape = None
     if "--mesh" in argv:
         # "--mesh dp4xtp2" → [4, 2]: the multichip tier's mesh shape (the
@@ -224,7 +247,8 @@ def main(argv=None) -> int:
             log("usage: bench.py --mesh dp4xtp2")
             return 2
     ctx = types.SimpleNamespace(device=dev, peak=chip_peak_flops(dev),
-                                mesh_shape=mesh_shape)
+                                mesh_shape=mesh_shape,
+                                load_seed=load_seed, chaos_seed=chaos_seed)
     _maybe_register_injection()
 
     quick = "--quick" in argv

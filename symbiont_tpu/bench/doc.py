@@ -403,6 +403,7 @@ vs the JSON-equivalent bytes they displaced, plus encode/decode seconds.
     quant_section = _render_quant(f)
     multichip_section = _render_multichip(f)
     overlap_section = _render_overlap(f)
+    load_section = _render_load(f)
     attribution_section = _render_attribution(r, f)
 
     mfu768 = ""
@@ -513,7 +514,7 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{frames_section}{quant_section}{multichip_section}{overlap_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{frames_section}{quant_section}{multichip_section}{overlap_section}{load_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.
@@ -673,6 +674,65 @@ proves the sharded code paths run (`scripts/multichip.sh`).
             f"{_fmt(f['mc_tp_decode_tok_per_s'])} tok/s"
             + (" (int8 weights shard and match too)"
                if f.get("mc_tp_int8_match") else ""))
+    return header + measured + ".\n\n"
+
+
+def _render_load(f: dict) -> str:
+    """The overload-protection / traffic-simulator section (ROADMAP item
+    5, bench/load.py): prose is archive-agnostic, the measured paragraph
+    appears once a run archives the load tier (`load_*` fields)."""
+    header = """## Overload protection under the multi-tenant traffic simulator
+
+The `load` tier replays a production-shaped mixed workload against the
+REAL single-process stack with chaos ON (seeded FaultPlan: handler crashes
++ delivery drops mid-ingest; `--chaos-seed`/`--load-seed` archived for
+bit-for-bit replay): ingest bursts, a search storm with one hot tenant at
+~8× everyone else's offered load, streaming generation over SSE, a
+search→generate RAG flow riding ONE trace (client-carried `X-Trace-Id`),
+and the knowledge-graph scenario (entity extraction → graph upsert →
+graph-augmented search via `POST /api/search/graph`). The overload plane
+(`resilience/admission.py`, docs/RESILIENCE.md overload rows) is what it
+proves:
+
+- **zero-loss ingest under chaos** (hard gate, EXACT point count) — 429s
+  and redelivery, never silent loss;
+- **per-tenant quotas + weighted-fair queues** — the hot tenant is clamped
+  to its own budget (Jain fairness ≥ 0.8 hard gate), overload answers
+  429-with-Retry-After instead of queuing unboundedly (queues asserted
+  empty at the end);
+- **deadline propagation** — `X-Symbiont-Deadline` minted at the edge,
+  threaded through every bus hop, expired work dropped before handlers run
+  (`admission.expired`), never retried, never DLQ'd;
+- **SLO shed ladder** — real SloWatchdog breach passes walk the rungs
+  (shed low-priority generation → degrade search: clamped top-k, rerank
+  skipped → recovery with hysteresis), observed live in the tier.
+
+"""
+    if "load_search_p99_ms" not in f:
+        return header + (
+            "This archive predates the load tier, so its measured fields "
+            "(`load_search_p99_ms`, `load_ttft_p99_ms`, "
+            "`load_zero_loss_ingest`, `load_fairness_jain`, the 429/shed "
+            "counts) will appear from the next full `python bench.py` "
+            "run.\n\n")
+    measured = (
+        f"Measured this run (seeds load={_fmt(f.get('load_seed', 0))} "
+        f"chaos={_fmt(f.get('chaos_seed', 0))}): "
+        f"{_fmt(f.get('load_ingest_docs', 0))} docs ingested under "
+        f"{_fmt(f.get('load_chaos_faults', 0))} injected faults with "
+        f"**zero loss** "
+        f"({_fmt(f.get('load_ingest_landed_points', 0))}/"
+        f"{_fmt(f.get('load_ingest_expected_points', 0))} points); search "
+        f"storm {_fmt(f.get('load_search_requests', 0))} requests → "
+        f"{_fmt(f.get('load_search_ok', 0))} served (p50 "
+        f"{f.get('load_search_p50_ms', '—')} ms, p99 "
+        f"**{f['load_search_p99_ms']} ms**) / "
+        f"{_fmt(f.get('load_throttled_429', 0))}× 429, tenant fairness "
+        f"Jain **{f['load_fairness_jain']}** with one hot tenant; TTFT p99 "
+        f"**{f['load_ttft_p99_ms']} ms** over "
+        f"{_fmt(f.get('load_gen_streams', 0))} SSE streams; shed ladder "
+        f"escalated to rung {_fmt(f.get('load_ladder_max_level', 0))} and "
+        f"recovered={bool(f.get('load_ladder_recovered', 0))}")
     return header + measured + ".\n\n"
 
 

@@ -1,0 +1,510 @@
+"""Load tier: the multi-tenant production traffic simulator (ROADMAP item 5).
+
+Open-loop load generation against the REAL single-process stack (runner +
+inproc durable bus + HTTP/SSE surface), replaying the mixed scenarios a
+million-user deployment produces — ingest bursts, search storms, streaming
+generation, a fused search→generate RAG flow riding ONE trace, and the
+knowledge-graph scenario (entity extraction → graph upsert → graph-augmented
+search) — across N simulated tenants with per-tenant quotas, WITH a seeded
+FaultPlan active (chaos ON: handler crashes + delivery drops during ingest).
+
+Hard gates (a violation throws → tier_failures → rc != 0):
+- `load_zero_loss_ingest` — EXACT point count under chaos: every accepted
+  document lands exactly once (durable redelivery + deterministic ids);
+- `load_fairness_jain` ≥ 0.8 — Jain index over per-tenant ADMITTED search
+  throughput with one hot tenant offering ~8× everyone else: quotas clamp
+  the hot tenant instead of letting it starve the rest;
+- zero unbounded-queue growth — overload answered by 429/shed (counted),
+  fair-queue and admission queues empty at the end;
+- the shed ladder demonstrably walks its rungs on REAL SloWatchdog breach
+  evaluations (low-priority generation shed → search degraded → recovery).
+
+SLO primaries archived (regression-gated across runs, not absolute-gated on
+CPU): `load_search_p99_ms`, `load_ttft_p99_ms`.
+
+Reproducibility: `--load-seed` / `--chaos-seed` (bench/cli.py) seed the
+workload mix and the FaultPlan; both are archived in the tier line so any
+red run replays bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from symbiont_tpu.bench.tiers import register
+from symbiont_tpu.bench.workload import log
+
+# workload shape (kept modest: the tier must run on CPU in ~a minute)
+N_TENANTS = 4            # equal-load tenants t0..t3
+HOT_TENANT = "hot"
+DOCS_PER_TENANT = 4      # ingest burst: 4 docs x (tenants+hot) = 20 docs
+SENTS_PER_DOC = 4
+SEARCHES_PER_TENANT = 20
+HOT_SEARCHES = 150       # ~8x a normal tenant's offered load
+GEN_STREAMS = 6
+RAG_FLOWS = 3
+GRAPH_SEARCHES = 5
+
+VOCAB = ["alpha", "beta", "gamma", "delta", "tensor", "symbiont", "matrix",
+         "vector", "graph", "stream", "decode", "ingest"]
+
+
+class _StubEngine:
+    """Deterministic duck-typed embed engine (same shape as the chaos
+    suite's): the load tier measures the SERVING plane — admission, bus,
+    store, SSE — not BERT numerics."""
+
+    class _ModelCfg:
+        hidden_size = 16
+
+    def __init__(self):
+        from symbiont_tpu.config import EngineConfig
+
+        self.config = EngineConfig(embedding_dim=16, max_batch=16,
+                                   flush_deadline_ms=2.0)
+        self.model_cfg = self._ModelCfg()
+        self.cross_params = None
+        self.stats = {"embed_calls": 0, "compiles": 0}
+
+    def embed_texts(self, texts):
+        self.stats["embed_calls"] += 1
+        import zlib
+
+        out = np.zeros((len(texts), 16), np.float32)
+        for i, t in enumerate(texts):
+            # crc32, NOT hash(): str hashing is salted per interpreter
+            # process, which would break the tier's bit-for-bit seed replay
+            rng = np.random.default_rng(zlib.crc32(t.encode("utf-8")))
+            out[i] = rng.standard_normal(16).astype(np.float32)
+        return out
+
+
+def jain_index(xs) -> float:
+    """Jain's fairness index (Σx)² / (n·Σx²): 1.0 = perfectly equal, 1/n =
+    one tenant got everything."""
+    xs = [float(x) for x in xs]
+    n = len(xs)
+    ssq = sum(x * x for x in xs)
+    if n == 0 or ssq == 0:
+        return 0.0
+    return (sum(xs) ** 2) / (n * ssq)
+
+
+def _pct(sorted_ms, q: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    return sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))]
+
+
+def _page(rng, tenant: str, i: int) -> str:
+    # exactly SENTS_PER_DOC period-terminated sentences per page (the
+    # splitter cuts on delimiters) so the zero-loss gate is EXACT arithmetic
+    sents = [f"{tenant} document {i} sentence {j} "
+             + " ".join(str(rng.choice(VOCAB)) for _ in range(4))
+             for j in range(SENTS_PER_DOC)]
+    return ("<html><body><main>"
+            + "".join(f"<p>{s}.</p>" for s in sents) + "</main></body></html>")
+
+
+@register("load", primary_metrics=(
+        "load_search_p99_ms", "load_ttft_p99_ms",
+        "load_zero_loss_ingest", "load_fairness_jain"))
+def tier_load(results: dict, ctx) -> None:
+    import asyncio
+
+    load_seed = int(getattr(ctx, "load_seed", 0) or 0)
+    chaos_seed = int(getattr(ctx, "chaos_seed", 0) or 0)
+    results["load_seed"] = load_seed
+    results["chaos_seed"] = chaos_seed
+    asyncio.run(_drive(results, load_seed, chaos_seed))
+
+
+async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
+    import asyncio
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (
+        AdmissionConfig,
+        ApiConfig,
+        GraphStoreConfig,
+        LmConfig,
+        ObsConfig,
+        SymbiontConfig,
+        TextGeneratorConfig,
+        VectorStoreConfig,
+    )
+    from symbiont_tpu.resilience.faults import FaultPlan, FaultRule
+    from symbiont_tpu.runner import SymbiontStack
+    from symbiont_tpu.utils.telemetry import metrics
+
+    rng = np.random.default_rng(load_seed)
+    tenants = [f"t{i}" for i in range(N_TENANTS)]
+    pages = {}
+    for tenant in tenants + [HOT_TENANT]:
+        for i in range(DOCS_PER_TENANT):
+            pages[f"http://load/{tenant}/{i}"] = _page(rng, tenant, i)
+
+    with tempfile.TemporaryDirectory() as td:
+        cfg = SymbiontConfig(
+            vector_store=VectorStoreConfig(dim=16, data_dir=f"{td}/vs",
+                                           shard_capacity=256),
+            graph_store=GraphStoreConfig(data_dir=f"{td}/gs"),
+            text_generator=TextGeneratorConfig(markov_state_path=None),
+            api=ApiConfig(host="127.0.0.1", port=0, fused_search=False,
+                          sse_keepalive_s=0.5),
+            lm=LmConfig(enabled=True, hidden_size=32, num_layers=1,
+                        num_heads=2, intermediate_size=64, max_positions=64,
+                        dtype="float32", prompt_buckets=[16],
+                        new_token_buckets=[16], stream_chunk=8,
+                        gen_flush_deadline_ms=5.0, temperature=0.0),
+            # slo_interval_s far beyond the tier's runtime: scenario 6
+            # drives wd.evaluate() BY HAND, and a periodic pass landing
+            # mid-tier would race it (consuming samples or adding an extra
+            # escalation) — a wall-clock flake no archived seed can replay
+            obs=ObsConfig(slo_p99_ms=["api.search=60000"],
+                          slo_interval_s=3600.0),
+            admission=AdmissionConfig(
+                # search quota: normals (SEARCHES_PER_TENANT) fit the
+                # burst; the hot tenant's ~8x flood is clamped to
+                # burst + rate x storm-seconds
+                search_rate=5.0, search_burst=float(SEARCHES_PER_TENANT),
+                ingest_rate=500.0, ingest_burst=500.0,
+                generate_rate=100.0, generate_burst=100.0,
+                # ladder demo: no dwell, 2 clean passes to step down
+                shed_hold_s=0.0, shed_recovery_passes=2,
+                degraded_top_k=3),
+        )
+        cfg.runner.services = ("perception,preprocessing,vector_memory,"
+                               "knowledge_graph,text_generator,api")
+        cfg.bus.durable = True
+        cfg.bus.durable_ack_wait_s = 0.3
+
+        plan = FaultPlan(seed=chaos_seed, rules=[
+            FaultRule(seam="handler", kind="error",
+                      match="vector_memory:data.text.with_embeddings",
+                      times=3),
+            FaultRule(seam="bus.deliver", kind="drop",
+                      match="data.text.with_embeddings", times=2),
+            FaultRule(seam="handler", kind="error",
+                      match="knowledge_graph:data.processed_text.tokenized",
+                      times=1),
+        ])
+
+        bus = InprocBus()
+        stack = SymbiontStack(cfg, bus=bus, engine=_StubEngine(),
+                              fetcher=lambda url: pages[url])
+        await stack.start()
+        loop = asyncio.get_running_loop()
+        port = stack.api.port
+
+        # the load generator gets ITS OWN thread pool: a storm of blocking
+        # HTTP clients on the default executor would starve the very embed
+        # calls it is waiting on (the stack shares that pool)
+        from concurrent.futures import ThreadPoolExecutor
+
+        client_pool = ThreadPoolExecutor(max_workers=48,
+                                         thread_name_prefix="load-client")
+
+        def _http(method, path, body=None, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=(_json.dumps(body).encode()
+                      if body is not None else None),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})}, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return r.status, _json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"{}")
+
+        def http(method, path, body=None, headers=None):
+            return loop.run_in_executor(
+                client_pool, lambda: _http(method, path, body, headers))
+
+        # one unfiltered SSE reader collects every generation event
+        sse_events: list = []
+
+        async def sse_reader():
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"GET /api/events HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    if line.startswith(b"data: "):
+                        try:
+                            sse_events.append(
+                                (time.monotonic(),
+                                 _json.loads(line[6:].strip())))
+                        except ValueError:
+                            pass
+            except (asyncio.CancelledError, ConnectionResetError):
+                pass
+            finally:
+                writer.close()
+
+        sse_task = asyncio.create_task(sse_reader())
+        await asyncio.sleep(0.2)
+
+        try:
+            # ---- scenario 1: ingest burst across tenants, chaos ON -------
+            expected = len(pages) * SENTS_PER_DOC
+            t0 = time.monotonic()
+            with plan.activate():
+                for url in pages:
+                    tenant = url.split("/")[3]
+                    status, _ = await http(
+                        "POST", "/api/submit-url", {"url": url},
+                        {"X-Symbiont-Tenant": tenant})
+                    assert status == 200, status
+                deadline = time.monotonic() + 60
+                while (time.monotonic() < deadline
+                       and stack.vector_store.count() < expected):
+                    await asyncio.sleep(0.05)
+                # let any in-flight redelivery settle, then check EXACTLY
+                await asyncio.sleep(0.5)
+            landed = stack.vector_store.count()
+            chaos_fired = sum(plan.fired.values())
+            results["load_chaos_faults"] = chaos_fired
+            results["load_ingest_docs"] = len(pages)
+            results["load_ingest_expected_points"] = expected
+            results["load_ingest_landed_points"] = landed
+            results["load_ingest_s"] = round(time.monotonic() - t0, 2)
+            results["load_zero_loss_ingest"] = float(landed == expected)
+            log(f"load ingest: {len(pages)} docs / {expected} points under "
+                f"chaos ({chaos_fired} faults fired) → {landed} landed in "
+                f"{results['load_ingest_s']}s")
+            if landed != expected:
+                raise RuntimeError(
+                    f"load_zero_loss_ingest violated: {landed}/{expected} "
+                    f"points (chaos seed {chaos_seed})")
+            if chaos_fired < 3:
+                raise RuntimeError(
+                    f"chaos was not ON: only {chaos_fired} faults fired")
+
+            # ---- scenario 2: search storm, one hot tenant ----------------
+            lat_ms: list = []
+            admitted = {t: 0 for t in tenants + [HOT_TENANT]}
+            throttled = {t: 0 for t in tenants + [HOT_TENANT]}
+
+            async def one_search(tenant, query):
+                t1 = time.monotonic()
+                status, body = await http(
+                    "POST", "/api/search/semantic",
+                    {"query_text": query, "top_k": 3},
+                    {"X-Symbiont-Tenant": tenant})
+                if status == 200 and body.get("error_message") is None:
+                    admitted[tenant] += 1
+                    lat_ms.append((time.monotonic() - t1) * 1000.0)
+                elif status == 429:
+                    throttled[tenant] += 1
+                else:
+                    raise RuntimeError(
+                        f"search failed ({tenant}): {status} {body}")
+
+            storm = []
+            for tenant in tenants:
+                storm += [one_search(tenant,
+                                     f"{rng.choice(VOCAB)} {rng.choice(VOCAB)}")
+                          for _ in range(SEARCHES_PER_TENANT)]
+            storm += [one_search(HOT_TENANT, f"{rng.choice(VOCAB)} flood")
+                      for _ in range(HOT_SEARCHES)]
+            t2 = time.monotonic()
+            await asyncio.gather(*storm)
+            storm_s = time.monotonic() - t2
+            lat_ms.sort()
+            n_429 = sum(throttled.values())
+            results["load_search_requests"] = len(storm)
+            results["load_search_ok"] = sum(admitted.values())
+            results["load_throttled_429"] = n_429
+            results["load_search_p50_ms"] = round(_pct(lat_ms, 0.50), 2)
+            results["load_search_p99_ms"] = round(_pct(lat_ms, 0.99), 2)
+            results["load_storm_s"] = round(storm_s, 2)
+            fairness = jain_index(admitted.values())
+            results["load_fairness_jain"] = round(fairness, 4)
+            log(f"load search storm: {len(storm)} req in {storm_s:.2f}s → "
+                f"{results['load_search_ok']} ok / {n_429}x 429; "
+                f"p50 {results['load_search_p50_ms']}ms "
+                f"p99 {results['load_search_p99_ms']}ms; admitted/tenant "
+                f"{ {t: admitted[t] for t in sorted(admitted)} } → "
+                f"Jain {fairness:.3f}")
+            if fairness < 0.8:
+                raise RuntimeError(
+                    f"tenant fairness index {fairness:.3f} < 0.8 with one "
+                    f"hot tenant (admitted: {admitted})")
+            if n_429 == 0:
+                raise RuntimeError(
+                    "hot tenant was never throttled: overload is queuing, "
+                    "not shedding")
+            # every normal tenant kept its full quota despite the flood
+            short = {t: admitted[t] for t in tenants
+                     if admitted[t] < SEARCHES_PER_TENANT}
+            if short:
+                raise RuntimeError(
+                    f"hot tenant starved normal tenants: {short}")
+
+            # edge-deadline refusal is part of the serving contract: an
+            # already-dead request is 429'd without a bus publish
+            status, body = await http(
+                "POST", "/api/search/semantic",
+                {"query_text": "late", "top_k": 1},
+                {"X-Symbiont-Tenant": "edge", "X-Symbiont-Deadline": "1"})
+            assert status == 429 and body.get("reason") == "deadline", body
+            results["load_deadline_429"] = 1.0
+
+            # ---- scenario 3: streaming generation (TTFT over SSE) --------
+            async def one_stream(i, timeout_s=90.0):
+                tid = f"load-gen-{i}"
+                t3 = time.monotonic()
+                status, _ = await http(
+                    "POST", "/api/generate-text",
+                    {"task_id": tid, "prompt": "symbiont tensor",
+                     "max_length": 12, "stream": True},
+                    {"X-Symbiont-Tenant": "gen"})
+                assert status == 200, status
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    for ts, e in sse_events:
+                        if (e.get("original_task_id") == tid
+                                and e.get("text_delta")):
+                            return (ts - t3) * 1000.0
+                    await asyncio.sleep(0.01)
+                raise RuntimeError(f"no streaming delta for {tid}")
+
+            await one_stream("warm")  # compiles sit outside the timed set
+            ttfts = sorted([await one_stream(i) for i in range(GEN_STREAMS)])
+            results["load_gen_streams"] = GEN_STREAMS
+            results["load_ttft_p50_ms"] = round(_pct(ttfts, 0.50), 1)
+            results["load_ttft_p99_ms"] = round(_pct(ttfts, 0.99), 1)
+            log(f"load generation: {GEN_STREAMS} SSE streams, TTFT p50 "
+                f"{results['load_ttft_p50_ms']}ms p99 "
+                f"{results['load_ttft_p99_ms']}ms")
+
+            # ---- scenario 4: RAG flow (search → generate) as ONE trace ---
+            rag_spans = 0
+            for i in range(RAG_FLOWS):
+                trace = {"X-Trace-Id": f"load-rag-{load_seed}-{i}",
+                         "X-Span-Id": f"load-rag-root-{i}",
+                         "X-Symbiont-Tenant": "rag"}
+                status, body = await http(
+                    "POST", "/api/search/semantic",
+                    {"query_text": str(rng.choice(VOCAB)), "top_k": 1},
+                    trace)
+                assert status == 200, body
+                hit = (body["results"][0]["payload"]["sentence_text"]
+                       if body["results"] else "fallback context")
+                status, _ = await http(
+                    "POST", "/api/generate-text",
+                    {"task_id": f"load-rag-gen-{i}",
+                     "prompt": hit[:32], "max_length": 8}, trace)
+                assert status == 200
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if any(e.get("original_task_id") == f"load-rag-gen-{i}"
+                           and e.get("generated_text") is not None
+                           for _, e in sse_events):
+                        break
+                    await asyncio.sleep(0.01)
+                status, tree = await http(
+                    "GET", f"/api/traces/load-rag-{load_seed}-{i}")
+                assert status == 200, tree
+                names = set()
+
+                def walk(node):
+                    names.add(node.get("name"))
+                    for c in node.get("children", []):
+                        walk(c)
+
+                for root in tree.get("roots", []):
+                    walk(root)
+                if {"api.search", "api.generate_text"} <= names:
+                    rag_spans += 1
+            results["load_rag_flows"] = RAG_FLOWS
+            results["load_rag_single_trace"] = float(rag_spans == RAG_FLOWS)
+            log(f"load RAG flow: {RAG_FLOWS} search→generate flows, "
+                f"{rag_spans} with both hops on ONE trace")
+            if rag_spans != RAG_FLOWS:
+                raise RuntimeError(
+                    f"RAG flow traces incomplete: {rag_spans}/{RAG_FLOWS} "
+                    "carried api.search + api.generate_text on one trace")
+
+            # ---- scenario 5: knowledge-graph limb, end-to-end ------------
+            graph_hits = 0
+            for _ in range(GRAPH_SEARCHES):
+                q = f"{rng.choice(VOCAB)} {rng.choice(VOCAB)}"
+                status, body = await http(
+                    "POST", "/api/search/graph",
+                    {"query_text": q, "top_k": 3},
+                    {"X-Symbiont-Tenant": "kg"})
+                assert status == 200, body
+                graph_hits += len(body["results"])
+            results["load_graph_searches"] = GRAPH_SEARCHES
+            results["load_graph_hits"] = graph_hits
+            log(f"load graph scenario: {GRAPH_SEARCHES} graph-augmented "
+                f"searches → {graph_hits} hits")
+            if graph_hits == 0:
+                raise RuntimeError(
+                    "graph-augmented search returned no hits: the "
+                    "knowledge-graph limb is dead again")
+
+            # ---- scenario 6: SLO shed ladder on real watchdog passes -----
+            ladder = stack.api.ladder
+            wd = stack.watchdog
+            # tighten the SLO so the REAL search histogram breaches it
+            wd.thresholds["api.search"] = 0.001
+            wd.evaluate()
+            assert ladder.level == 1, ladder.level
+            status, body = await http(
+                "POST", "/api/generate-text",
+                {"task_id": "shed-me", "prompt": "x", "max_length": 4},
+                {"X-Symbiont-Tenant": "gen", "X-Symbiont-Priority": "low"})
+            assert status == 429 and body["reason"] == "shed_gen_low", body
+            # fresh samples so the next pass has evidence, then rung 2
+            await one_search("t0", "another probe")
+            wd.evaluate()
+            assert ladder.level == 2, ladder.level
+            status, body = await http(
+                "POST", "/api/search/semantic",
+                {"query_text": "degraded probe", "top_k": 10},
+                {"X-Symbiont-Tenant": "t1"})
+            assert status == 200 and len(body["results"]) <= 3, \
+                ("degraded search did not clamp top-k", body)
+            results["load_shed_generations"] = metrics.get(
+                "admission.shed", labels={"reason": "shed_gen_low",
+                                          "tenant": "gen"})
+            results["load_degraded_searches"] = metrics.get(
+                "admission.degraded", labels={"what": "search",
+                                              "tenant": "t1"})
+            results["load_ladder_max_level"] = float(ladder.level)
+            # recovery: healthy passes step the ladder back down
+            wd.thresholds["api.search"] = 60000.0
+            for _ in range(2 * cfg.admission.shed_recovery_passes):
+                wd.evaluate()
+            results["load_ladder_recovered"] = float(ladder.level == 0)
+            log(f"load shed ladder: escalated to rung 2 on real breach "
+                f"passes (shed {results['load_shed_generations']:.0f} gen, "
+                f"degraded {results['load_degraded_searches']:.0f} "
+                f"searches), recovered={ladder.level == 0}")
+            if ladder.level != 0:
+                raise RuntimeError(
+                    f"shed ladder did not recover: level {ladder.level}")
+
+            # ---- no unbounded queues: everything drained, sheds counted --
+            queued = stack.api.admission.fair_queue.queued()
+            results["load_final_queued"] = float(queued)
+            if queued != 0:
+                raise RuntimeError(
+                    f"fair queue not drained at end of run: {queued}")
+        finally:
+            sse_task.cancel()
+            client_pool.shutdown(wait=False)
+            await stack.stop()
+            await bus.close()

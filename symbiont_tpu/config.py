@@ -423,6 +423,90 @@ class ResilienceConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """Overload-protection plane (resilience/admission.py,
+    docs/RESILIENCE.md overload rows): per-tenant token-bucket quotas per
+    request class, the weighted-fair search queue, edge-minted deadlines,
+    capacity-aware generation admission, and the SLO shed ladder. Tenant
+    identity comes from the `X-Symbiont-Tenant` HTTP header (default
+    tenant otherwise); quotas are PER TENANT, so one hot tenant is clamped
+    to its own budget instead of starving everyone."""
+
+    enabled: bool = True
+    # per-tenant token buckets: sustained requests/second + burst headroom,
+    # one bucket per (tenant, class). Exhaustion answers 429 with
+    # Retry-After at the HTTP edge — the queue never grows unboundedly.
+    ingest_rate: float = 200.0
+    ingest_burst: float = 400.0
+    search_rate: float = 100.0
+    search_burst: float = 200.0
+    generate_rate: float = 20.0
+    generate_burst: float = 40.0
+    # weighted-fair search scheduling: shared concurrency budget, bounded
+    # per-tenant wait queues (full queue → 429), stride weights like
+    # "gold=4,free=1" (unlisted tenants weigh 1)
+    search_concurrency: int = 32
+    max_queue_per_tenant: int = 64
+    fair_weights: str = ""
+    # distinct tenant identities the edge will track: the tenant header is
+    # client-supplied, so past this bound every NEW identity shares one
+    # overflow bucket/queue (quota-bypass-by-fresh-tenant and unbounded
+    # per-tenant state/metric cardinality both stop here)
+    max_tenants: int = 1024
+    # deadlines minted at the API edge (X-Symbiont-Deadline, absolute epoch
+    # ms), threaded through every bus hop by telemetry.child_headers;
+    # expired work is dropped before the handler runs (never retried,
+    # never DLQ'd). 0 disables minting for that class; a client-supplied
+    # deadline always passes through (and can only TIGHTEN a minted one).
+    # INGEST defaults to NO minted deadline: the edge already answered 200
+    # "submitted successfully", and an expiring deadline would silently
+    # drop accepted data during a redelivery storm — violating the plane's
+    # own ingest-is-never-shed / zero-loss invariant. Opt in only if your
+    # clients treat submit-url as best-effort.
+    deadline_ingest_ms: float = 0.0
+    deadline_search_ms: float = 10000.0
+    deadline_generate_ms: float = 60000.0
+    # capacity-aware generation admission: refuse new generation streams
+    # (429) once the LM's allocated KV rows across live decode sessions
+    # reach this bound (LmEngine.can_admit); 0 = unbounded (the pre-plane
+    # behavior)
+    max_kv_rows: int = 0
+    # shed-ladder hysteresis (resilience/admission.DegradationLadder):
+    # dwell time between level changes and consecutive breach-free
+    # watchdog passes required to step down — an oscillating breach parks
+    # the ladder instead of flapping it
+    shed_recovery_passes: int = 3
+    shed_hold_s: float = 5.0
+    # degraded-search rung: top-k clamp (rerank is skipped outright)
+    degraded_top_k: int = 3
+
+    def __post_init__(self) -> None:
+        for name in ("ingest", "search", "generate"):
+            if (getattr(self, f"{name}_rate") <= 0
+                    or getattr(self, f"{name}_burst") <= 0):
+                raise ValueError(
+                    f"admission.{name}_rate/_burst must be positive")
+        if self.search_concurrency < 1 or self.max_queue_per_tenant < 1:
+            raise ValueError(
+                "admission.search_concurrency and max_queue_per_tenant "
+                "must be >= 1")
+        if self.max_tenants < 1:
+            raise ValueError("admission.max_tenants must be >= 1")
+        if self.shed_recovery_passes < 1:
+            raise ValueError("admission.shed_recovery_passes must be >= 1")
+        if self.shed_hold_s < 0:
+            raise ValueError("admission.shed_hold_s must be >= 0")
+        if self.degraded_top_k < 1:
+            raise ValueError("admission.degraded_top_k must be >= 1")
+        if self.max_kv_rows < 0:
+            raise ValueError("admission.max_kv_rows must be >= 0")
+        # malformed weights fail at boot, not silently weight 1
+        from symbiont_tpu.resilience.admission import parse_weights
+
+        parse_weights(self.fair_weights)
+
+
+@dataclass
 class RunnerConfig:
     """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
 
@@ -451,6 +535,7 @@ class SymbiontConfig:
     runner: RunnerConfig = field(default_factory=RunnerConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
 
     def __post_init__(self) -> None:
         # cross-section invariant: every top_k the gateway routes to the

@@ -326,6 +326,14 @@ class _PendingGen:
     temperature: float
     top_k: int
     future: asyncio.Future
+    # cancellation signal (anything with .is_set(); e.g. asyncio.Event):
+    # checked at every chunk boundary — a vanished SSE reader's request
+    # frees its decode row mid-session instead of pinning it to budget
+    # exhaustion. A cancelled request's future resolves to None.
+    cancel: Optional[object] = None
+
+    def cancelled(self) -> bool:
+        return self.cancel is not None and self.cancel.is_set()
 
 
 class GenBatcher(_BatcherBase):
@@ -355,13 +363,18 @@ class GenBatcher(_BatcherBase):
 
     async def generate(self, prompt: str, max_new_tokens: int,
                        temperature: Optional[float] = None,
-                       top_k: Optional[int] = None) -> str:
+                       top_k: Optional[int] = None,
+                       cancel: Optional[object] = None) -> Optional[str]:
+        """Returns the generated text, or None when `cancel` (an object
+        with .is_set(), e.g. asyncio.Event) was set mid-decode and the
+        request's row was freed at a chunk boundary."""
         cfg = self.lm.config
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._submit(_PendingGen(prompt, int(max_new_tokens),
-                                 float(temperature), int(top_k), fut))
+                                 float(temperature), int(top_k), fut,
+                                 cancel=cancel))
         return await fut
 
     def _size(self, item: _PendingGen) -> int:
@@ -379,6 +392,15 @@ class GenBatcher(_BatcherBase):
         for p in batch:
             groups.setdefault(self._bucket(p.max_new), []).append(p)
         for group in groups.values():
+            # requests cancelled while they sat in the flush window never
+            # enter a session at all — their futures resolve to None here
+            still = [p for p in group if not p.cancelled()]
+            for p in group:
+                if p.cancelled() and not p.future.done():
+                    p.future.set_result(None)
+            if not still:
+                continue
+            group = still
             # every request that ever joins this session; on session failure
             # each unresolved future gets the exception (a silently dropped
             # future would hang its caller forever)
@@ -446,7 +468,33 @@ class GenBatcher(_BatcherBase):
                                     by_tag[tag] = p
                                     participants.append(p)
                                     self.stats["admitted_midflight"] += 1
-                    if sess.done() and not by_tag:
+                    # 1b) cancellation sweep at the chunk boundary: a
+                    #     vanished client's row frees NOW (admissible to
+                    #     newcomers, kv gauges drop it) instead of decoding
+                    #     to budget exhaustion (BatchSession.cancel_tag)
+                    swept = [(tag, p) for tag, p in by_tag.items()
+                             if p.cancelled()]
+                    if swept:
+                        # cancel_tag takes the ENGINE lock, which an
+                        # executor thread can hold through a decode chunk
+                        # or a first-call XLA compile — never block the
+                        # event loop on it
+                        await loop.run_in_executor(
+                            None,
+                            lambda: [sess.cancel_tag(t) for t, _ in swept])
+                    for tag, p in swept:
+                        by_tag.pop(tag)
+                        if not p.future.done():
+                            p.future.set_result(None)
+                        self.stats["cancelled"] = (
+                            self.stats.get("cancelled", 0) + 1)
+                    if sess.done() and not by_tag and prep_fut is None:
+                        # prep_fut pending (e.g. the sweep just cancelled
+                        # every row) must NOT be abandoned here: the next
+                        # iteration's harvest force-awaits it — splicing
+                        # its rows in if budget remains, failing/deferring
+                        # them otherwise — so no newcomer future ever
+                        # dangles off a normal session exit
                         break
                     # 2) steal the queue and start preparing newcomers —
                     #    overlapped with the step below, never awaited here

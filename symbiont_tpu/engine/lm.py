@@ -592,6 +592,24 @@ class LmEngine:
         session.step(); admit newcomers with session.admit()."""
         return BatchSession(self, prompts, max_new_tokens, temperature, top_k)
 
+    def kv_rows_allocated(self) -> int:
+        """Batch rows allocated across live decode sessions — the number
+        the `lm.kv_rows_allocated` gauge exports, readable synchronously
+        for admission decisions."""
+        with self._sessions_lock:
+            return sum(s.bb for s in self._sessions if not s.done())
+
+    def can_admit(self, n_rows: int = 1, max_kv_rows: int = 0) -> bool:
+        """Capacity-aware generation admission (resilience/admission.py):
+        may `n_rows` more decode rows start without pushing allocated KV
+        rows past `max_kv_rows`? The API edge consults this BEFORE
+        accepting a generation stream, so overload answers 429 instead of
+        growing KV caches until the device OOMs. cap <= 0 = unbounded
+        (the pre-plane behavior)."""
+        if max_kv_rows <= 0:
+            return True
+        return self.kv_rows_allocated() + max(1, int(n_rows)) <= max_kv_rows
+
     def update_params(self, params) -> None:
         """Swap in new model parameters (online fine-tune sync,
         train/online.py). Serialized on the engine lock so no decode is
@@ -817,6 +835,31 @@ class BatchSession:
             prompts, max_new_tokens, temperature=temperature, top_k=top_k))
         assert None not in tags, "admit() beyond capacity()"
         return tags
+
+    def cancel_tag(self, tag: int) -> bool:
+        """Abort one in-flight request (SSE client vanished): its batch row
+        frees IMMEDIATELY — the slot becomes admissible to newcomers at the
+        next chunk boundary, the `lm.kv_rows_active` gauge stops counting
+        it, and a session whose every row was cancelled reads done() (so
+        `lm.kv_rows_allocated` returns to baseline too). The row's decoded
+        tokens are discarded, not published. Returns False when the tag is
+        not live (already finished — cancellation raced completion)."""
+        for i, row in enumerate(self.rows):
+            if row is not None and row.tag == tag:
+                self.rows[i] = None
+                with self.lm._lock:
+                    self.lm.stats["cancelled"] = (
+                        self.lm.stats.get("cancelled", 0) + 1)
+                    # the row's share of device time is still real work done
+                    self.lm.stats["tokens_generated"] += len(row.tokens)
+                    # flush accumulated decode seconds like _finish does: a
+                    # fully-cancelled session never reaches _finish, and
+                    # tokens credited without their time would inflate the
+                    # derived tok/s gauge
+                    self.lm.stats["decode_s"] += self.decode_s
+                    self.decode_s = 0.0
+                return True
+        return False
 
     # --------------------------------------------------------------- decode
 

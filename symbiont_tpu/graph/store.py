@@ -147,16 +147,24 @@ class GraphStore:
                  for np_, ep in rows]
         return [text for _, text in sorted(pairs)]
 
-    def documents_containing_token(self, token: str) -> List[str]:
-        """original_ids of documents containing a token (case-insensitive)."""
+    def documents_containing_token(self, token: str,
+                                   limit: int = 0) -> List[str]:
+        """original_ids of documents containing a token (case-insensitive),
+        sorted. limit > 0 bounds the rows INSIDE the query — a stopword
+        matching the whole corpus must not materialize and sort every
+        document id just to be sliced by the caller."""
+        q = ("SELECT DISTINCT d.merge_key FROM nodes t "
+             "JOIN edges e ON e.dst = t.node_id AND e.type='CONTAINS_TOKEN' "
+             "JOIN nodes d ON d.node_id = e.src "
+             "WHERE t.label='Token' AND t.merge_key=? "
+             "ORDER BY d.merge_key")
+        args: tuple = (token.lower(),)
+        if limit > 0:
+            q += " LIMIT ?"
+            args += (limit,)
         with self._lock:
-            rows = self._db.execute(
-                "SELECT d.merge_key FROM nodes t "
-                "JOIN edges e ON e.dst = t.node_id AND e.type='CONTAINS_TOKEN' "
-                "JOIN nodes d ON d.node_id = e.src "
-                "WHERE t.label='Token' AND t.merge_key=?",
-                (token.lower(),)).fetchall()
-        return sorted({r[0] for r in rows})
+            rows = self._db.execute(q, args).fetchall()
+        return [r[0] for r in rows]
 
     def counts(self) -> Dict[str, int]:
         with self._lock:

@@ -62,6 +62,17 @@ class SloWatchdog:
         # observation count at the last evaluation, per span: an idle span
         # must not re-alert every interval off the same old samples
         self._seen_counts: Dict[str, int] = {}
+        # pass listeners: fn(breaches) called at the END of every
+        # evaluation — with the empty list too, which is what lets the
+        # admission shed ladder (resilience/admission.DegradationLadder)
+        # count breach-free passes toward stepping back down
+        self.listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """Subscribe fn(breaches: list[dict]) to every evaluation pass.
+        The watchdog was observe-only before the overload-protection
+        plane; listeners are how breaches now ACT (shed ladder)."""
+        self.listeners.append(fn)
 
     def evaluate(self) -> List[dict]:
         """One evaluation pass; returns the breach events it emitted.
@@ -101,6 +112,13 @@ class SloWatchdog:
             self.events.append(event)
             breaches.append(event)
             log.warning(json.dumps(event, ensure_ascii=False))
+        for fn in list(self.listeners):
+            try:
+                fn(breaches)
+            except Exception:
+                # listeners act on breaches (shedding); a broken one must
+                # not take the watchdog down with it
+                log.exception("SLO pass listener failed")
         return breaches
 
     async def _run(self) -> None:

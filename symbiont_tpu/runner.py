@@ -90,6 +90,44 @@ class SymbiontStack:
         self.services = []
         self.bus = self._bus_override or await connect(cfg.bus.url)
 
+        # API gateway starts FIRST (when hosted): liveness (/healthz) and
+        # readiness (/readyz → 503) must answer DURING engine placement /
+        # mesh build, so a load balancer keeps traffic away from a cold
+        # process instead of timing out against a socket that doesn't exist
+        # yet. mark_ready() flips only at the very end of start(), once
+        # params are placed and the mesh (when parallel.enabled) is built.
+        if on("api"):
+            admission_ctl = ladder = None
+            if cfg.admission.enabled:
+                from symbiont_tpu.resilience.admission import (
+                    AdmissionController,
+                    DegradationLadder,
+                )
+
+                admission_ctl = AdmissionController(cfg.admission)
+                # SLO-aware shedding: the watchdog's breach passes drive
+                # the degradation ladder the gateway consults per request
+                ladder = DegradationLadder(
+                    recovery_passes=cfg.admission.shed_recovery_passes,
+                    hold_s=cfg.admission.shed_hold_s,
+                    degraded_top_k=cfg.admission.degraded_top_k)
+                if self.watchdog is not None:
+                    self.watchdog.add_listener(ladder.on_slo_pass)
+            self.api = ApiService(
+                self.bus, cfg.api, cfg.bus,
+                admission=admission_ctl, ladder=ladder,
+                # capacity-aware generation admission: consult the live
+                # LM's KV-row occupancy before accepting a stream (late-
+                # bound — the LM is constructed below)
+                gen_capacity=(
+                    (lambda: self.lm is None
+                     or self.lm.can_admit(1, cfg.admission.max_kv_rows))
+                    if cfg.admission.enabled else None),
+                admission_config=(cfg.admission if cfg.admission.enabled
+                                  else None),
+                defer_ready=True)
+            await self.api.start()
+
         # Multi-chip serving plane (ROADMAP item 1): the mesh is a first-
         # class, config-driven property of the live stack. When this process
         # is about to construct a real device engine (embed or LM) and no
@@ -279,9 +317,9 @@ class SymbiontStack:
             # plane); services may further tune their own fields after
             s.apply_resilience(cfg.resilience)
             await s.start()
-        if on("api"):
-            self.api = ApiService(self.bus, cfg.api, cfg.bus)
-            await self.api.start()
+        if self.api is not None:
+            # everything behind the gateway is placed: flip /readyz to 200
+            self.api.mark_ready()
             log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
         else:
             log.info("symbiont stack up (no api): %s", sorted(want))

@@ -28,6 +28,7 @@ the native C++ gateway under native/.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 from typing import Dict, List, Optional, Tuple
@@ -48,8 +49,22 @@ from symbiont_tpu.schema import (
     to_json_bytes,
 )
 from symbiont_tpu.schema import frames
+from symbiont_tpu.resilience import admission as adm
+from symbiont_tpu.resilience.admission import (
+    AdmissionController,
+    AdmissionReject,
+    DegradationLadder,
+)
 from symbiont_tpu.utils.ids import generate_uuid
-from symbiont_tpu.utils.telemetry import metrics, new_trace_headers, span
+from symbiont_tpu.utils.telemetry import (
+    DEADLINE_HEADER,
+    SPAN_HEADER,
+    TENANT_HEADER,
+    TRACE_HEADER,
+    metrics,
+    new_trace_headers,
+    span,
+)
 
 log = logging.getLogger(__name__)
 
@@ -75,6 +90,32 @@ class _HttpError(Exception):
         self.origin = origin
 
 
+def _deadline_capped(default_s: float, headers: Dict[str, str]) -> float:
+    """A bus-request timeout never longer than the request's remaining
+    deadline budget: downstream services drop expired deliveries WITHOUT
+    replying, so waiting out the full transport timeout would pin a fair-
+    queue slot for dead work — up to 2x the deadline — exactly when
+    shedding should be freeing capacity. Floor keeps a just-expiring
+    request failing fast instead of with timeout=0 weirdness."""
+    rem = adm.remaining_ms(headers)
+    if rem is None:
+        return default_s
+    return max(0.05, min(default_s, rem / 1000.0))
+
+
+@contextlib.asynccontextmanager
+async def _fair_slot(admission, tenant: str):
+    """Hold one weighted-fair search-concurrency slot for the block (no-op
+    without an admission controller); released on every exit path."""
+    if admission is not None:
+        await admission.fair_queue.acquire(tenant)
+    try:
+        yield
+    finally:
+        if admission is not None:
+            admission.fair_queue.release(tenant)
+
+
 class _SseHub:
     """Bounded broadcast: capacity-32 queues, drop-on-lag with a warning
     (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209).
@@ -96,6 +137,15 @@ class _SseHub:
 
     def unregister(self, q: asyncio.Queue) -> None:
         self._clients = [(c, t) for (c, t) in self._clients if c is not q]
+
+    def has_follower(self, task_id: str) -> bool:
+        """Any remaining client that would receive this task's events — a
+        client filtered on it, or an unfiltered (receive-everything)
+        reference-style client. Consulted before cancelling a generation
+        on disconnect: one of several readers leaving must not kill the
+        stream for the rest."""
+        return any(want is None or want == task_id
+                   for _, want in self._clients)
 
     def broadcast(self, payload: str) -> None:
         event_tid = _UNPARSED
@@ -136,11 +186,36 @@ class ApiService:
     name = "api"
 
     def __init__(self, bus, config: Optional[ApiConfig] = None,
-                 bus_config: Optional[BusConfig] = None):
+                 bus_config: Optional[BusConfig] = None,
+                 admission: Optional[AdmissionController] = None,
+                 ladder: Optional[DegradationLadder] = None,
+                 gen_capacity=None, admission_config=None,
+                 defer_ready: bool = False):
         self.bus = bus
         self.config = config or ApiConfig()
         self.bus_config = bus_config or BusConfig()
         self.hub = _SseHub(self.config.sse_channel_capacity)
+        # overload-protection plane (resilience/admission.py, wired by the
+        # runner): per-tenant quotas + weighted-fair search scheduling
+        # (None = no admission control, the pre-plane behavior standalone
+        # test gateways keep), the SLO shed ladder, and the LM-capacity
+        # probe consulted before accepting a generation stream
+        self.admission = admission
+        self.ladder = ladder
+        self.gen_capacity = gen_capacity  # () -> bool; None = unbounded
+        self.admission_config = admission_config  # deadline budgets
+        # readiness (GET /readyz): False until the hosting process says its
+        # engines are placed — load balancers must not route to a cold
+        # process. Standalone gateways flip ready at start() (there is
+        # nothing to warm); the runner defers and calls mark_ready() once
+        # the whole stack is up.
+        self._ready = False
+        self._defer_ready = defer_ready
+        # generation task ids THIS gateway accepted (bounded, oldest out):
+        # an SSE disconnect only cancels tasks known to exist — a reader
+        # that pre-connected with a client-minted id and dropped before
+        # ever POSTing must not tombstone the id downstream
+        self._gen_submitted: dict = {}
         # negative cache for the fused-search subject: after a timeout
         # (subject unserved — engine and store not co-located), skip the
         # fused attempt for a window instead of stalling every request
@@ -148,6 +223,9 @@ class ApiService:
         self._server: Optional[asyncio.AbstractServer] = None
         self._bridge_tasks: List[asyncio.Task] = []
         self._bridge_subs: List = []
+
+    def mark_ready(self) -> None:
+        self._ready = True
 
     # ---------------------------------------------------------------- server
 
@@ -169,6 +247,8 @@ class ApiService:
             for s in self._bridge_subs]
         self._server = await asyncio.start_server(
             self._handle_conn, self.config.host, self.config.port)
+        if not self._defer_ready:
+            self._ready = True
         log.info("api listening on %s:%s", self.config.host, self.config.port)
 
     @property
@@ -252,11 +332,16 @@ class ApiService:
                         if not keep_alive:
                             break
                         continue
-                status, payload = await self._route(method, path, query,
-                                                    headers, body)
+                routed = await self._route(method, path, query,
+                                           headers, body)
+                status, payload = routed[0], routed[1]
+                # optional third element: extra response headers (e.g.
+                # Retry-After on a 429 from the admission plane)
+                extra = routed[2] if len(routed) > 2 else None
                 await self._write_response(writer, status, payload,
                                            origin=headers.get("origin"),
-                                           keep_alive=keep_alive)
+                                           keep_alive=keep_alive,
+                                           extra_headers=extra)
                 if not keep_alive:
                     break
         except (ConnectionResetError, asyncio.IncompleteReadError, BrokenPipeError):
@@ -318,15 +403,21 @@ class ApiService:
     async def _write_response(self, writer, status: int, payload: str,
                               origin: Optional[str] = None,
                               content_type: str = "application/json",
-                              keep_alive: bool = True) -> None:
+                              keep_alive: bool = True,
+                              extra_headers: Optional[Dict[str, str]] = None
+                              ) -> None:
         reasons = {200: "OK", 400: "Bad Request", 404: "Not Found",
                    405: "Method Not Allowed", 413: "Payload Too Large",
+                   429: "Too Many Requests",
                    500: "Internal Server Error", 503: "Service Unavailable"}
         body = payload.encode("utf-8")
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
         head = (f"HTTP/1.1 {status} {reasons.get(status, 'OK')}\r\n"
                 f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"{self._cors(origin)}"
+                f"{extra}"
                 f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n")
         writer.write(head.encode("latin-1") + body)
         await writer.drain()
@@ -338,16 +429,31 @@ class ApiService:
                      body: bytes) -> Tuple[int, str]:
         if method == "OPTIONS":
             return 200, ""
+        if (not self._ready and method == "POST"
+                and path in ("/api/submit-url", "/api/generate-text",
+                             "/api/search/semantic", "/api/search/graph")):
+            # the port opens BEFORE the stack's services subscribe (so
+            # /healthz and /readyz answer during engine warm-up): accepting
+            # data-path work now would 200 into a bus with no consumers —
+            # silent loss. Refuse honestly; a well-behaved LB watches
+            # /readyz and never sends this.
+            metrics.inc("api.not_ready_rejects")
+            return 503, json.dumps(
+                {"message": "stack is warming up (see /readyz)",
+                 "task_id": None}), {"Retry-After": "1"}
         try:
             if path == "/api/submit-url" and method == "POST":
                 metrics.inc("api.POST./api/submit-url")
-                return await self._submit_url(body)
+                return await self._submit_url(body, headers)
             if path == "/api/generate-text" and method == "POST":
                 metrics.inc("api.POST./api/generate-text")
-                return await self._generate_text(body)
+                return await self._generate_text(body, headers)
             if path == "/api/search/semantic" and method == "POST":
                 metrics.inc("api.POST./api/search/semantic")
-                return await self._semantic_search(body)
+                return await self._semantic_search(body, headers)
+            if path == "/api/search/graph" and method == "POST":
+                metrics.inc("api.POST./api/search/graph")
+                return await self._graph_search(body, headers)
             if path == "/api/metrics" and method == "GET":
                 return 200, json.dumps(metrics.snapshot())
             if path == "/api/traces/recent" and method == "GET":
@@ -362,13 +468,33 @@ class ApiService:
                 metrics.inc("api.POST./api/dlq/replay")
                 return await self._dlq_replay(body)
             if path == "/healthz" and method == "GET":
+                # liveness ONLY: the process is up and serving HTTP. Routing
+                # decisions belong to /readyz — a restart loop detector must
+                # not flap with engine warm-up.
                 return 200, json.dumps({"status": "ok"})
+            if path == "/readyz" and method == "GET":
+                # readiness: 503 until the hosting process says its engine
+                # params are placed and the mesh (when parallel.enabled) is
+                # built — load balancers must not route to a cold process
+                if self._ready:
+                    return 200, json.dumps({"status": "ready"})
+                return 503, json.dumps(
+                    {"status": "starting",
+                     "message": "engine placement / mesh build in progress"})
             if path == "/api/health/engine" and method == "GET":
                 return await self._engine_health()
             # one bucket for everything unmatched: arbitrary scanner paths
             # must not create unbounded counter cardinality
             metrics.inc("api.unmatched")
             return 404, json.dumps({"message": "not found", "task_id": None})
+        except AdmissionReject as e:
+            # overload answer: bounded refusal with a retry hint, never an
+            # unbounded queue (resilience/admission.py; the Retry-After
+            # header is what well-behaved clients back off on)
+            return (429,
+                    json.dumps({"message": str(e), "reason": e.reason,
+                                "task_id": None}),
+                    adm.retry_after_header(e.retry_after_s))
         except json.JSONDecodeError as e:
             return 400, json.dumps({"message": f"invalid JSON: {e}", "task_id": None})
         except ValueError as e:
@@ -417,24 +543,115 @@ class ApiService:
                                                              spans))
         return 404, json.dumps({"message": "not found", "task_id": None})
 
-    async def _submit_url(self, body: bytes) -> Tuple[int, str]:
+    # ------------------------------------------------------- admission edge
+
+    @staticmethod
+    def _trace_ctx(headers: Optional[Dict[str, str]]):
+        """Inbound HTTP trace context → span parent. A client carrying
+        X-Trace-Id/X-Span-Id across calls (the RAG flow in bench/load.py:
+        search → rerank → generate) gets ONE flight-recorder trace instead
+        of three; absent headers keep the old mint-per-request behavior."""
+        if headers and "x-trace-id" in headers:
+            ctx = {TRACE_HEADER: headers["x-trace-id"]}
+            if "x-span-id" in headers:
+                ctx[SPAN_HEADER] = headers["x-span-id"]
+            return ctx
+        return None
+
+    def _degraded_top_k(self, tenant: str, top_k: int) -> Tuple[int, bool]:
+        """Ladder rung-2 clamp shared by BOTH search surfaces (semantic +
+        graph): returns (possibly-clamped top_k, degraded?) and counts the
+        degraded serve — degrade, don't fail, while the SLO recovers."""
+        if self.ladder is None or not self.ladder.search_degraded():
+            return top_k, False
+        metrics.inc("admission.degraded",
+                    labels={"what": "search", "tenant": tenant})
+        return self.ladder.degrade_top_k(top_k), True
+
+    def _search_slot(self, tenant: str):
+        """One weighted-fair concurrency slot over the shared search
+        budget (both search surfaces ride it — a storm on either cannot
+        sidestep the bounded fair queue). Async context manager; a no-op
+        without an admission controller."""
+        return _fair_slot(self.admission, tenant)
+
+    def _edge_admit(self, klass: str, headers: Dict[str, str],
+                    priority: str = "normal") -> Tuple[str, Dict[str, str]]:
+        """The one admission gate every ingress class passes: already-
+        expired client deadline → reject (no bus publish); shed ladder
+        (generation only; ingest is NEVER shed); per-tenant quota; LM
+        capacity (generation only). Returns (tenant, headers-to-thread):
+        tenant identity plus the deadline minted for this class's budget.
+        Raises AdmissionReject — answered 429 + Retry-After by _route."""
+        tenant = adm.tenant_of(headers)
+        if self.admission is not None:
+            # client-supplied header → bounded identity universe (past the
+            # cap, new tenants share the overflow bucket/queue)
+            tenant = self.admission.resolve_tenant(tenant)
+        if adm.expired(headers):
+            # the caller's own deadline has passed: doing the work (or even
+            # publishing it) serves nobody
+            metrics.inc("admission.expired",
+                        labels={"service": self.name, "subject": "edge"})
+            raise AdmissionReject(
+                "deadline", retry_after_s=1.0,
+                message="request deadline already expired at the edge")
+        if klass == "generate":
+            if self.ladder is not None:
+                reason = self.ladder.shed_generation(priority)
+                if reason is not None:
+                    metrics.inc("admission.shed",
+                                labels={"reason": reason, "tenant": tenant})
+                    raise AdmissionReject(
+                        reason, retry_after_s=self._shed_retry_after_s(),
+                        message=f"generation shed under SLO pressure "
+                                f"({reason}, priority {priority})")
+            if self.gen_capacity is not None and not self.gen_capacity():
+                metrics.inc("admission.shed",
+                            labels={"reason": "kv_capacity",
+                                    "tenant": tenant})
+                raise AdmissionReject(
+                    "kv_capacity", retry_after_s=2.0,
+                    message="generation capacity exhausted (KV rows at "
+                            "the admission bound)")
+        if self.admission is not None:
+            self.admission.admit(klass, tenant)  # raises on quota
+        extra = {TENANT_HEADER: tenant}
+        budget = 0.0
+        if self.admission_config is not None:
+            budget = getattr(self.admission_config, f"deadline_{klass}_ms")
+        deadline = adm.mint_deadline(budget, headers)
+        if deadline is not None:
+            extra[DEADLINE_HEADER] = deadline
+        return tenant, extra
+
+    def _shed_retry_after_s(self) -> float:
+        """Sheds hint a longer back-off than quota refills: the ladder only
+        steps down after recovery passes × the watchdog interval."""
+        return 5.0
+
+    async def _submit_url(self, body: bytes,
+                          headers: Dict[str, str]) -> Tuple[int, str]:
         data = json.loads(body)
         url = (data.get("url") or "").strip()
         if not url:
             # reference: main.rs:48-53
             return 400, json.dumps({"message": "URL cannot be empty", "task_id": None})
+        _tenant, extra = self._edge_admit("ingest", headers)
         # root span of the ingest pipeline trace: every downstream handler
         # span (perception → preprocessing → vector_memory/knowledge_graph)
-        # links back to this one in the flight recorder
-        with span("api.submit_url", None, url=url) as sp:
+        # links back to this one in the flight recorder; the deadline +
+        # tenant headers thread through every hop via child_headers
+        with span("api.submit_url", self._trace_ctx(headers), url=url) as sp:
             await self.bus.publish(subjects.TASKS_PERCEIVE_URL,
                                    to_json_bytes_url(url),
-                                   headers=sp.headers)
+                                   headers={**sp.headers, **extra})
         return 200, json.dumps({
             "message": f"Task to scrape URL '{url}' submitted successfully.",
             "task_id": None})
 
-    async def _generate_text(self, body: bytes) -> Tuple[int, str]:
+    async def _generate_text(self, body: bytes,
+                             headers: Dict[str, str]) -> Tuple[int, str]:
         task = from_dict(GenerateTextTask, json.loads(body))
         if not task.task_id.strip():
             # reference: main.rs:125-131
@@ -455,28 +672,106 @@ class ApiService:
             return 400, json.dumps({
                 "message": "top_k must be at most 100000",
                 "task_id": task.task_id})
-        with span("api.generate_text", None, task_id=task.task_id) as sp:
+        priority = (headers.get("x-symbiont-priority")
+                    or "normal").strip().lower()
+        _tenant, extra = self._edge_admit("generate", headers,
+                                          priority=priority)
+        with span("api.generate_text", self._trace_ctx(headers),
+                  task_id=task.task_id) as sp:
             await self.bus.publish(subjects.TASKS_GENERATION_TEXT,
-                                   to_json_bytes(task), headers=sp.headers)
+                                   to_json_bytes(task),
+                                   headers={**sp.headers, **extra})
+        self._gen_submitted[task.task_id] = True
+        while len(self._gen_submitted) > 1024:
+            self._gen_submitted.pop(next(iter(self._gen_submitted)))
         return 200, json.dumps({
             "message": f"Text generation task (id: {task.task_id}) submitted successfully.",
             "task_id": task.task_id})
 
-    async def _semantic_search(self, body: bytes) -> Tuple[int, str]:
+    async def _graph_search(self, body: bytes,
+                            headers: Dict[str, str]) -> Tuple[int, str]:
+        """Graph-augmented search (the un-orphaned knowledge-graph limb as
+        a first-class query surface): one request-reply hop to
+        tasks.search.graph.request, same admission class and status
+        mapping as semantic search."""
+        data = json.loads(body)
+        query_text = (data.get("query_text") or "").strip()
+        if not query_text:
+            return 400, json.dumps({"message": "query_text cannot be empty",
+                                    "task_id": None})
+        try:
+            top_k = int(data.get("top_k", 5))
+        except (TypeError, ValueError):
+            # same 400-at-the-edge contract as semantic search — a
+            # malformed field is the client's error, not a 500
+            return 400, json.dumps({"message": "top_k must be an integer",
+                                    "task_id": None})
+        tenant, extra = self._edge_admit("search", headers)
+        top_k, _ = self._degraded_top_k(tenant, top_k)
+        async with self._search_slot(tenant):
+            with span("api.graph_search", self._trace_ctx(headers),
+                      top_k=top_k) as sp:
+                try:
+                    reply = await self.bus.request(
+                        subjects.TASKS_SEARCH_GRAPH_REQUEST,
+                        json.dumps({"query_text": query_text,
+                                    "top_k": top_k}).encode(),
+                        timeout=_deadline_capped(
+                            self.bus_config.request_timeout_search_s,
+                            extra),
+                        headers={**sp.headers, **extra})
+                except TimeoutError as e:
+                    return 503, json.dumps({
+                        "results": [],
+                        "error_message":
+                            f"Failed to get graph search results "
+                            f"from knowledge graph service: {e}"})
+        try:
+            out = json.loads(reply.data)
+            if not isinstance(out, dict):
+                raise ValueError("reply is not a JSON object")
+        except ValueError as e:
+            return 500, json.dumps({
+                "results": [],
+                "error_message": f"bad graph search reply: {e}"})
+        return (500 if out.get("error_message") else 200), json.dumps(out)
+
+    async def _semantic_search(self, body: bytes,
+                               headers: Dict[str, str]) -> Tuple[int, str]:
         """2-hop orchestration with the reference's status mapping
-        (main.rs:272-512): bus timeout → 503; service-reported error → 500."""
+        (main.rs:272-512): bus timeout → 503; service-reported error → 500.
+
+        Overload plane: per-tenant quota + a weighted-fair concurrency slot
+        around the whole orchestration (a hot tenant's backlog waits in ITS
+        bounded queue, everyone else's requests keep flowing), and the shed
+        ladder's degraded rung clamps top-k / skips rerank instead of
+        failing the request outright."""
         req = from_dict(SemanticSearchApiRequest, json.loads(body))
         request_id = generate_uuid()
+        tenant, extra = self._edge_admit("search", headers)
+        req.top_k, degraded = self._degraded_top_k(tenant, req.top_k)
+        if degraded and req.rerank:
+            # degraded also skips the cross-encoder pass: answering
+            # cheaper beats failing while the SLO recovers
+            req.rerank = False
+        async with self._search_slot(tenant):
+            return await self._semantic_search_inner(req, request_id,
+                                                     headers, extra)
 
+    async def _semantic_search_inner(self, req, request_id: str,
+                                     headers: Dict[str, str],
+                                     extra: Dict[str, str]) -> Tuple[int, str]:
         def resp(results, err=None) -> str:
             return to_json(SemanticSearchApiResponse(
                 search_request_id=request_id, results=results,
                 error_message=err))
 
-        with span("api.search", None, top_k=req.top_k) as sp:
+        with span("api.search", self._trace_ctx(headers),
+                  top_k=req.top_k) as sp:
             # downstream hops publish under THIS span's context so their
-            # handler spans link into the search trace
-            trace = sp.headers
+            # handler spans link into the search trace; deadline + tenant
+            # thread along with it
+            trace = {**sp.headers, **extra}
             if self.config.fused_search:
                 fused = await self._fused_search(req, trace)
                 if fused is not None:
@@ -497,7 +792,8 @@ class ApiService:
                 reply = await self.bus.request(
                     subjects.TASKS_EMBEDDING_FOR_QUERY,
                     to_json_bytes(embed_task),
-                    timeout=self.bus_config.request_timeout_embed_s,
+                    timeout=_deadline_capped(
+                        self.bus_config.request_timeout_embed_s, trace),
                     headers={**trace, frames.ACCEPT_FRAME_HEADER: "1"})
             except TimeoutError as e:
                 return 503, resp([], f"Failed to get embedding from preprocessing service: {e}")
@@ -523,7 +819,8 @@ class ApiService:
                 reply = await self.bus.request(
                     subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
                     to_json_bytes(search_task),
-                    timeout=self.bus_config.request_timeout_search_s,
+                    timeout=_deadline_capped(
+                        self.bus_config.request_timeout_search_s, trace),
                     headers=trace)
             except TimeoutError as e:
                 return 503, resp([], f"Failed to get search results from vector memory service: {e}")
@@ -713,13 +1010,23 @@ class ApiService:
         # connected" while actually counting connects-ever
         metrics.gauge_add("api.sse_clients", 1)
         metrics.inc("api.sse_clients_total")
+        shutdown = False
+        completed = False  # saw the task's done-chunk / final message
         try:
             while True:
                 try:
                     payload = await asyncio.wait_for(
                         q.get(), timeout=self.config.sse_keepalive_s)
                     if payload is None:  # close sentinel from stop()
+                        shutdown = True
                         return
+                    if task_filter and not completed:
+                        try:
+                            obj = json.loads(payload)
+                            completed = (obj.get("done") is True
+                                         or "generated_text" in obj)
+                        except (ValueError, AttributeError):
+                            pass
                     for line in payload.splitlines() or [""]:
                         writer.write(f"data: {line}\n".encode("utf-8"))
                     writer.write(b"\n")
@@ -731,6 +1038,25 @@ class ApiService:
         finally:
             self.hub.unregister(q)
             metrics.gauge_add("api.sse_clients", -1)
+            if (task_filter and not shutdown and not completed
+                    and task_filter in self._gen_submitted
+                    and not self.hub.has_follower(task_filter)):
+                # the LAST reader of a task this gateway accepted vanished
+                # MID-generation: tell the text generator so the task's
+                # decode row frees at the next chunk boundary instead of
+                # pinning a KV slot to budget exhaustion. A normal close
+                # after the done event, a never-submitted task id, or a
+                # surviving co-reader all publish nothing — the generator
+                # tombstones unknown ids (the cancel-raced-ahead case), so
+                # a spurious cancel would kill a live or future stream.
+                metrics.inc("api.sse_gen_cancels")
+                try:
+                    await self.bus.publish(
+                        subjects.TASKS_GENERATION_CANCEL,
+                        json.dumps({"task_id": task_filter}).encode())
+                except Exception:
+                    log.debug("generation cancel publish failed",
+                              exc_info=True)
 
 
 def to_json_bytes_url(url: str) -> bytes:

@@ -27,7 +27,7 @@ import random
 from typing import Awaitable, Callable, Optional
 
 from symbiont_tpu.bus.core import Msg
-from symbiont_tpu.resilience import faults
+from symbiont_tpu.resilience import admission, faults
 from symbiont_tpu.resilience.supervisor import supervise
 from symbiont_tpu.utils.retry import jittered
 from symbiont_tpu.utils.telemetry import metrics, span
@@ -121,11 +121,32 @@ class Service:
             name=f"{self.name}:{subject}")
         self._loops.append(t)
 
+    async def _drop_expired(self, subject: str, msg: Msg,
+                            ack: bool) -> bool:
+        """Deadline propagation (overload-protection plane): a message whose
+        X-Symbiont-Deadline has passed is dropped BEFORE the handler runs —
+        the caller already gave up, so doing the work only adds load at the
+        worst time. Counted as `admission.expired{service}`; the durable
+        delivery is ACKED (expiry is not a handler failure: it must not
+        redeliver and must never quarantine as poison)."""
+        if not admission.expired(msg.headers):
+            return False
+        metrics.inc("admission.expired",
+                    labels={"service": self.name, "subject": subject})
+        log.info("%s: dropping expired work on %s (deadline passed "
+                 "%.0fms ago)", self.name, subject,
+                 -(admission.remaining_ms(msg.headers) or 0.0))
+        if ack:
+            await self.bus.ack(msg)
+        return True
+
     async def _run_handler(self, subject: str, handler: Handler, msg: Msg,
                            ack: bool = False) -> None:
         try:
             metrics.inc("bus.consumed",
                         labels={"service": self.name, "subject": subject})
+            if await self._drop_expired(subject, msg, ack):
+                return
             attempts = 1 + max(0, self.handler_retries)
             delay = self.handler_backoff_base_s
             for attempt in range(attempts):
@@ -161,6 +182,10 @@ class Service:
                     # full-jitter exponential backoff between attempts
                     await asyncio.sleep(jittered(delay, self._rng))
                     delay = min(delay * 2, self.handler_backoff_max_s)
+                    # the deadline may have passed during the backoff: a
+                    # retry of expired work is load with no beneficiary
+                    if await self._drop_expired(subject, msg, ack):
+                        return
                     continue
                 if ack:
                     # ack-after-success: a failed handler leaves the message

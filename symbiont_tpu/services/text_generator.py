@@ -84,16 +84,58 @@ class TextGeneratorService(Service):
         self._lm_buffer_chars = 0
         self._lm_train_lock = asyncio.Lock()
         self._lm_train_task: asyncio.Task | None = None
+        # in-flight generations by task_id → cancel Event (overload plane):
+        # a tasks.generation.cancel for a task this replica is decoding
+        # frees its batch row / closes its stream at the next chunk boundary
+        self._inflight: dict = {}
+        # cancels that arrived BEFORE their generation task: under overload
+        # — exactly when cancellation matters — generate tasks sit bus-queued
+        # behind in-flight work, so the SSE reader can vanish (and its cancel
+        # arrive) while the task is still undelivered. Tombstone the id
+        # (with its arrival time) so registration observes it; bounded,
+        # oldest ids expire first, and a stale tombstone (past the TTL) is
+        # ignored — the cancel fans out to EVERY replica, so on the ones
+        # that never see the task it would otherwise lie in wait for an
+        # id-reusing resubmission forever.
+        self._cancelled_early: dict = {}
+        self._cancelled_early_ttl_s = 60.0
+        # ...but a cancel for a task that already FINISHED here must not
+        # tombstone (it would silently kill a resubmission reusing the id)
+        self._completed_recent: dict = {}
 
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.TASKS_GENERATION_TEXT,
                                    self._handle_generate,
                                    queue=subjects.QUEUE_TEXT_GENERATOR)
+        # cancels fan out to EVERY replica (no queue group): only the one
+        # decoding the task acts; everyone else ignores the unknown id
+        await self._subscribe_loop(subjects.TASKS_GENERATION_CANCEL,
+                                   self._handle_cancel)
         if self.train_on_ingest or self.lm_trainer is not None:
             # continuous learning from the pipeline (no queue group: every
             # generator replica learns the full stream)
             await self._subscribe_loop(subjects.DATA_RAW_TEXT_DISCOVERED,
                                        self._handle_train)
+
+    async def _handle_cancel(self, msg: Msg) -> None:
+        import json as _json
+
+        try:
+            task_id = _json.loads(msg.data).get("task_id")
+        except (ValueError, AttributeError):
+            return
+        ev = self._inflight.get(task_id)
+        metrics.inc("text_generator.cancel_requests")
+        if ev is not None and not ev.is_set():
+            ev.set()
+            metrics.inc("text_generator.cancelled")
+            log.info("generation %s cancelled (client disconnected)", task_id)
+        elif ev is None and task_id and task_id not in self._completed_recent:
+            import time as _time
+
+            self._cancelled_early[task_id] = _time.monotonic()
+            while len(self._cancelled_early) > 256:
+                self._cancelled_early.pop(next(iter(self._cancelled_early)))
 
     async def _handle_train(self, msg: Msg) -> None:
         raw = from_json(RawTextMessage, msg.data)
@@ -231,28 +273,60 @@ class TextGeneratorService(Service):
 
     async def _handle_generate(self, msg: Msg) -> None:
         task = from_json(GenerateTextTask, msg.data)
-        with span("text_generator.generate", msg.headers,
-                  max_length=task.max_length):
-            if self.lm_stream is not None and task.stream:
-                # per-request opt-in: a stream decodes chunk-by-chunk (the
-                # engine lock is released between chunks, lm.py:328-336) but
-                # still can't share one batched executable with other
-                # requests, so only explicit stream=true requests take it —
-                # everything else rides the micro-batcher
-                text = await self._stream_generate(task, msg.headers)
-            elif self.lm_batcher is not None:
-                text = await self.lm_batcher.generate(
-                    task.prompt or "", task.max_length,
-                    temperature=task.temperature, top_k=task.top_k)
-            elif self.lm_generate is not None:
-                text = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: self.lm_generate(
+        import time as _time
+
+        cancel = asyncio.Event()
+        tombstone = self._cancelled_early.pop(task.task_id, None)
+        if (tombstone is not None
+                and _time.monotonic() - tombstone
+                <= self._cancelled_early_ttl_s):
+            # the cancel raced ahead of the task across the two subjects:
+            # honor it now or the decode runs its full budget for a reader
+            # that is already gone (stale tombstones are ignored — see
+            # _cancelled_early above)
+            cancel.set()
+            metrics.inc("text_generator.cancelled")
+        self._inflight[task.task_id] = cancel
+        try:
+            with span("text_generator.generate", msg.headers,
+                      max_length=task.max_length):
+                if self.lm_stream is not None and task.stream:
+                    # per-request opt-in: a stream decodes chunk-by-chunk
+                    # (the engine lock is released between chunks,
+                    # lm.py:328-336) but still can't share one batched
+                    # executable with other requests, so only explicit
+                    # stream=true requests take it — everything else rides
+                    # the micro-batcher
+                    text = await self._stream_generate(task, msg.headers,
+                                                       cancel)
+                elif self.lm_batcher is not None:
+                    # cancel frees the request's decode row at the next
+                    # chunk boundary (GenBatcher → BatchSession.cancel_tag)
+                    text = await self.lm_batcher.generate(
                         task.prompt or "", task.max_length,
-                        temperature=task.temperature, top_k=task.top_k))
-            else:
-                # Markov backend has no sampling knobs: temperature/top_k
-                # are accepted on the wire but ignored (documented in schema)
-                text = self.markov.generate(task.max_length)
+                        temperature=task.temperature, top_k=task.top_k,
+                        cancel=cancel)
+                elif self.lm_generate is not None:
+                    text = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: self.lm_generate(
+                            task.prompt or "", task.max_length,
+                            temperature=task.temperature, top_k=task.top_k))
+                else:
+                    # Markov backend has no sampling knobs: temperature/top_k
+                    # are accepted on the wire but ignored (documented in
+                    # schema)
+                    text = self.markov.generate(task.max_length)
+        finally:
+            self._inflight.pop(task.task_id, None)
+        # completion is only recorded on the NORMAL path: a raised handler
+        # will be retried (services/base.py), and a cancel landing during
+        # its backoff must still tombstone so the retry aborts
+        self._completed_recent[task.task_id] = True
+        while len(self._completed_recent) > 256:
+            self._completed_recent.pop(next(iter(self._completed_recent)))
+        if text is None or cancel.is_set():
+            # cancelled mid-decode: nobody is listening — no final event
+            return
         out = GeneratedTextMessage(original_task_id=task.task_id,
                                    generated_text=text,
                                    timestamp_ms=current_timestamp_ms())
@@ -261,19 +335,32 @@ class TextGeneratorService(Service):
                                headers=child_headers(msg.headers))
         metrics.inc("text_generator.generated")
 
-    async def _stream_generate(self, task: GenerateTextTask, headers) -> str:
+    async def _stream_generate(self, task: GenerateTextTask, headers,
+                               cancel=None):
         """Drive the decode generator in an executor thread; every text delta
         crossing back is published as a GeneratedTextChunk before the next
-        chunk even starts decoding. Returns the accumulated full text."""
+        chunk even starts decoding. Returns the accumulated full text — or
+        None when `cancel` was set mid-stream (the generator is CLOSED at
+        the next chunk boundary, which runs its finally block and releases
+        its decode state; the terminal done-chunk still goes out so any
+        remaining consumer sees a clean close)."""
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
         def produce() -> None:
+            gen = self.lm_stream(task.prompt or "", task.max_length,
+                                 temperature=task.temperature,
+                                 top_k=task.top_k)
             try:
-                for delta in self.lm_stream(task.prompt or "",
-                                            task.max_length,
-                                            temperature=task.temperature,
-                                            top_k=task.top_k):
+                for delta in gen:
+                    if cancel is not None and cancel.is_set():
+                        # closing the generator runs its finally (stats
+                        # flushed, device state dropped) — the decode stops
+                        # at this chunk instead of running out the budget
+                        gen.close()
+                        loop.call_soon_threadsafe(queue.put_nowait,
+                                                  ("cancelled", None))
+                        return
                     loop.call_soon_threadsafe(queue.put_nowait, ("delta", delta))
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
             except BaseException as e:  # surface decode errors to the handler
@@ -282,6 +369,7 @@ class TextGeneratorService(Service):
         producer = loop.run_in_executor(None, produce)
         parts: list = []
         seq = 0
+        cancelled = False
         try:
             while True:
                 kind, payload = await queue.get()
@@ -298,6 +386,9 @@ class TextGeneratorService(Service):
                     metrics.inc("text_generator.stream_chunks")
                 elif kind == "end":
                     break
+                elif kind == "cancelled":
+                    cancelled = True
+                    break
                 else:
                     raise payload
         finally:
@@ -310,4 +401,4 @@ class TextGeneratorService(Service):
                     original_task_id=task.task_id, text_delta="", seq=seq,
                     done=True, timestamp_ms=current_timestamp_ms())),
                 headers=child_headers(headers))
-        return "".join(parts)
+        return None if cancelled else "".join(parts)

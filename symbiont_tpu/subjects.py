@@ -26,10 +26,20 @@ EVENTS_TEXT_GENERATED = "events.text.generated"
 # tokens back out through NATS→SSE"); the final full message still rides
 # EVENTS_TEXT_GENERATED for reference-era consumers
 EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial"
+# generation cancellation (overload-protection plane): published by the API
+# gateway when an SSE client that was following a task disconnects
+# mid-generation; the text generator frees the task's decode row / closes
+# its stream so a vanished reader can never pin a KV slot
+TASKS_GENERATION_CANCEL = "tasks.generation.cancel"
 
 # request-reply (query path)
 TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query"
 TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request"
+# graph-augmented search (the reference's knowledge-graph limb, finally
+# load-bearing end-to-end: entity extraction → graph upsert → THIS query
+# surface): token-overlap document lookup over the graph store, served by
+# knowledge_graph behind POST /api/search/graph
+TASKS_SEARCH_GRAPH_REQUEST = "tasks.search.graph.request"
 
 ALL_SUBJECTS = [
     TASKS_PERCEIVE_URL,
@@ -39,8 +49,10 @@ ALL_SUBJECTS = [
     TASKS_GENERATION_TEXT,
     EVENTS_TEXT_GENERATED,
     EVENTS_TEXT_GENERATED_PARTIAL,
+    TASKS_GENERATION_CANCEL,
     TASKS_EMBEDDING_FOR_QUERY,
     TASKS_SEARCH_SEMANTIC_REQUEST,
+    TASKS_SEARCH_GRAPH_REQUEST,
 ]
 
 # engine plane (framework-internal, not part of the reference's wire surface):

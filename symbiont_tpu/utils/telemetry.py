@@ -41,6 +41,16 @@ log = logging.getLogger("symbiont.trace")
 
 TRACE_HEADER = "X-Trace-Id"
 SPAN_HEADER = "X-Span-Id"
+# Overload-protection plane (resilience/admission.py): the request deadline
+# (absolute unix epoch MILLISECONDS, minted at the API edge) and the tenant
+# identity ride the same bus-header channel as the trace context, and
+# child_headers threads them across every hop — a downstream service drops
+# expired work BEFORE its handler runs (services/base.py).
+DEADLINE_HEADER = "X-Symbiont-Deadline"
+TENANT_HEADER = "X-Symbiont-Tenant"
+
+# headers child_headers carries verbatim beyond the trace pair
+_THREADED_HEADERS = (DEADLINE_HEADER, TENANT_HEADER)
 
 
 def new_trace_headers() -> Dict[str, str]:
@@ -53,12 +63,21 @@ def child_headers(parent: Optional[Dict[str, str]]) -> Dict[str, str]:
     The span id is carried over VERBATIM (it names the publishing span):
     the receiving handler's span records it as parent_id, which is what
     links hops into one tree. (Pre-obs versions minted a fresh span id per
-    hop — an id that no recorded span owned, so trees could never link.)"""
+    hop — an id that no recorded span owned, so trees could never link.)
+
+    Deadline/tenant headers (the admission plane's channel) thread through
+    verbatim too: a deadline minted at the API edge must reach the LAST hop
+    of the pipeline, or expired work is only droppable at the first."""
     if not parent or TRACE_HEADER not in parent:
-        return new_trace_headers()
-    out = {TRACE_HEADER: parent[TRACE_HEADER]}
-    if SPAN_HEADER in parent:
-        out[SPAN_HEADER] = parent[SPAN_HEADER]
+        out = new_trace_headers()
+    else:
+        out = {TRACE_HEADER: parent[TRACE_HEADER]}
+        if SPAN_HEADER in parent:
+            out[SPAN_HEADER] = parent[SPAN_HEADER]
+    if parent:
+        for h in _THREADED_HEADERS:
+            if h in parent:
+                out[h] = parent[h]
     return out
 
 

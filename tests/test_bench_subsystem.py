@@ -354,8 +354,8 @@ def test_cli_main_end_to_end_stub_registry(monkeypatch, capsys):
     # register into the ORIGINAL registry — main()'s imports then no-op and
     # only the stubs below exist in the patched registry
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
-        serialization)
+        chaos, compute, decode, e2e, engine_plane, load, multichip, obs,
+        quant, serialization)
 
     monkeypatch.setattr(tiers, "_REGISTRY", {})
 
@@ -389,8 +389,8 @@ def test_cli_only_runs_named_tier_and_never_persists(monkeypatch, capsys):
     BENCH_LATEST.json — a partial line must not become the doc's source."""
     from symbiont_tpu.bench import cli
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
-        serialization)
+        chaos, compute, decode, e2e, engine_plane, load, multichip, obs,
+        quant, serialization)
 
     monkeypatch.setattr(tiers, "_REGISTRY", {})
 
@@ -498,8 +498,8 @@ def test_declared_primary_metrics_single_source():
     from symbiont_tpu.bench import cli
     # the real tier modules must be registered for this check
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
-        serialization)
+        chaos, compute, decode, e2e, engine_plane, load, multichip, obs,
+        quant, serialization)
 
     declared = cli.declared_primary_metrics()
     assert cli.ROOFLINE_PRIMARY in declared
@@ -539,8 +539,8 @@ def test_declared_primary_metrics_excludes_skipped_tiers():
     lost metric (review finding)."""
     from symbiont_tpu.bench import cli
     from symbiont_tpu.bench import (  # noqa: F401
-        chaos, compute, decode, e2e, engine_plane, multichip, obs, quant,
-        serialization)
+        chaos, compute, decode, e2e, engine_plane, load, multichip, obs,
+        quant, serialization)
 
     full = cli.declared_primary_metrics()
     no_e2e = cli.declared_primary_metrics(skips={"e2e": "skipped by flag"})
@@ -585,3 +585,43 @@ def test_quant_tier_registered_with_primaries():
         "quant_embed_cos_int8", "quant_embed_int8_vs_bf16_x",
         "quant_decode_int8kv_vs_bf16_x"}
     assert not reg["quant"].quick  # device tier: full runs only
+
+
+# ------------------------------------------------------ load-tier seed knobs
+
+def test_load_seed_flag_parsing():
+    """--chaos-seed/--load-seed parse to ints, default 0, and reject
+    garbage loudly — a typo'd seed must not silently replay seed 0."""
+    from symbiont_tpu.bench import cli
+
+    assert cli.parse_seed_flag(["--load-seed", "7"], "--load-seed") == 7
+    assert cli.parse_seed_flag([], "--load-seed") == 0
+    with pytest.raises(ValueError):
+        cli.parse_seed_flag(["--load-seed", "banana"], "--load-seed")
+    with pytest.raises(ValueError):
+        cli.parse_seed_flag(["--load-seed"], "--load-seed")
+
+
+def test_cli_seed_flags_reach_tier_ctx(monkeypatch, capsys):
+    """The seeds ride ctx into every tier (the load tier archives them as
+    load_seed/chaos_seed so a red run replays bit-for-bit), and a
+    malformed seed is usage (rc 2), not a traceback."""
+    from symbiont_tpu.bench import cli
+    from symbiont_tpu.bench import (  # noqa: F401
+        chaos, compute, decode, e2e, engine_plane, load, multichip, obs,
+        quant, serialization)
+
+    monkeypatch.setattr(tiers, "_REGISTRY", {})
+    seen = {}
+
+    @tiers.register("seed_probe", primary_metrics=("probe_ok",), quick=True)
+    def probe(results, ctx):
+        seen["load"] = ctx.load_seed
+        seen["chaos"] = ctx.chaos_seed
+        results["probe_ok"] = 1.0
+
+    rc = cli.main(["--quick", "--load-seed", "11", "--chaos-seed", "42"])
+    capsys.readouterr()
+    assert rc == 0 and seen == {"load": 11, "chaos": 42}
+    assert cli.main(["--quick", "--load-seed", "banana"]) == 2
+    capsys.readouterr()
