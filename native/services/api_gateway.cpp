@@ -347,6 +347,13 @@ struct Config {
 
 Config g_cfg;
 
+// readiness (GET /readyz): the HTTP port opens BEFORE the bus connection
+// exists (so /healthz answers during bring-up), but a data-path POST
+// accepted then would 200 into nothing — the exact cold-gateway window the
+// compose healthcheck used to miss by probing /healthz. Flipped true once
+// the SSE bridge's bus client is connected and subscribed.
+std::atomic<bool> g_ready{false};
+
 // negative cache: after a fused-search timeout (subject unserved), skip the
 // fused probe until this steady-clock deadline so a deployment without a
 // co-located engine+store pays the probe once per window, not per request
@@ -742,12 +749,19 @@ void sse_bridge() {
     if (!symbiont::connect_with_retry(bus, SERVICE)) return;
     bus.subscribe(symbiont::subjects::EVENTS_TEXT_GENERATED);
     bus.subscribe(symbiont::subjects::EVENTS_TEXT_GENERATED_PARTIAL);
+    g_ready.store(true);  // bus live + subscribed: safe to take data paths
     while (bus.connected()) {
       auto msg = bus.next(1000);
       if (!msg) continue;
       g_hub.broadcast(msg->data, g_cfg.sse_capacity);
       g_metrics.inc("api.sse_broadcast");
     }
+    // readiness is a LIVE claim: with the bus gone, /readyz must go 503
+    // and the data-path gate must re-engage — a gateway that keeps
+    // advertising ready while its bridge redials (or gives up after the
+    // retry budget) is serving into nothing, the exact window the
+    // liveness/readiness split exists to close
+    g_ready.store(false);
     symbiont::logline("WARN", SERVICE, "sse bridge lost bus; reconnecting");
   }
 }
@@ -805,6 +819,19 @@ void handle_connection(int fd) {
     }
     int status = 404;
     std::string body;
+    if (req.method == "POST" && !g_ready.load() &&
+        (req.path == "/api/submit-url" || req.path == "/api/generate-text" ||
+         req.path == "/api/search/semantic")) {
+      // Python-twin parity (api.py _route): a cold gateway must refuse
+      // data-path work honestly instead of 200ing into a bus with no
+      // connection — a well-behaved LB watches /readyz and never sends this
+      g_metrics.inc("api.not_ready_rejects");
+      write_response(fd, 503,
+                     msg_json("stack is warming up (see /readyz)"),
+                     req.headers, keep_alive);
+      if (!keep_alive) break;
+      continue;
+    }
     if (req.method == "OPTIONS") {
       status = 200;
       body = "";
@@ -821,8 +848,19 @@ void handle_connection(int fd) {
       status = 200;
       body = g_metrics.snapshot_json();
     } else if (req.method == "GET" && req.path == "/healthz") {
+      // liveness ONLY: the process is up and serving HTTP. Routing
+      // decisions belong to /readyz (Python-twin split).
       status = 200;
       body = "{\"status\": \"ok\"}";
+    } else if (req.method == "GET" && req.path == "/readyz") {
+      if (g_ready.load()) {
+        status = 200;
+        body = "{\"status\": \"ready\"}";
+      } else {
+        status = 503;
+        body = "{\"status\": \"starting\", \"message\": "
+               "\"bus connection in progress\"}";
+      }
     } else if (req.method == "GET" && req.path == "/api/health/engine") {
       std::tie(status, body) = route_engine_health();
     } else {

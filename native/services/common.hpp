@@ -136,6 +136,26 @@ inline std::map<std::string, std::string> child_headers(
   return h;
 }
 
+// ---- expired-deadline drop (Service._run_handler parity, PR 9/10) -------
+//
+// The edge mints X-Symbiont-Deadline (absolute epoch ms) and child_headers
+// threads it through every hop. A delivery whose deadline has passed is
+// DEAD WORK: the caller already gave up, so a mid-pipeline C++ worker must
+// not burn capacity on it — drop BEFORE the handler body runs, ACK on
+// durable streams (expiry is the caller giving up, not a handler failure:
+// never retried, never dead-lettered), exactly like the Python services.
+// An unparseable deadline is NO deadline (garbage must not make work
+// immortal OR instantly dead).
+
+inline bool deadline_expired(const std::map<std::string, std::string>& headers) {
+  auto it = headers.find(DEADLINE_HEADER);
+  if (it == headers.end()) return false;
+  char* end = nullptr;
+  double dl = std::strtod(it->second.c_str(), &end);
+  if (end == it->second.c_str()) return false;  // unparseable: no deadline
+  return (double)now_ms() > dl;
+}
+
 // Structured one-line log: ts level service msg key=value... trace=...
 inline void logline(const char* level, const std::string& service,
                     const std::string& msg,
@@ -145,6 +165,23 @@ inline void logline(const char* level, const std::string& service,
                (unsigned long long)now_ms(), level, service.c_str(),
                msg.c_str(),
                it != headers.end() ? it->second.c_str() : "-");
+}
+
+// The ack half of the expired-deadline drop (declared after logline — see
+// deadline_expired above): returns true when the delivery was expired (and
+// therefore acked + consumed); the worker loop `continue`s past it.
+// bus.ack is a no-op on non-durable deliveries (no X-Symbus-* headers), so
+// this is safe on every subject, request-reply included — an expired
+// request gets NO reply, the caller's timeout already fired.
+inline bool drop_if_expired(symbus::Client& bus, const symbus::BusMsg& msg,
+                            const std::string& service) {
+  if (!deadline_expired(msg.headers)) return false;
+  logline("INFO", service,
+          "dropping expired work on " + msg.subject +
+              " (deadline passed; acked, never retried)",
+          msg.headers);
+  bus.ack(msg);
+  return true;
 }
 
 // Bus URL: symbus://host:port (nats:// accepted as a reference-era alias,

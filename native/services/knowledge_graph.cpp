@@ -48,6 +48,10 @@ int main() try {
   while (bus.connected()) {
     auto msg = bus.next(1000);
     if (!msg) continue;
+    // expired-deadline drop (Service._run_handler parity): acked, never
+    // retried — a mid-pipeline worker must not burn graph writes on work
+    // whose caller already gave up
+    if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
 
     symbiont::TokenizedTextMessage m;
     try {

@@ -322,6 +322,10 @@ int main() try {
   while (bus.connected()) {
     auto msg = bus.next(1000);
     if (!msg || msg->sid != sid) continue;
+    // expired-deadline drop (Service._run_handler parity). Ingest mints no
+    // deadline by default (zero-loss invariant) — this only fires for a
+    // client-supplied deadline, exactly like the Python perception service.
+    if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
     symbiont::PerceiveUrlTask task;
     try {
       task = symbiont::PerceiveUrlTask::parse(msg->data);

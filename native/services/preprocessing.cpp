@@ -285,6 +285,9 @@ int main() try {
 
     // ------------------------------------------------------------ pipeline
     if (msg->sid == sid_raw) {
+      // expired-deadline drop (Service._run_handler parity): dead work is
+      // acked BEFORE any embed capacity is spent on it
+      if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
       PendingDoc d;
       d.delivery = *msg;
       try {
@@ -335,6 +338,9 @@ int main() try {
 
     // ----------------------------------------------------- query embedding
     if (msg->sid == sid_query) {
+      // an expired query gets NO reply: the edge's deadline-capped bus
+      // timeout already fired, a late reply would land in a dead inbox
+      if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
       if (msg->reply.empty()) {
         symbiont::logline("WARN", SERVICE, "query task without reply inbox",
                           msg->headers);

@@ -122,6 +122,9 @@ int main() try {
       continue;
     }
     if (msg->sid != sid_gen) continue;
+    // expired-deadline drop (Service._run_handler parity): the reader that
+    // wanted this generation is past its deadline — never decode for it
+    if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
 
     symbiont::GenerateTextTask task;
     try {

@@ -279,6 +279,10 @@ int main() try {
 
     // ------------------------------------------------------------- upsert
     if (msg->sid == sid_store) {
+      // expired-deadline drop (Service._run_handler parity): acked, never
+      // retried, never dead-lettered. Ingest mints no deadline by default
+      // (docs/RESILIENCE.md) — this only fires on client-opt-in deadlines.
+      if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
       PendingDoc d;
       d.delivery = *msg;
       try {
@@ -350,6 +354,9 @@ int main() try {
 
     // ------------------------------------------------------------- search
     if (msg->sid == sid_search) {
+      // an expired search gets NO reply — the edge's deadline-capped bus
+      // timeout already fired (api.py _deadline_capped)
+      if (symbiont::drop_if_expired(bus, *msg, SERVICE)) continue;
       if (msg->reply.empty()) {
         symbiont::logline("WARN", SERVICE, "search task without reply inbox",
                           msg->headers);

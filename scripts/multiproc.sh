@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Process-failure plane, one command (docs/RESILIENCE.md "process
+# supervision"): broker + supervised worker PROCESSES + the multi-tenant
+# load simulator, with a seeded kill-chaos plan SIGKILLing one worker,
+# SIGSTOPping another, and SIGKILLing the broker itself mid-run — hard
+# gates: exact zero-loss ingest, Jain fairness >= 0.8, zero final queue
+# depth, and the kill->serving-again recovery time archived as
+# `load_proc_recovery_s`.
+#
+#   scripts/multiproc.sh                 # chaos scenarios + the bench tier
+#   scripts/multiproc.sh --tests-only    # just the pytest chaos scenarios
+#   scripts/multiproc.sh --seed 7        # replay a specific kill plan
+#
+# Device-free: workers run tiny real engines on the JAX CPU backend; the
+# broker is the pure-Python symbus twin (bus/pybroker.py) where the native
+# build is unavailable — same wire protocol, same .symlog durability.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+seed=1
+tests_only=0
+prev=""
+for arg in "$@"; do
+  case "$arg" in
+    --tests-only) tests_only=1 ;;
+    --seed) prev="seed" ;;
+    *) if [[ "$prev" == "seed" ]]; then seed="$arg"; prev=""; fi ;;
+  esac
+done
+
+echo "== process-failure chaos scenarios (pybroker + supervisor) ==" >&2
+python -m pytest tests/test_procsup.py -m chaos -q
+
+if [[ "$tests_only" -eq 1 ]]; then
+  exit 0
+fi
+
+echo "== load_multiproc bench tier (kill-chaos, seed ${seed}) ==" >&2
+exec python bench.py --only load_multiproc --multiproc \
+  --load-seed "${seed}" --chaos-seed "${seed}"

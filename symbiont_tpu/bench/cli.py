@@ -6,6 +6,8 @@
     python bench.py --no-chaos      # skip the fault-injection tier
     python bench.py --only multichip           # one tier (no persist)
     python bench.py --mesh dp4xtp2             # multichip tier mesh shape
+    python bench.py --only load_multiproc --multiproc   # kill-chaos, real
+                                               # multi-process deployment
     python bench.py --render-doc BENCH_rNN.json > docs/PERF.md
     python bench.py --gate NEW.json BASELINE.json   # regression gate
     python bench.py --validate ARCHIVE.json [...]   # schema check
@@ -248,7 +250,13 @@ def main(argv=None) -> int:
             return 2
     ctx = types.SimpleNamespace(device=dev, peak=chip_peak_flops(dev),
                                 mesh_shape=mesh_shape,
-                                load_seed=load_seed, chaos_seed=chaos_seed)
+                                load_seed=load_seed, chaos_seed=chaos_seed,
+                                # --multiproc arms the load_multiproc tier:
+                                # broker + supervised worker PROCESSES +
+                                # seeded kill-chaos (bench/load.py); without
+                                # the flag that tier skips (it spawns real
+                                # OS processes — explicit opt-in only)
+                                multiproc="--multiproc" in argv)
     _maybe_register_injection()
 
     quick = "--quick" in argv

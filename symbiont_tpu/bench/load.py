@@ -508,3 +508,476 @@ async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
             client_pool.shutdown(wait=False)
             await stack.stop()
             await bus.close()
+
+
+# ---------------------------------------------------------------------------
+# --multiproc: the SAME simulator against the REAL multi-process deployment
+# (ROADMAP item 5 remainder #1; the process-failure plane's end-to-end
+# proof). A ProcessSupervisor owns the broker (pure-Python symbus twin,
+# bus/pybroker.py — wire/log-compatible with native/symbus) plus one
+# `python -m symbiont_tpu.runner` process per role; a seeded kill plan
+# SIGKILLs one worker and SIGSTOPs another MID-INGEST and then SIGKILLs the
+# broker itself, and the hard gates still hold:
+#
+# - `load_mp_zero_loss_ingest` — EXACT point count across process deaths
+#   (durable stream log + client reconnect/re-attach + deterministic ids);
+# - `load_mp_fairness_jain` ≥ 0.8 with one ~8x hot tenant (edge admission
+#   in the gateway PROCESS, engine lanes in the embed process);
+# - zero final fair-queue depth (429s, not queues);
+# - `load_proc_recovery_s` — worst kill→serving-again time across the
+#   killed workers (supervisor liveness confirmations), the tier's new
+#   primary; broker recovery archived alongside.
+#
+# Scale note (CPU, ~2 min): each worker is a real process importing jax and
+# building a small real engine — this tier is about process failure, not
+# throughput, so the corpus stays modest and generation runs the Markov
+# backend (LM decode compiles would dominate the wall clock).
+# ---------------------------------------------------------------------------
+
+MP_DOCS_PER_TENANT = 3     # 3 docs x 5 tenants x 4 sentences = 60 points
+MP_SEARCHES_PER_TENANT = 15
+MP_HOT_SEARCHES = 110
+MP_GENERATIONS = 4
+
+
+@register("load_multiproc", primary_metrics=(
+        "load_proc_recovery_s", "load_mp_zero_loss_ingest",
+        "load_mp_fairness_jain"))
+def tier_load_multiproc(results: dict, ctx) -> None:
+    import asyncio
+
+    if not getattr(ctx, "multiproc", False):
+        from symbiont_tpu.bench.tiers import TierSkip
+
+        raise TierSkip("spawns real OS processes; pass --multiproc "
+                       "(scripts/multiproc.sh)")
+    load_seed = int(getattr(ctx, "load_seed", 0) or 0)
+    chaos_seed = int(getattr(ctx, "chaos_seed", 0) or 0)
+    results["load_mp_seed"] = load_seed
+    results["load_mp_chaos_seed"] = chaos_seed
+    asyncio.run(_drive_multiproc(results, load_seed, chaos_seed))
+
+
+async def _page_server(pages: dict):
+    """Tiny HTTP server handing the perception WORKER PROCESS its pages —
+    in-proc fetcher injection can't cross a process boundary, so the
+    multiproc tier scrapes real HTTP like production would."""
+    import asyncio
+
+    async def handle(reader, writer):
+        try:
+            line = await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            path = line.split()[1].decode()
+            body = pages.get(path, "").encode()
+            status = "200 OK" if body else "404 Not Found"
+            writer.write((f"HTTP/1.1 {status}\r\n"
+                          "Content-Type: text/html\r\n"
+                          f"Content-Length: {len(body)}\r\n"
+                          "Connection: close\r\n\r\n").encode() + body)
+            await writer.drain()
+        except (ConnectionResetError, IndexError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, "127.0.0.1", 0)
+
+
+async def _drive_multiproc(results: dict, load_seed: int,
+                           chaos_seed: int) -> None:
+    import asyncio
+    import json as _json
+    import os
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from symbiont_tpu import subjects
+    from symbiont_tpu.bus.tcp import TcpBus
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        pybroker_spec,
+        runner_spec,
+    )
+
+    rng = np.random.default_rng(load_seed)
+    chaos_rng = np.random.default_rng(chaos_seed)
+    tenants = [f"t{i}" for i in range(N_TENANTS)]
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    pages = {}
+    for tenant in tenants + [HOT_TENANT]:
+        for i in range(MP_DOCS_PER_TENANT):
+            pages[f"/{tenant}/{i}"] = _page(rng, tenant, i)
+    page_srv = await _page_server(pages)
+    page_port = page_srv.sockets[0].getsockname()[1]
+
+    with tempfile.TemporaryDirectory() as td:
+        broker_port = free_port()
+        api_port = free_port()
+        bus_url = f"symbus://127.0.0.1:{broker_port}"
+        # worker-process config, all via env (the config layer's canonical
+        # spelling — SYMBIONT_<SECTION>_<FIELD>)
+        common = {
+            "JAX_PLATFORMS": "cpu",
+            "SYMBIONT_BUS_DURABLE": "1",
+            "SYMBIONT_BUS_DURABLE_ACK_WAIT_S": "1.0",
+            "SYMBIONT_BUS_DURABLE_MAX_DELIVER": "10",
+            "SYMBIONT_PARALLEL_ENABLED": "0",
+            "SYMBIONT_VECTOR_STORE_DIM": "32",
+            "SYMBIONT_VECTOR_STORE_DATA_DIR": f"{td}/vs",
+            "SYMBIONT_VECTOR_STORE_SHARD_CAPACITY": "256",
+            "SYMBIONT_GRAPH_STORE_DATA_DIR": f"{td}/gs",
+            "SYMBIONT_TEXT_GENERATOR_MARKOV_STATE_PATH": f"{td}/markov.json",
+            # tiny real engine (test_tcp_bus full-stack geometry): boots in
+            # seconds on CPU, compiles two buckets on first embed
+            "SYMBIONT_ENGINE_EMBEDDING_DIM": "32",
+            "SYMBIONT_ENGINE_LENGTH_BUCKETS": "[16, 32]",
+            "SYMBIONT_ENGINE_BATCH_BUCKETS": "[2, 8]",
+            "SYMBIONT_ENGINE_MAX_BATCH": "8",
+            "SYMBIONT_ENGINE_DTYPE": "float32",
+            "SYMBIONT_ENGINE_DATA_PARALLEL": "0",
+            "SYMBIONT_ENGINE_FLUSH_DEADLINE_MS": "2.0",
+        }
+        gateway_env = {
+            **common,
+            "SYMBIONT_API_HOST": "127.0.0.1",
+            "SYMBIONT_API_PORT": str(api_port),
+            "SYMBIONT_API_FUSED_SEARCH": "0",
+            "SYMBIONT_API_SSE_KEEPALIVE_S": "0.5",
+            # per-tenant quotas sized like the in-proc tier: normals fit,
+            # the hot tenant's ~8x flood is clamped
+            "SYMBIONT_ADMISSION_SEARCH_RATE": "5.0",
+            "SYMBIONT_ADMISSION_SEARCH_BURST": str(
+                float(MP_SEARCHES_PER_TENANT)),
+            "SYMBIONT_ADMISSION_INGEST_RATE": "500.0",
+            "SYMBIONT_ADMISSION_INGEST_BURST": "500.0",
+            "SYMBIONT_ADMISSION_GENERATE_RATE": "100.0",
+            "SYMBIONT_ADMISSION_GENERATE_BURST": "100.0",
+        }
+
+        log_path = f"{td}/workers.log"
+        stdio = open(log_path, "ab")
+        sup = ProcessSupervisor(bus_url=bus_url, stdio=stdio)
+        sup.add_worker(pybroker_spec(broker_port, f"{td}/symbus",
+                                     heartbeat_timeout_s=4.0))
+        hb = dict(heartbeat_s=0.4, heartbeat_timeout_s=4.0)
+        sup.add_worker(runner_spec("gateway", "api", bus_url,
+                                   env=gateway_env, **hb))
+        sup.add_worker(runner_spec("perception", "perception", bus_url,
+                                   env=common, **hb))
+        sup.add_worker(runner_spec("embed", "preprocessing", bus_url,
+                                   env=common, **hb))
+        sup.add_worker(runner_spec("memory", "vector_memory", bus_url,
+                                   env=common, **hb))
+        sup.add_worker(runner_spec("graphgen",
+                                   "knowledge_graph,text_generator",
+                                   bus_url, env=common, **hb))
+        await sup.start()
+        loop = asyncio.get_running_loop()
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        client_pool = ThreadPoolExecutor(max_workers=32,
+                                         thread_name_prefix="mp-client")
+
+        def _http(method, path, body=None, headers=None, timeout=30):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{api_port}{path}",
+                data=(_json.dumps(body).encode()
+                      if body is not None else None),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})}, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, _json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"{}")
+            except (urllib.error.URLError, ConnectionError, OSError):
+                # gateway process booting or mid-restart: status 0 lets
+                # pollers keep polling instead of tearing the tier down
+                return 0, {}
+
+        def http(method, path, body=None, headers=None, timeout=30):
+            return loop.run_in_executor(
+                client_pool,
+                lambda: _http(method, path, body, headers, timeout))
+
+        driver_bus = None
+
+        async def store_count() -> int:
+            nonlocal driver_bus
+            try:
+                if driver_bus is None:
+                    driver_bus = TcpBus("127.0.0.1", broker_port)
+                    await driver_bus.connect()
+                reply = await driver_bus.request(
+                    subjects.TASKS_MEMORY_COUNT, b"{}", timeout=3.0)
+                body = _json.loads(reply.data)
+                return -1 if body.get("count") is None else int(body["count"])
+            except (TimeoutError, ConnectionError, OSError, ValueError):
+                return -1  # store process (or broker) mid-restart
+
+        try:
+            # ---- boot: gateway /readyz green + every role heartbeating --
+            t_boot = time.monotonic()
+            deadline = t_boot + 180
+            while time.monotonic() < deadline:
+                status, _ = await http("GET", "/readyz", timeout=2)
+                if status == 200:
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise RuntimeError(
+                    f"gateway /readyz never went green (see {log_path})")
+            for role in ("perception", "embed", "memory", "graphgen"):
+                await sup.wait_role_up(role, after=t_boot - 1,
+                                       timeout_s=120)
+            results["load_mp_boot_s"] = round(time.monotonic() - t_boot, 2)
+            log(f"multiproc deployment up in {results['load_mp_boot_s']}s "
+                f"(broker + 5 worker processes)")
+
+            # ---- phase A: first ingest wave ----------------------------
+            urls = [f"http://127.0.0.1:{page_port}{path}"
+                    for path in pages]
+            expected = len(pages) * SENTS_PER_DOC
+            half = len(urls) // 2
+            t0 = time.monotonic()
+            for url in urls[:half]:
+                tenant = url.rsplit("/", 2)[1]
+                status, _ = await http("POST", "/api/submit-url",
+                                       {"url": url},
+                                       {"X-Symbiont-Tenant": tenant})
+                assert status == 200, status
+            while (time.monotonic() < t0 + 120
+                   and await store_count() < 1):
+                await asyncio.sleep(0.1)
+
+            # ---- phase B: seeded kill plan MID-INGEST ------------------
+            kill_victim = str(chaos_rng.choice(["embed", "memory"]))
+            stop_pool = [r for r in ("graphgen", "memory", "embed")
+                         if r != kill_victim]
+            stop_victim = str(chaos_rng.choice(stop_pool[:2]))
+            results["load_mp_kill_victim_" + kill_victim] = 1.0
+            results["load_mp_stop_victim_" + stop_victim] = 1.0
+            t_kill = time.monotonic()
+            os.kill(sup.pid(kill_victim), signal.SIGKILL)
+            t_stop = time.monotonic()
+            os.kill(sup.pid(stop_victim), signal.SIGSTOP)
+            log(f"multiproc kill plan (seed {chaos_seed}): SIGKILL "
+                f"{kill_victim}, SIGSTOP {stop_victim} — mid-ingest")
+
+            # ---- phase C: second wave lands INTO the chaos -------------
+            for url in urls[half:]:
+                tenant = url.rsplit("/", 2)[1]
+                status, _ = await http("POST", "/api/submit-url",
+                                       {"url": url},
+                                       {"X-Symbiont-Tenant": tenant})
+                assert status == 200, status
+
+            # ---- phase D: zero loss + recovery -------------------------
+            # after = t_kill + one heartbeat period: a beat the dead
+            # process published milliseconds BEFORE the SIGKILL can be
+            # routed/stamped after it, and must not count as recovery
+            r_kill = await sup.wait_role_up(kill_victim, after=t_kill + 1.0,
+                                            timeout_s=120) - t_kill
+            # the SIGSTOPped worker only recovers via the hang detector's
+            # SIGKILL → restart; its liveness signal must postdate the kill
+            r_stop = await sup.wait_role_up(stop_victim, after=t_stop + 4.0,
+                                            timeout_s=120) - t_stop
+            deadline = time.monotonic() + 180
+            landed = -1
+            while time.monotonic() < deadline:
+                landed = await store_count()
+                if landed >= expected:
+                    break
+                await asyncio.sleep(0.2)
+            await asyncio.sleep(1.5)  # redelivery settle, then check EXACT
+            landed = await store_count()
+            results["load_mp_ingest_docs"] = len(pages)
+            results["load_mp_expected_points"] = expected
+            results["load_mp_landed_points"] = landed
+            results["load_mp_zero_loss_ingest"] = float(landed == expected)
+            results["load_proc_recovery_s"] = round(max(r_kill, r_stop), 2)
+            results["load_mp_recovery_kill_s"] = round(r_kill, 2)
+            results["load_mp_recovery_stop_s"] = round(r_stop, 2)
+            log(f"multiproc ingest: {len(pages)} docs / {expected} points "
+                f"across SIGKILL({kill_victim})+SIGSTOP({stop_victim}) → "
+                f"{landed} landed; recovery kill {r_kill:.2f}s / "
+                f"stop {r_stop:.2f}s")
+            if landed != expected:
+                raise RuntimeError(
+                    f"load_mp_zero_loss_ingest violated: {landed}/"
+                    f"{expected} points (chaos seed {chaos_seed}, "
+                    f"log {log_path})")
+
+            # ---- phase E: the broker itself dies -----------------------
+            t_broker = time.monotonic()
+            os.kill(sup.pid("broker"), signal.SIGKILL)
+            await sup.wait_role_up("broker", after=t_broker, timeout_s=60)
+            # serving again = a search round-trips through gateway →
+            # preprocessing → vector_memory over the RESTARTED broker
+            deadline = time.monotonic() + 60
+            broker_recovered = None
+            while time.monotonic() < deadline:
+                status, body = await http(
+                    "POST", "/api/search/semantic",
+                    {"query_text": "symbiont tensor", "top_k": 2},
+                    {"X-Symbiont-Tenant": "probe"}, timeout=10)
+                if status == 200 and body.get("error_message") is None:
+                    broker_recovered = time.monotonic() - t_broker
+                    break
+                await asyncio.sleep(0.5)
+            if broker_recovered is None:
+                raise RuntimeError(
+                    "search never recovered after broker SIGKILL "
+                    f"(log {log_path})")
+            results["load_mp_broker_recovery_s"] = round(broker_recovered, 2)
+            log(f"multiproc broker SIGKILL → stream log replayed, clients "
+                f"re-attached, search serving again in "
+                f"{broker_recovered:.2f}s")
+
+            # ---- phase F: search storm, one hot tenant -----------------
+            lat_ms: list = []
+            admitted = {t: 0 for t in tenants + [HOT_TENANT]}
+            throttled = {t: 0 for t in tenants + [HOT_TENANT]}
+
+            async def one_search(tenant, query):
+                t1 = time.monotonic()
+                status, body = await http(
+                    "POST", "/api/search/semantic",
+                    {"query_text": query, "top_k": 3},
+                    {"X-Symbiont-Tenant": tenant}, timeout=60)
+                if status == 200 and body.get("error_message") is None:
+                    admitted[tenant] += 1
+                    lat_ms.append((time.monotonic() - t1) * 1000.0)
+                elif status == 429:
+                    throttled[tenant] += 1
+                else:
+                    raise RuntimeError(
+                        f"search failed ({tenant}): {status} {body}")
+
+            storm = []
+            for tenant in tenants:
+                storm += [one_search(tenant, f"{rng.choice(VOCAB)} "
+                                             f"{rng.choice(VOCAB)}")
+                          for _ in range(MP_SEARCHES_PER_TENANT)]
+            storm += [one_search(HOT_TENANT, f"{rng.choice(VOCAB)} flood")
+                      for _ in range(MP_HOT_SEARCHES)]
+            t2 = time.monotonic()
+            await asyncio.gather(*storm)
+            storm_s = time.monotonic() - t2
+            lat_ms.sort()
+            n_429 = sum(throttled.values())
+            fairness = jain_index(admitted.values())
+            results["load_mp_search_requests"] = len(storm)
+            results["load_mp_search_ok"] = sum(admitted.values())
+            results["load_mp_throttled_429"] = n_429
+            results["load_mp_search_p99_ms"] = round(_pct(lat_ms, 0.99), 2)
+            results["load_mp_fairness_jain"] = round(fairness, 4)
+            log(f"multiproc storm: {len(storm)} req in {storm_s:.2f}s → "
+                f"{results['load_mp_search_ok']} ok / {n_429}x 429; "
+                f"admitted {dict(sorted(admitted.items()))} → "
+                f"Jain {fairness:.3f}")
+            if fairness < 0.8:
+                raise RuntimeError(
+                    f"multiproc tenant fairness {fairness:.3f} < 0.8 "
+                    f"(admitted: {admitted})")
+            if n_429 == 0:
+                raise RuntimeError("hot tenant was never throttled in the "
+                                   "multiproc deployment")
+            short = {t: admitted[t] for t in tenants
+                     if admitted[t] < MP_SEARCHES_PER_TENANT}
+            if short:
+                raise RuntimeError(
+                    f"hot tenant starved normal tenants: {short}")
+
+            # ---- phase G: generation through the restarted worker ------
+            sse_events: list = []
+
+            async def sse_reader():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", api_port)
+                writer.write(b"GET /api/events HTTP/1.1\r\n"
+                             b"Host: x\r\n\r\n")
+                await writer.drain()
+                try:
+                    while True:
+                        line = await reader.readline()
+                        if not line:
+                            return
+                        if line.startswith(b"data: "):
+                            try:
+                                sse_events.append(
+                                    _json.loads(line[6:].strip()))
+                            except ValueError:
+                                pass
+                except (asyncio.CancelledError, ConnectionResetError):
+                    pass
+                finally:
+                    writer.close()
+
+            sse_task = asyncio.create_task(sse_reader())
+            await asyncio.sleep(0.3)
+            gen_ms: list = []
+            for i in range(MP_GENERATIONS):
+                tid = f"mp-gen-{i}"
+                t3 = time.monotonic()
+                status, _ = await http(
+                    "POST", "/api/generate-text",
+                    {"task_id": tid, "prompt": "symbiont", "max_length": 10},
+                    {"X-Symbiont-Tenant": "gen"})
+                assert status == 200, status
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if any(e.get("original_task_id") == tid
+                           and e.get("generated_text") is not None
+                           for e in sse_events):
+                        gen_ms.append((time.monotonic() - t3) * 1000.0)
+                        break
+                    await asyncio.sleep(0.02)
+                else:
+                    raise RuntimeError(
+                        f"no generated event for {tid} — text_generator "
+                        "did not survive the kill plan")
+            sse_task.cancel()
+            results["load_mp_generations"] = MP_GENERATIONS
+            results["load_mp_gen_p99_ms"] = round(
+                _pct(sorted(gen_ms), 0.99), 1)
+            log(f"multiproc generation: {MP_GENERATIONS} tasks through the "
+                f"restarted worker, p99 {results['load_mp_gen_p99_ms']}ms")
+
+            # ---- no unbounded queues anywhere --------------------------
+            status, snap = await http("GET", "/api/metrics")
+            assert status == 200
+            queued = float(snap.get("gauges", {}).get("admission.queued",
+                                                      0.0))
+            results["load_mp_final_queued"] = queued
+            if queued != 0:
+                raise RuntimeError(
+                    f"gateway fair queue not drained: {queued}")
+            results["load_mp_worker_restarts"] = float(
+                sum(sup.restarts(r) for r in
+                    ("embed", "memory", "graphgen", "broker", "gateway",
+                     "perception")))
+        finally:
+            try:
+                if driver_bus is not None:
+                    await driver_bus.close()
+            except Exception:
+                pass
+            client_pool.shutdown(wait=False)
+            await sup.stop()
+            stdio.close()
+            page_srv.close()
+            await page_srv.wait_closed()

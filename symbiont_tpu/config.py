@@ -82,6 +82,13 @@ class EngineConfig:
     # flushes keeps the chip fed (engine/batcher.py _BatcherBase). 2 was
     # measured as break-even locally; raise toward 4 on a high-RTT tunnel.
     max_inflight_flushes: int = 2
+    # Engine-plane tenant fairness (engine/batcher.TenantLanes): items queue
+    # in per-tenant lanes drained stride-fair, so a hot tenant that bypasses
+    # the API edge cannot starve others at the device queue. This bounds
+    # each lane; a full lane rejects (typed engine error / unacked durable
+    # delivery that redelivers later) instead of growing without limit.
+    # 0 = unbounded lanes (fairness still applies).
+    tenant_lane_depth: int = 4096
     data_parallel: bool = True  # shard batches across the mesh 'data' axis
     executable_cache_size: int = 64
     # Bulk-ingest host pipeline: embed_texts tokenizes this many texts per
@@ -109,6 +116,8 @@ class EngineConfig:
             raise ValueError(
                 f"engine.quantize must be one of {QUANTIZE_MODES}, "
                 f"got {self.quantize!r}")
+        if self.tenant_lane_depth < 0:
+            raise ValueError("engine.tenant_lane_depth must be >= 0")
 
 
 @dataclass
@@ -151,6 +160,10 @@ class LmConfig:
     # into per-request sessions, 10x the wall time).
     gen_max_batch: int = 8
     gen_flush_deadline_ms: float = 30.0
+    # per-tenant bounded lanes in front of the generation batcher (see
+    # EngineConfig.tenant_lane_depth; generation requests are heavier, so
+    # the default lane bound is tighter). 0 = unbounded.
+    gen_tenant_lane_depth: int = 1024
     # continuous batching: a decode session keeps at least this many batch
     # rows so requests arriving mid-decode can JOIN at chunk boundaries
     # (BatchSession.admit). Nearly free on TPU — decode steps are bound by
@@ -197,6 +210,8 @@ class LmConfig:
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"lm.kv_quant must be none|int8, got {self.kv_quant!r}")
+        if self.gen_tenant_lane_depth < 0:
+            raise ValueError("lm.gen_tenant_lane_depth must be >= 0")
         # the streaming decode loop runs whole chunks against a KV cache with
         # exactly new_bucket decode slots — a non-dividing chunk would scan
         # past the cache and rely on dynamic_update_slice clamp semantics
@@ -518,6 +533,18 @@ class RunnerConfig:
     """
 
     services: str = "all"
+    # process-failure plane (resilience/procsup.py): when heartbeat_s > 0
+    # the stack publishes a liveness heartbeat to `_sys.heartbeat.<role>`
+    # every heartbeat_s seconds — the signal the process supervisor uses to
+    # detect a HUNG (SIGSTOPped, deadlocked) worker that an exit code can't
+    # reveal. `role` names this process in heartbeats and procsup metrics;
+    # empty = derived from the services list.
+    role: str = ""
+    heartbeat_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.heartbeat_s < 0:
+            raise ValueError("runner.heartbeat_s must be >= 0")
 
 
 @dataclass

@@ -34,9 +34,184 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.resilience.admission import (
+    DEFAULT_TENANT,
+    OVERFLOW_TENANT,
+    AdmissionReject,
+    StrideClock,
+)
 from symbiont_tpu.utils.telemetry import metrics
 
 log = logging.getLogger(__name__)
+
+# distinct tenant lanes a batcher keeps before folding NEW identities into
+# the shared overflow lane — same bounded-universe stance as the edge's
+# admission.max_tenants (the tenant header is client-supplied)
+MAX_TENANT_LANES = 256
+
+
+class TenantLanes:
+    """Per-tenant FIFO lanes drained in stride-fair order (engine-plane
+    fairness, ROADMAP item 5 remainder).
+
+    The PR 9 overload plane enforced tenant fairness only at the API edge;
+    the micro-batcher itself was one FIFO deque — so any path that bypasses
+    the edge (a replicated gateway without admission, a native shell calling
+    engine.* directly, a restarted worker draining a durable backlog)
+    re-created hot-tenant starvation at the device queue. These lanes move
+    the guarantee into the batcher: each queued item lands in its tenant's
+    bounded lane, and the drain order is stride scheduling over the SAME
+    `StrideClock` the edge fair queue runs (resilience/admission.py) — a
+    tenant with 80 queued embeds interleaves 1:1 with a tenant holding 2,
+    instead of serializing ahead of it.
+
+    Single-tenant behavior is exactly the old FIFO deque (one lane), so
+    every pre-existing ordering contract holds unchanged. A full lane
+    rejects (`AdmissionReject` → typed engine error / handler failure whose
+    durable delivery redelivers later) — bounded memory, never unbounded
+    queue growth behind the device.
+
+    Duck-typing: supports the deque surface the batcher (and its tests)
+    use — `len`, truthiness, iteration in drain order (non-mutating),
+    `clear()` — plus the fair `append/peek/popleft/requeue_front/drain`
+    cycle. Items without a `.tenant` attribute ride the default lane.
+    """
+
+    def __init__(self, kind: str = "batcher", max_per_tenant: int = 0,
+                 max_lanes: int = MAX_TENANT_LANES,
+                 weights: Optional[dict] = None):
+        self.kind = kind
+        self.max_per_tenant = int(max_per_tenant)
+        self.max_lanes = int(max_lanes)
+        self._clock = StrideClock(weights)
+        self._lanes: "dict[str, deque]" = {}
+        # CUMULATIVE identity bound (the edge's resolve_tenant stance): the
+        # tenant header is client-supplied, so bounding only the CONCURRENT
+        # lane count would still let a client cycling fresh identities one
+        # request at a time grow clock state and the tenant_depth gauge
+        # label space without limit — past max_lanes identities ever seen,
+        # every NEW name shares the overflow lane.
+        self._seen: set = {DEFAULT_TENANT}
+        self._n = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def _lane_key(self, item) -> str:
+        tenant = getattr(item, "tenant", None) or DEFAULT_TENANT
+        if tenant in self._seen or tenant in self._clock.weights:
+            return tenant
+        if len(self._seen) >= self.max_lanes:
+            return OVERFLOW_TENANT
+        self._seen.add(tenant)
+        return tenant
+
+    def _gauge(self, tenant: str) -> None:
+        metrics.gauge_set("batcher.tenant_depth",
+                          len(self._lanes.get(tenant, ())),
+                          labels={"batcher": self.kind, "tenant": tenant})
+
+    def _drop_if_empty(self, tenant: str) -> None:
+        lane = self._lanes.get(tenant)
+        if lane is not None and not lane:
+            del self._lanes[tenant]
+            # no banked lateness is erased: the clock only forgets a tenant
+            # whose virtual time is at/below the floor
+            self._clock.forget(tenant)
+
+    # ------------------------------------------------------------------ api
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        return iter(self.fair_order())
+
+    def fair_order(self) -> List:
+        """Every queued item in the order popleft() would serve them —
+        computed on snapshots, nothing consumed."""
+        clock = self._clock.snapshot()
+        lanes = {t: list(q) for t, q in self._lanes.items() if q}
+        out: List = []
+        while lanes:
+            tenant = clock.pick(lanes)
+            lane = lanes[tenant]
+            out.append(lane.pop(0))
+            clock.charge(tenant)
+            if not lane:
+                del lanes[tenant]
+        return out
+
+    def append(self, item) -> None:
+        tenant = self._lane_key(item)
+        lane = self._lanes.setdefault(tenant, deque())
+        if self.max_per_tenant and len(lane) >= self.max_per_tenant:
+            self._drop_if_empty(tenant)
+            metrics.inc("batcher.lane_rejected",
+                        labels={"batcher": self.kind, "tenant": tenant})
+            raise AdmissionReject(
+                "engine_lane_full", retry_after_s=1.0,
+                message=f"tenant {tenant!r} {self.kind} lane is full "
+                        f"({self.max_per_tenant} queued at the engine)")
+        lane.append(item)
+        self._n += 1
+        self._gauge(tenant)
+
+    def peek(self):
+        """The item the next popleft() will return (deterministic between
+        mutations); None when empty."""
+        tenant = self._clock.pick(t for t, q in self._lanes.items() if q)
+        return None if tenant is None else self._lanes[tenant][0]
+
+    def popleft(self):
+        tenant = self._clock.pick(t for t, q in self._lanes.items() if q)
+        if tenant is None:
+            raise IndexError("pop from empty TenantLanes")
+        item = self._lanes[tenant].popleft()
+        self._clock.charge(tenant)
+        self._n -= 1
+        self._gauge(tenant)
+        self._drop_if_empty(tenant)
+        return item
+
+    def requeue_front(self, items: List) -> None:
+        """Stolen-but-unserved items go back to the FRONT of their own
+        lanes in original arrival order — the cross-lane drain order is the
+        clock's business, per-lane FIFO is preserved."""
+        per_lane: "dict[str, List]" = {}
+        for item in items:
+            per_lane.setdefault(self._lane_key(item), []).append(item)
+        for tenant, block in per_lane.items():
+            lane = self._lanes.setdefault(tenant, deque())
+            # extendleft reverses its argument, so reversed() lands the
+            # block at the front IN ORIGINAL ORDER (pinned by tests)
+            lane.extendleft(reversed(block))
+            self._n += len(block)
+            self._gauge(tenant)
+
+    def drain_fair(self) -> List:
+        """Pop everything in fair order (the GenBatcher steal)."""
+        out: List = []
+        while self._n:
+            out.append(self.popleft())
+        return out
+
+    def clear(self) -> None:
+        for tenant, lane in list(self._lanes.items()):
+            lane.clear()
+            self._gauge(tenant)
+            self._drop_if_empty(tenant)
+        self._n = 0
+
+    def oldest_submit(self) -> Optional[float]:
+        """Earliest _t_submit across lane heads (each lane is FIFO, so its
+        head is its oldest) — feeds the queue-age gauge."""
+        heads = [q[0] for q in self._lanes.values() if q]
+        times = [getattr(h, "_t_submit", None) for h in heads]
+        times = [t for t in times if t is not None]
+        return min(times) if times else None
 
 
 class _BatcherBase:
@@ -70,13 +245,15 @@ class _BatcherBase:
     kind = "batcher"
 
     def __init__(self, max_batch: int, deadline_s: float,
-                 max_inflight_flushes: int = 1):
+                 max_inflight_flushes: int = 1, lane_depth: int = 0):
         self.max_batch = max_batch
         self.deadline_s = deadline_s
-        # deque: popleft is O(1); the pre-obs list popped index 0, an O(n)
-        # shift per item that scaled with backlog depth exactly when the
-        # batcher was busiest
-        self._queue: deque = deque()
+        # per-tenant bounded lanes drained stride-fair (TenantLanes): the
+        # single-tenant case degenerates to the old FIFO deque; under a
+        # multi-tenant backlog the chunk composition interleaves tenants so
+        # an edge-bypassing hot tenant cannot starve the rest at the device
+        self._queue: TenantLanes = TenantLanes(kind=self.kind,
+                                               max_per_tenant=lane_depth)
         self._queued = 0
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -113,8 +290,9 @@ class _BatcherBase:
                 return None
             if not b._queue:
                 return 0.0
-            # FIFO (requeues go to the FRONT), so [0] is the oldest
-            t = getattr(b._queue[0], "_t_submit", None)
+            # per-lane FIFO (requeues go to the FRONT), so the oldest item
+            # is the earliest lane head
+            t = b._queue.oldest_submit()
             return 0.0 if t is None else max(0.0, time.monotonic() - t)
 
         def inflight(b):
@@ -149,8 +327,7 @@ class _BatcherBase:
         # after _run has already exited — with no loop left to serve them,
         # their futures would hang forever. All flushes are done now, so the
         # queue is final: fail what's left.
-        leftovers = list(self._queue)
-        self._queue.clear()
+        leftovers = self._queue.drain_fair()
         self._queued = 0
         for item in leftovers:
             if not item.future.done():
@@ -166,24 +343,23 @@ class _BatcherBase:
 
     def _requeue(self, items: List) -> None:
         """Put stolen-but-unserved items back, ahead of anything submitted
-        meanwhile (preserve arrival order), and wake the run loop — it may
-        have parked on a cleared _wake after the steal emptied the queue;
-        without a wake the re-queued items sit unserved until an unrelated
-        submission arrives (ADVICE r4 medium)."""
+        meanwhile (preserve per-lane arrival order), and wake the run loop —
+        it may have parked on a cleared _wake after the steal emptied the
+        queue; without a wake the re-queued items sit unserved until an
+        unrelated submission arrives (ADVICE r4 medium)."""
         if not items:
             return
-        # extendleft reverses its argument, so reversed(items) lands the
-        # re-queued block at the front IN ORIGINAL ORDER (covered by tests)
-        self._queue.extendleft(reversed(items))
+        self._queue.requeue_front(items)
         self._queued += sum(self._size(k) for k in items)
         self._wake.set()
 
     def _take_chunk(self) -> List:
-        """Pop up to max_batch's worth of items (always at least one)."""
+        """Pop up to max_batch's worth of items (always at least one),
+        composed across tenant lanes in stride-fair order."""
         taken: List = []
         size = 0
         while self._queue and (not taken
-                               or size + self._size(self._queue[0]) <= self.max_batch):
+                               or size + self._size(self._queue.peek()) <= self.max_batch):
             item = self._queue.popleft()
             size += self._size(item)
             taken.append(item)
@@ -255,6 +431,9 @@ class _BatcherBase:
 class _Pending:
     texts: List[str]
     future: asyncio.Future
+    # engine-plane fairness: the lane this item queues in (bus-header tenant
+    # threaded down by the calling service; default lane otherwise)
+    tenant: str = DEFAULT_TENANT
 
 
 class MicroBatcher(_BatcherBase):
@@ -262,7 +441,8 @@ class MicroBatcher(_BatcherBase):
 
     def __init__(self, engine: TpuEngine, max_batch: Optional[int] = None,
                  flush_deadline_ms: Optional[float] = None,
-                 max_inflight_flushes: Optional[int] = None):
+                 max_inflight_flushes: Optional[int] = None,
+                 lane_depth: Optional[int] = None):
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
                     else engine.config.flush_deadline_ms) / 1000.0
         from symbiont_tpu.config import EngineConfig
@@ -286,13 +466,21 @@ class MicroBatcher(_BatcherBase):
                              # shadowed by a stale literal here
                              else getattr(
                                  engine.config, "max_inflight_flushes",
-                                 EngineConfig.max_inflight_flushes)))
+                                 EngineConfig.max_inflight_flushes)),
+                         lane_depth=(
+                             lane_depth if lane_depth is not None
+                             else getattr(engine.config, "tenant_lane_depth",
+                                          EngineConfig.tenant_lane_depth)))
         self.engine = engine
 
-    async def embed(self, texts: Sequence[str]) -> np.ndarray:
-        """Submit texts; resolves with [n, dim] when their batch flushes."""
+    async def embed(self, texts: Sequence[str],
+                    tenant: Optional[str] = None) -> np.ndarray:
+        """Submit texts; resolves with [n, dim] when their batch flushes.
+        `tenant` picks the fairness lane (engine-plane fairness survives
+        edge bypass — docs/RESILIENCE.md); None rides the default lane."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._submit(_Pending(list(texts), fut))
+        self._submit(_Pending(list(texts), fut,
+                              tenant=tenant or DEFAULT_TENANT))
         return await fut
 
     def _size(self, item: _Pending) -> int:
@@ -331,6 +519,8 @@ class _PendingGen:
     # frees its decode row mid-session instead of pinning it to budget
     # exhaustion. A cancelled request's future resolves to None.
     cancel: Optional[object] = None
+    # fairness lane (see _Pending.tenant)
+    tenant: str = DEFAULT_TENANT
 
     def cancelled(self) -> bool:
         return self.cancel is not None and self.cancel.is_set()
@@ -354,27 +544,37 @@ class GenBatcher(_BatcherBase):
     kind = "generate"
 
     def __init__(self, lm, max_batch: Optional[int] = None,
-                 flush_deadline_ms: Optional[float] = None):
+                 flush_deadline_ms: Optional[float] = None,
+                 lane_depth: Optional[int] = None):
+        from symbiont_tpu.config import LmConfig
+
         deadline = (flush_deadline_ms if flush_deadline_ms is not None
                     else lm.config.gen_flush_deadline_ms) / 1000.0
-        super().__init__(max_batch or lm.config.gen_max_batch, deadline)
+        super().__init__(max_batch or lm.config.gen_max_batch, deadline,
+                         lane_depth=(
+                             lane_depth if lane_depth is not None
+                             else getattr(lm.config, "gen_tenant_lane_depth",
+                                          LmConfig.gen_tenant_lane_depth)))
         self.lm = lm
         self.stats = {"sessions": 0, "admitted_midflight": 0}
 
     async def generate(self, prompt: str, max_new_tokens: int,
                        temperature: Optional[float] = None,
                        top_k: Optional[int] = None,
-                       cancel: Optional[object] = None) -> Optional[str]:
+                       cancel: Optional[object] = None,
+                       tenant: Optional[str] = None) -> Optional[str]:
         """Returns the generated text, or None when `cancel` (an object
         with .is_set(), e.g. asyncio.Event) was set mid-decode and the
-        request's row was freed at a chunk boundary."""
+        request's row was freed at a chunk boundary. `tenant` picks the
+        fairness lane (default lane otherwise)."""
         cfg = self.lm.config
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._submit(_PendingGen(prompt, int(max_new_tokens),
                                  float(temperature), int(top_k), fut,
-                                 cancel=cancel))
+                                 cancel=cancel,
+                                 tenant=tenant or DEFAULT_TENANT))
         return await fut
 
     def _size(self, item: _PendingGen) -> int:
@@ -500,8 +700,9 @@ class GenBatcher(_BatcherBase):
                     #    overlapped with the step below, never awaited here
                     if (prep_fut is None and self._queue
                             and sess.capacity() > 0):
-                        candidates = list(self._queue)
-                        self._queue.clear()
+                        # steal in stride-fair order: admission slots fill
+                        # across tenants, not first-come within one lane
+                        candidates = self._queue.drain_fair()
                         self._queued -= sum(self._size(c) for c in candidates)
                         try:
                             take, retry, defer = await loop.run_in_executor(

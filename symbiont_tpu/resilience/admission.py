@@ -114,6 +114,71 @@ class TokenBucket:
         return max(0.0, deficit / self.rate)
 
 
+# ------------------------------------------------------- stride scheduling
+
+
+class StrideClock:
+    """The stride-scheduling core shared by the edge fair queue and the
+    engine batcher's tenant lanes (PR 10): each grant charges the tenant's
+    virtual time by 1/weight, and the pending tenant with the SMALLEST
+    effective virtual time goes next. The global clock (`vnow`) follows
+    EVERY grant so a tenant active while uncontended banks no virtual
+    lateness, and a tenant returning from idle starts at the current floor
+    instead of its stale past time (no burst catch-up) — both behaviors
+    are regression-pinned by tests/test_admission.py."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0):
+        self.weights = dict(weights or {})
+        self.default_weight = float(default_weight)
+        self._vtime: Dict[str, float] = {}
+        self._vnow = 0.0  # floor for tenants returning from idle
+
+    def _weight(self, tenant: str) -> float:
+        return max(1e-6, float(self.weights.get(tenant,
+                                                self.default_weight)))
+
+    def effective(self, tenant: str) -> float:
+        """The virtual time a grant to `tenant` would happen at."""
+        return max(self._vtime.get(tenant, 0.0), self._vnow)
+
+    def pick(self, tenants) -> Optional[str]:
+        """The pending tenant that goes next (smallest effective virtual
+        time; name breaks exact ties deterministically). None when empty."""
+        best = None
+        for t in tenants:
+            key = (self.effective(t), t)
+            if best is None or key < best:
+                best = key
+        return None if best is None else best[1]
+
+    def charge(self, tenant: str) -> None:
+        """Record one grant: advance the global clock to the grant's
+        virtual time and push the tenant's next entitlement out by
+        1/weight."""
+        v = self.effective(tenant)
+        self._vnow = v
+        self._vtime[tenant] = v + 1.0 / self._weight(tenant)
+
+    def forget(self, tenant: str) -> None:
+        """Drop a drained tenant's bookkeeping once it carries at most ONE
+        grant of debt — after a tenant's last grant its vtime sits exactly
+        1/weight past the floor, so an at-the-floor-only condition would
+        never fire and the dict would grow with every identity ever seen.
+        Erasing ≤ one grant of lateness is the same forgiveness the
+        idle-return floor already grants (effective() clamps to vnow)."""
+        if (self._vtime.get(tenant, 0.0)
+                <= self._vnow + 1.0 / self._weight(tenant)):
+            self._vtime.pop(tenant, None)
+
+    def snapshot(self) -> "StrideClock":
+        """Cheap copy for non-mutating fair-order walks."""
+        c = StrideClock(self.weights, self.default_weight)
+        c._vtime = dict(self._vtime)
+        c._vnow = self._vnow
+        return c
+
+
 # ------------------------------------------------------- weighted-fair queue
 
 
@@ -139,16 +204,15 @@ class WeightedFairQueue:
             raise ValueError("concurrency and max_queue must be >= 1")
         self.concurrency = concurrency
         self.max_queue = max_queue
-        self.weights = dict(weights or {})
-        self.default_weight = float(default_weight)
+        # the stride core is shared with the engine batcher's tenant lanes
+        # (engine/batcher.TenantLanes) — one scheduling policy, two planes
+        self._clock = StrideClock(weights, default_weight)
         self._free = concurrency
         self._waiting: Dict[str, deque] = {}
-        self._vtime: Dict[str, float] = {}
-        self._vnow = 0.0  # floor for tenants returning from idle
 
-    def _weight(self, tenant: str) -> float:
-        return max(1e-6, float(self.weights.get(tenant,
-                                                self.default_weight)))
+    @property
+    def weights(self) -> Dict[str, float]:
+        return self._clock.weights
 
     def queued(self, tenant: Optional[str] = None) -> int:
         if tenant is not None:
@@ -190,15 +254,13 @@ class WeightedFairQueue:
             metrics.gauge_set("admission.queued", self.queued())
 
     def _charge(self, tenant: str) -> None:
-        # returning-from-idle tenants start at the current floor, not at
-        # their stale (possibly far-past) virtual time — no burst catch-up
-        v = max(self._vtime.get(tenant, 0.0), self._vnow)
-        # the global clock follows EVERY grant, fast-path ones included: a
-        # tenant active while the queue was empty must not bank virtual
-        # lateness that lets later contenders monopolize the slots (and
-        # starve it into queue_full 429s) until they catch up
-        self._vnow = v
-        self._vtime[tenant] = v + 1.0 / self._weight(tenant)
+        # returning-from-idle tenants start at the current floor (no burst
+        # catch-up), and the global clock follows EVERY grant, fast-path
+        # ones included: a tenant active while the queue was empty must not
+        # bank virtual lateness that lets later contenders monopolize the
+        # slots (and starve it into queue_full 429s) until they catch up —
+        # both behaviors live in StrideClock.charge now
+        self._clock.charge(tenant)
 
     def release(self, tenant: str) -> None:
         self._free += 1
@@ -206,12 +268,10 @@ class WeightedFairQueue:
 
     def _grant(self) -> None:
         while self._free > 0:
-            pending = [(max(self._vtime.get(t, 0.0), self._vnow), t)
-                       for t, q in self._waiting.items() if q]
-            if not pending:
+            tenant = self._clock.pick(
+                t for t, q in self._waiting.items() if q)
+            if tenant is None:
                 return
-            vmin, tenant = min(pending)
-            self._vnow = vmin
             q = self._waiting[tenant]
             fut = q.popleft()
             if not q:

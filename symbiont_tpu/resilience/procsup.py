@@ -1,0 +1,438 @@
+"""Process-level fault tolerance: the supervisor that owns a multi-process
+deployment.
+
+`resilience/supervisor.py` restarts crashed asyncio LOOPS inside one
+process; this module graduates the same policy to real OS processes. The
+reference system's failure story ends at "one Tokio task per NATS message"
+— a crashed service container is simply gone until an operator notices
+(PAPER survey §2). Here a deployment is a `ProcessSupervisor` owning:
+
+- the broker (native `symbus_broker` or `python -m symbiont_tpu.bus.pybroker`
+  — same wire protocol, same `.symlog` durability, see bus/pybroker.py);
+- one `python -m symbiont_tpu.runner` process per worker role
+  (SYMBIONT_RUNNER_SERVICES picks the role's service set).
+
+Liveness is judged on THREE signals, because each catches what the others
+cannot:
+
+- exit codes — a crashed/killed process is restarted with jittered
+  exponential backoff (the supervisor.py policy, per process);
+- bus heartbeats (`_sys.heartbeat.<role>`, RunnerConfig.heartbeat_s) — a
+  SIGSTOPped or deadlocked worker never exits, but its heartbeats stall;
+  past `heartbeat_timeout_s` the supervisor SIGKILLs and restarts it.
+  Heartbeat verdicts are GATED on broker health: when the broker itself is
+  down, nobody's heartbeats flow, and killing healthy workers for it would
+  turn one failure into seven;
+- a broker PING probe (raw socket, PONG within a deadline) — the broker
+  publishes no heartbeats of its own, and a SIGSTOPped broker still
+  accepts TCP connects into its backlog, so only a round-trip proves it
+  alive. `/readyz` polling covers the gateway the same way for HTTP.
+
+Durability composes with the planes below: the broker's stream log replays
+on restart, `bus/tcp.py` clients auto-reconnect + re-attach durable
+consumers, unacked deliveries redeliver after ack_wait, and deterministic
+point ids make redelivered work idempotent — so a SIGKILL anywhere in the
+deployment (broker included) is a pause, not a loss. Proven end to end by
+`python bench.py --only load_multiproc --multiproc` under a seeded kill
+plan (bench/load.py) and the chaos scenarios in tests/test_procsup.py.
+
+Metrics: `procsup.up{role}` (1 while the process runs), `procsup.restarts
+{role}`, `procsup.heartbeat_age_s{role}`. Restart timestamps are kept on
+each worker so a driver can measure kill→serving-again recovery
+(`load_proc_recovery_s`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from symbiont_tpu.utils.retry import jittered
+from symbiont_tpu.utils.telemetry import metrics
+
+log = logging.getLogger(__name__)
+
+OP_PING, OP_PONG = 4, 6  # symbus wire opcodes (protocol.hpp)
+
+
+@dataclass
+class WorkerSpec:
+    """One supervised process: how to launch it and how to judge it."""
+
+    role: str
+    argv: List[str]
+    env: Dict[str, str] = field(default_factory=dict)
+    # process whose liveness rides bus heartbeats (RunnerConfig.heartbeat_s
+    # must be set in env for these); 0 disables the hang detector
+    heartbeat_timeout_s: float = 0.0
+    # before the FIRST heartbeat ever arrives, judge against this longer
+    # window instead: a worker importing jax and building its engine takes
+    # far longer to start beating than a live one takes to stall
+    boot_grace_s: float = 60.0
+    # restart backoff
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 10.0
+    # the broker worker: probed with a wire PING instead of heartbeats
+    is_broker: bool = False
+    probe_host: str = "127.0.0.1"
+    probe_port: int = 0
+
+
+class _Worker:
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.started_at = 0.0
+        self.last_heartbeat = 0.0   # monotonic ts of the last bus heartbeat
+        self.up_events: List[float] = []  # heartbeat/probe confirmations
+        self.task: Optional[asyncio.Task] = None
+        self.stopping = False
+
+
+class ProcessSupervisor:
+    """Launch, watch, and restart a set of worker processes.
+
+    The supervisor owns its own bus client (connected lazily once the
+    broker answers) purely for the heartbeat subscription — it never
+    publishes application traffic.
+    """
+
+    def __init__(self, bus_url: str = "", heartbeat_poll_s: float = 0.25,
+                 stdio=None):
+        self.bus_url = bus_url
+        self.heartbeat_poll_s = heartbeat_poll_s
+        self.workers: Dict[str, _Worker] = {}
+        self._bus = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._mon_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._broker_healthy = True
+        self._last_probe = 0.0
+        # after the broker (re)covers, worker clients reconnect on THEIR
+        # jittered exponential backoff (bus/tcp.py: up to several seconds)
+        # — suppress hang verdicts for workers that have not yet beaten
+        # since the recovery, for this long
+        self.broker_resync_grace_s = 10.0
+        self._resync_from = 0.0
+        self._resync_until = 0.0
+        # where worker stdio goes (default: inherit; tests pass DEVNULL or
+        # an open log file)
+        self._stdio = stdio
+
+    # ------------------------------------------------------------ lifecycle
+
+    def add_worker(self, spec: WorkerSpec) -> None:
+        if spec.role in self.workers:
+            raise ValueError(f"duplicate worker role {spec.role!r}")
+        self.workers[spec.role] = _Worker(spec)
+
+    async def start(self) -> None:
+        self._stopping = False
+        for w in self.workers.values():
+            self._spawn(w)
+            w.task = asyncio.create_task(self._monitor(w),
+                                         name=f"procsup-{w.spec.role}")
+        if self.bus_url:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop(),
+                                                name="procsup-heartbeats")
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for w in self.workers.values():
+            w.stopping = True
+        if self._hb_task:
+            self._hb_task.cancel()
+            self._hb_task = None
+        if self._bus is not None:
+            try:
+                await self._bus.close()
+            except Exception:
+                pass
+            self._bus = None
+        for w in self.workers.values():
+            self._terminate(w, sig=signal.SIGTERM)
+        for w in self.workers.values():
+            if w.task is not None:
+                w.task.cancel()
+        tasks = [w.task for w in self.workers.values() if w.task]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        # grace, then hard kill
+        deadline = time.monotonic() + 5.0
+        for w in self.workers.values():
+            if w.proc is None:
+                continue
+            while w.proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            if w.proc.poll() is None:
+                self._terminate(w, sig=signal.SIGKILL)
+                w.proc.wait(timeout=5)
+            metrics.gauge_set("procsup.up", 0,
+                              labels={"role": w.spec.role})
+
+    # -------------------------------------------------------------- spawn
+
+    def _spawn(self, w: _Worker) -> None:
+        env = {**os.environ, **w.spec.env}
+        kwargs = {}
+        if self._stdio is not None:
+            kwargs["stdout"] = self._stdio
+            kwargs["stderr"] = self._stdio
+        # own process group: a SIGKILL aimed at one worker must never leak
+        # to the supervisor's group (and chaos plans kill by pid anyway)
+        w.proc = subprocess.Popen(w.spec.argv, env=env,
+                                  start_new_session=True, **kwargs)
+        w.started_at = time.monotonic()
+        w.last_heartbeat = 0.0
+        if w.spec.is_broker:
+            # a (re)started broker means every worker's client is about to
+            # reconnect on ITS jittered backoff — heartbeats resume at
+            # their pace, not ours. Open the resync grace window, or the
+            # gap reads as a fleet-wide hang (a restart can also outrun the
+            # 1s PING probe, so the broker-unhealthy gate alone is not
+            # enough).
+            self._note_broker_recovered()
+        metrics.gauge_set("procsup.up", 1, labels={"role": w.spec.role})
+        log.info("procsup: %s started (pid %d)", w.spec.role, w.proc.pid)
+
+    def _note_broker_recovered(self) -> None:
+        now = time.monotonic()
+        self._resync_from = now
+        self._resync_until = now + self.broker_resync_grace_s
+
+    def _terminate(self, w: _Worker, sig=signal.SIGTERM) -> None:
+        if w.proc is None or w.proc.poll() is not None:
+            return
+        try:
+            w.proc.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def pid(self, role: str) -> Optional[int]:
+        w = self.workers[role]
+        return None if w.proc is None else w.proc.pid
+
+    def restarts(self, role: str) -> int:
+        return self.workers[role].restarts
+
+    # ----------------------------------------------------------- liveness
+
+    async def _monitor(self, w: _Worker) -> None:
+        """Exit-code + hang supervision for one worker, with jittered
+        exponential backoff between restarts (supervisor.py policy)."""
+        delay = w.spec.backoff_base_s
+        while not self._stopping and not w.stopping:
+            rc = w.proc.poll() if w.proc is not None else None
+            hung = self._is_hung(w)
+            if rc is None and not hung:
+                # healthy run resets the backoff after a stable period
+                if time.monotonic() - w.started_at > 10 * delay:
+                    delay = w.spec.backoff_base_s
+                await asyncio.sleep(self.heartbeat_poll_s)
+                continue
+            if rc is None:
+                # hung (heartbeats stalled / probe dead): only SIGKILL
+                # clears a SIGSTOPped process
+                log.warning("procsup: %s HUNG (no liveness signal for "
+                            "%.1fs); killing pid %d", w.spec.role,
+                            time.monotonic() - max(w.last_heartbeat,
+                                                   w.started_at),
+                            w.proc.pid)
+                metrics.inc("procsup.hangs", labels={"role": w.spec.role})
+                self._terminate(w, sig=signal.SIGKILL)
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, w.proc.wait, 10)
+                except Exception:
+                    pass
+            else:
+                log.warning("procsup: %s exited rc=%s", w.spec.role, rc)
+            metrics.gauge_set("procsup.up", 0, labels={"role": w.spec.role})
+            if self._stopping or w.stopping:
+                return
+            await asyncio.sleep(jittered(delay))
+            delay = min(delay * 2, w.spec.backoff_max_s)
+            if self._stopping or w.stopping:
+                return
+            w.restarts += 1
+            metrics.inc("procsup.restarts", labels={"role": w.spec.role})
+            self._spawn(w)
+
+    def _is_hung(self, w: _Worker) -> bool:
+        if w.spec.is_broker:
+            return False  # judged by the probe loop (needs a round-trip)
+        if w.spec.heartbeat_timeout_s <= 0:
+            return False
+        if not self._broker_healthy:
+            # the broker is down/SIGSTOPped: NOBODY's heartbeats flow.
+            # Judging workers now would turn one failure into many.
+            return False
+        if w.last_heartbeat == 0.0:
+            # never beaten yet: still booting (jax import + engine build) —
+            # judge against the boot grace, not the steady-state timeout
+            return (time.monotonic() - w.started_at) > w.spec.boot_grace_s
+        now = time.monotonic()
+        if now < self._resync_until and w.last_heartbeat < self._resync_from:
+            # broker just recovered and this worker hasn't beaten through
+            # it yet: its client is mid-reconnect, not hung
+            return False
+        age = time.monotonic() - w.last_heartbeat
+        metrics.gauge_set("procsup.heartbeat_age_s", round(age, 2),
+                          labels={"role": w.spec.role})
+        return age > w.spec.heartbeat_timeout_s
+
+    async def _heartbeat_loop(self) -> None:
+        """Subscribe `_sys.heartbeat.>` on the broker and stamp workers;
+        also probes the broker itself (PING→PONG round-trip) and flips
+        `_broker_healthy`, SIGKILLing a hung broker so its monitor
+        restarts it over the persisted stream log."""
+        from symbiont_tpu import subjects
+        from symbiont_tpu.bus import connect
+
+        sub = None
+        while not self._stopping:
+            # (re)connect the supervisor's own bus client
+            if self._bus is None:
+                try:
+                    # retries=1: this loop IS the retry policy (fast poll)
+                    self._bus = await connect(self.bus_url, retries=1)
+                    sub = await self._bus.subscribe(
+                        subjects.SYS_HEARTBEAT + ".>")
+                except (ConnectionError, OSError):
+                    self._bus = None
+                    await asyncio.sleep(self.heartbeat_poll_s)
+                    continue
+            msg = await sub.next(self.heartbeat_poll_s)
+            now = time.monotonic()
+            if msg is not None:
+                role = msg.subject.rsplit(".", 1)[-1]
+                w = self.workers.get(role)
+                if w is not None:
+                    w.last_heartbeat = now
+                    w.up_events.append(now)
+                    del w.up_events[:-64]
+            await self._probe_broker()
+
+    async def _probe_broker(self) -> None:
+        """PING→PONG the broker over a fresh socket. A SIGSTOPped broker
+        still ACCEPTS connections (kernel backlog) — only the round-trip
+        proves the event loop is alive."""
+        broker = next((w for w in self.workers.values()
+                       if w.spec.is_broker), None)
+        if broker is None:
+            return
+        now = time.monotonic()
+        if now - self._last_probe < 1.0:
+            return
+        self._last_probe = now
+        timeout = max(1.0, broker.spec.heartbeat_timeout_s or 3.0)
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._ping_once,
+            broker.spec.probe_host, broker.spec.probe_port, timeout)
+        if ok and not self._broker_healthy:
+            # broker just came back (e.g. SIGCONT after a SIGSTOP — no
+            # respawn involved): same resync grace as a restart
+            self._note_broker_recovered()
+        self._broker_healthy = ok
+        metrics.gauge_set("procsup.up",
+                          1 if (ok and broker.proc is not None
+                                and broker.proc.poll() is None) else 0,
+                          labels={"role": broker.spec.role})
+        if ok:
+            broker.last_heartbeat = now
+            broker.up_events.append(now)
+            del broker.up_events[:-64]
+        elif (broker.proc is not None and broker.proc.poll() is None
+              and broker.spec.heartbeat_timeout_s > 0
+              and now - max(broker.last_heartbeat,
+                            broker.started_at)
+              > broker.spec.heartbeat_timeout_s):
+            # alive by exit code, dead by probe: SIGSTOPped/deadlocked —
+            # kill it; the monitor restarts it over the persisted log
+            log.warning("procsup: broker %s unresponsive to PING; killing",
+                        broker.spec.role)
+            metrics.inc("procsup.hangs", labels={"role": broker.spec.role})
+            self._terminate(broker, sig=signal.SIGKILL)
+
+    @staticmethod
+    def _ping_once(host: str, port: int, timeout_s: float) -> bool:
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout_s) as s:
+                s.settimeout(timeout_s)
+                s.sendall(struct.pack("<IB", 1, OP_PING))
+                head = b""
+                while len(head) < 5:
+                    chunk = s.recv(5 - len(head))
+                    if not chunk:
+                        return False
+                    head += chunk
+                n, op = struct.unpack("<IB", head)
+                return op == OP_PONG
+        except OSError:
+            return False
+
+    # ------------------------------------------------- recovery measurement
+
+    async def wait_role_up(self, role: str, after: float,
+                           timeout_s: float = 60.0) -> float:
+        """Block until `role` shows a liveness confirmation (heartbeat or
+        broker-probe success) AFTER monotonic time `after`; returns that
+        confirmation's timestamp. The kill→serving-again measurement behind
+        `load_proc_recovery_s`."""
+        deadline = time.monotonic() + timeout_s
+        w = self.workers[role]
+        while time.monotonic() < deadline:
+            for ts in w.up_events:
+                if ts > after:
+                    return ts
+            await asyncio.sleep(0.05)
+        raise TimeoutError(
+            f"role {role!r} showed no liveness signal within {timeout_s}s "
+            f"of the kill (restarts={w.restarts})")
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def runner_spec(role: str, services: str, bus_url: str,
+                env: Optional[Dict[str, str]] = None,
+                heartbeat_s: float = 0.5,
+                heartbeat_timeout_s: float = 5.0) -> WorkerSpec:
+    """A WorkerSpec for one `python -m symbiont_tpu.runner` role."""
+    full_env = {
+        "SYMBIONT_BUS_URL": bus_url,
+        "SYMBIONT_RUNNER_SERVICES": services,
+        "SYMBIONT_RUNNER_ROLE": role,
+        "SYMBIONT_RUNNER_HEARTBEAT_S": str(heartbeat_s),
+        **(env or {}),
+    }
+    return WorkerSpec(role=role,
+                      argv=[sys.executable, "-m", "symbiont_tpu.runner"],
+                      env=full_env,
+                      heartbeat_timeout_s=heartbeat_timeout_s)
+
+
+def pybroker_spec(port: int, data_dir: str, role: str = "broker",
+                  heartbeat_timeout_s: float = 5.0) -> WorkerSpec:
+    """A WorkerSpec for the pure-Python broker (bus/pybroker.py)."""
+    return WorkerSpec(
+        role=role,
+        argv=[sys.executable, "-m", "symbiont_tpu.bus.pybroker",
+              "--host", "127.0.0.1", "--port", str(port),
+              "--data-dir", data_dir],
+        is_broker=True, probe_port=port,
+        heartbeat_timeout_s=heartbeat_timeout_s,
+        # a fresh broker replays its log in well under a second; restart
+        # fast so redelivery windows stay short
+        backoff_base_s=0.2, backoff_max_s=2.0)

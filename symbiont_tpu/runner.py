@@ -50,6 +50,7 @@ class SymbiontStack:
         self.graph_store = None
         self.api: Optional[ApiService] = None
         self.watchdog = None  # obs.watchdog.SloWatchdog when configured
+        self._heartbeat_task: Optional[asyncio.Task] = None
 
     KNOWN_SERVICES = {"all", "perception", "preprocessing", "vector_memory",
                       "knowledge_graph", "text_generator", "api", "engine"}
@@ -323,8 +324,44 @@ class SymbiontStack:
             log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
         else:
             log.info("symbiont stack up (no api): %s", sorted(want))
+        # process-failure plane: liveness heartbeats for the supervisor
+        # (resilience/procsup.py). Started LAST — a heartbeat promises the
+        # whole stack is placed and consuming, not just that python booted.
+        if cfg.runner.heartbeat_s > 0:
+            role = cfg.runner.role or "+".join(sorted(want))
+            self._heartbeat_task = asyncio.create_task(
+                self._heartbeat_loop(role, cfg.runner.heartbeat_s),
+                name="runner-heartbeat")
+
+    async def _heartbeat_loop(self, role: str, interval_s: float) -> None:
+        import json
+        import os
+
+        from symbiont_tpu.utils.telemetry import metrics
+
+        payload = json.dumps({"role": role, "pid": os.getpid()}).encode()
+        while True:
+            try:
+                await self.bus.publish(
+                    f"{subjects.SYS_HEARTBEAT}.{role}", payload)
+                metrics.inc("runner.heartbeats", labels={"role": role})
+            except ConnectionError:
+                # broker gap: the TcpBus send-gate already waited its
+                # bounded window; skip this beat and keep beating — the
+                # supervisor treats broker-down as "don't judge workers"
+                log.debug("heartbeat publish failed (bus disconnected)")
+            except RuntimeError:
+                return  # bus closed: stack is stopping
+            await asyncio.sleep(interval_s)
 
     async def stop(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._heartbeat_task = None
         if self.watchdog is not None:
             await self.watchdog.stop()
             self.watchdog = None

@@ -39,6 +39,7 @@ from symbiont_tpu.engine.batcher import MicroBatcher
 from symbiont_tpu.engine.engine import TpuEngine
 from symbiont_tpu.schema import TokenizedTextMessage, from_dict
 from symbiont_tpu.schema import frames
+from symbiont_tpu.resilience import admission
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.services.coalesce import (
     UpsertCoalescer,
@@ -246,7 +247,10 @@ class EngineService(Service):
             texts = req["texts"]
             if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
                 raise ValueError("texts must be a list of strings")
-            vecs = await self.batcher.embed(texts)
+            # fairness lane from the bus tenant header (native shells thread
+            # it verbatim via child_headers — common.hpp parity)
+            vecs = await self.batcher.embed(
+                texts, tenant=admission.tenant_of(msg.headers))
             encoding = req.get("encoding")
             if encoding in ("frame", "frame16"):
                 # zero-copy reply for frame-capable callers: the [n, dim]
@@ -290,7 +294,8 @@ class EngineService(Service):
             text = req["text"]
             if not isinstance(text, str):
                 raise ValueError("text must be a string")
-            vecs = await self.batcher.embed([text])
+            vecs = await self.batcher.embed(
+                [text], tenant=admission.tenant_of(msg.headers))
             return {"vector": np.asarray(vecs[0], np.float32).tolist(),
                     "model_name": self.engine.config.model_name}
         await self._handle(msg, "embed.query", op)
@@ -314,7 +319,8 @@ class EngineService(Service):
                 # shared micro-batcher: concurrent engine.generate callers
                 # decode as one batch with the bus-surface requests
                 text = await self.lm_batcher.generate(
-                    prompt, max_new, temperature=temperature, top_k=top_k)
+                    prompt, max_new, temperature=temperature, top_k=top_k,
+                    tenant=admission.tenant_of(msg.headers))
             else:
                 text = await self._run_blocking(
                     lambda: self.lm.generate(prompt, max_new,

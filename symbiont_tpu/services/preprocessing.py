@@ -37,6 +37,7 @@ from symbiont_tpu.schema import (
     to_json_bytes,
 )
 from symbiont_tpu.schema import frames
+from symbiont_tpu.resilience import admission
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.utils.ids import current_timestamp_ms
 from symbiont_tpu.utils.telemetry import child_headers, metrics
@@ -90,7 +91,11 @@ class PreprocessingService(Service):
             log.warning("cleaned text empty for id %s", raw.id)
             return
         sentences = split_sentences(cleaned)
-        vectors = await self.batcher.embed(sentences)
+        # engine-plane fairness: the tenant header threaded from the edge
+        # picks this document's lane in the micro-batcher — fairness holds
+        # even when the API edge's admission plane is bypassed or restarted
+        vectors = await self.batcher.embed(
+            sentences, tenant=admission.tenant_of(msg.headers))
         # engine output → wire without a single per-float Python conversion:
         # frame mode appends the [n, dim] f32 block to the JSON metadata
         # (schema/frames); fallback mode emits the reference wire shape
@@ -128,7 +133,9 @@ class PreprocessingService(Service):
             await self.bus.publish(msg.reply, to_json_bytes(err))
             return
         try:
-            vecs = await self.batcher.embed([task.text_to_embed])
+            vecs = await self.batcher.embed(
+                [task.text_to_embed],
+                tenant=admission.tenant_of(msg.headers))
             if frames.wants_frame(msg.headers):
                 # negotiated reply frame (X-Symbiont-Accept-Frame): the
                 # [1, dim] block rides appended to a schema-valid reply

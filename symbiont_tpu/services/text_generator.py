@@ -27,6 +27,7 @@ from symbiont_tpu.schema import (
     from_json,
     to_json_bytes,
 )
+from symbiont_tpu.resilience import admission
 from symbiont_tpu.services.base import Service
 from symbiont_tpu.utils.ids import current_timestamp_ms
 from symbiont_tpu.utils.telemetry import child_headers, metrics, span
@@ -301,11 +302,13 @@ class TextGeneratorService(Service):
                                                        cancel)
                 elif self.lm_batcher is not None:
                     # cancel frees the request's decode row at the next
-                    # chunk boundary (GenBatcher → BatchSession.cancel_tag)
+                    # chunk boundary (GenBatcher → BatchSession.cancel_tag);
+                    # the tenant header picks the fairness lane
                     text = await self.lm_batcher.generate(
                         task.prompt or "", task.max_length,
                         temperature=task.temperature, top_k=task.top_k,
-                        cancel=cancel)
+                        cancel=cancel,
+                        tenant=admission.tenant_of(msg.headers))
                 elif self.lm_generate is not None:
                     text = await asyncio.get_running_loop().run_in_executor(
                         None, lambda: self.lm_generate(

@@ -98,6 +98,12 @@ class VectorMemoryService(Service):
         await self._subscribe_loop(subjects.TASKS_SEARCH_SEMANTIC_REQUEST,
                                    self._handle_search,
                                    queue=subjects.QUEUE_VECTOR_MEMORY)
+        # operational count surface: a multi-process deployment's driver
+        # (bench/load.py --multiproc) verifies EXACT zero-loss ingest from
+        # outside this process through one request-reply hop
+        await self._subscribe_loop(subjects.TASKS_MEMORY_COUNT,
+                                   self._handle_count,
+                                   queue=subjects.QUEUE_VECTOR_MEMORY)
 
     def _store_upsert(self, ids, rows, payloads) -> int:
         return upsert_rows_or_points(self.store, ids, rows, payloads)
@@ -138,6 +144,23 @@ class VectorMemoryService(Service):
                     store_executor(), self._store_upsert, ids, m.rows,
                     payloads)
         metrics.inc("vector_memory.points_upserted", n)
+
+    async def _handle_count(self, msg: Msg) -> None:
+        import json as _json
+
+        if not msg.reply:
+            return
+        try:
+            # executor: an external-Qdrant count is a blocking HTTP call
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, self.store.count)
+            payload = {"count": int(n), "error_message": None}
+        except Exception as e:
+            log.exception("count failed")
+            payload = {"count": None, "error_message": str(e)}
+        await self.bus.publish(msg.reply,
+                               _json.dumps(payload).encode(),
+                               headers=child_headers(msg.headers))
 
     async def _handle_search(self, msg: Msg) -> None:
         if not msg.reply:

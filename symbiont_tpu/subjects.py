@@ -32,6 +32,14 @@ EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial"
 # its stream so a vanished reader can never pin a KV slot
 TASKS_GENERATION_CANCEL = "tasks.generation.cancel"
 
+# process-failure plane (resilience/procsup.py): every supervised runner
+# process publishes a liveness heartbeat under `_sys.heartbeat.<role>`; the
+# supervisor subscribes the wildcard and declares a worker HUNG (SIGKILL +
+# restart) when its heartbeats stall — the liveness signal a SIGSTOPped or
+# deadlocked process cannot fake, unlike an exit code. The `_` prefix keeps
+# heartbeats out of durable-stream capture by convention.
+SYS_HEARTBEAT = "_sys.heartbeat"
+
 # request-reply (query path)
 TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query"
 TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request"
@@ -40,6 +48,10 @@ TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request"
 # surface): token-overlap document lookup over the graph store, served by
 # knowledge_graph behind POST /api/search/graph
 TASKS_SEARCH_GRAPH_REQUEST = "tasks.search.graph.request"
+# vector-store point count (request-reply, served by vector_memory): the
+# operational surface a multi-process deployment needs to verify zero-loss
+# ingest from OUTSIDE the store-owning process (bench/load.py --multiproc)
+TASKS_MEMORY_COUNT = "tasks.memory.count"
 
 ALL_SUBJECTS = [
     TASKS_PERCEIVE_URL,

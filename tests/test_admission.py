@@ -1052,3 +1052,225 @@ def test_graph_search_end_to_end(tmp_path):
             await bus.close()
 
     asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Engine-plane tenant fairness (PR 10): the batcher's per-tenant lanes must
+# uphold the fairness guarantee WITHOUT any edge admission in front — the
+# exact scenario where a replicated/bypassed/restarted gateway would
+# otherwise re-create hot-tenant starvation at the device queue.
+# ---------------------------------------------------------------------------
+
+
+def _jain(xs):
+    xs = [float(x) for x in xs]
+    ssq = sum(x * x for x in xs)
+    return 0.0 if not ssq else (sum(xs) ** 2) / (len(xs) * ssq)
+
+
+class _SlowStubEngine:
+    """Duck-typed embed engine whose forward is slow enough that a backlog
+    forms — chunk composition (not engine speed) decides who gets served."""
+
+    class _ModelCfg:
+        hidden_size = 8
+
+    def __init__(self, delay_s=0.005):
+        from symbiont_tpu.config import EngineConfig
+
+        self.config = EngineConfig(embedding_dim=8, max_batch=4,
+                                   flush_deadline_ms=1.0)
+        self.model_cfg = self._ModelCfg()
+        self.delay_s = delay_s
+        self.served = []  # flush order, one entry per text
+
+    def embed_texts(self, texts):
+        import time as _t
+
+        _t.sleep(self.delay_s)
+        self.served.extend(texts)
+        return np.zeros((len(texts), 8), np.float32)
+
+
+def test_batcher_fairness_with_edge_admission_disabled():
+    """One ~10x hot tenant floods the micro-batcher DIRECTLY (no edge, no
+    quotas, no fair queue): per-tenant admitted throughput across the
+    backlog window must still be fair (Jain >= 0.8 over completion of the
+    normals' work), because TenantLanes interleaves lanes stride-fair
+    instead of FIFO-serving the hot tenant's head start."""
+    from symbiont_tpu.engine.batcher import MicroBatcher
+
+    engine = _SlowStubEngine()
+    normals = [f"t{i}" for i in range(4)]
+
+    async def scenario():
+        b = MicroBatcher(engine)
+        await b.start()
+        try:
+            # the hot tenant gets its whole flood queued FIRST — under the
+            # old FIFO every normal tenant would wait out all 60 items
+            hot = [asyncio.ensure_future(
+                b.embed([f"hot-{i}"], tenant="hot")) for i in range(60)]
+            waits = {}
+            t0 = asyncio.get_running_loop().time()
+
+            async def timed(tenant, i):
+                await b.embed([f"{tenant}-{i}"], tenant=tenant)
+                waits.setdefault(tenant, []).append(
+                    asyncio.get_running_loop().time() - t0)
+
+            normal_futs = [asyncio.ensure_future(timed(t, i))
+                           for t in normals for i in range(6)]
+            await asyncio.gather(*normal_futs)
+            # every normal tenant finished its 6 items while the hot flood
+            # was still draining — the FIFO order would have served all 60
+            # hot items first
+            remaining_hot = sum(1 for f in hot if not f.done())
+            assert remaining_hot > 0, (
+                "hot flood fully drained before the normals finished — "
+                "the lanes did not interleave")
+            admitted = {t: len(waits[t]) for t in normals}
+            admitted["hot"] = 60 - remaining_hot
+            jain = _jain(admitted.values())
+            assert jain >= 0.8, (jain, admitted)
+            await asyncio.gather(*hot)
+        finally:
+            await b.close()
+
+    asyncio.run(scenario())
+
+
+def test_tenant_lanes_stride_order_and_requeue():
+    from symbiont_tpu.engine.batcher import TenantLanes
+
+    class Item:
+        def __init__(self, tag, tenant):
+            self.tag, self.tenant = tag, tenant
+            self.future = None
+
+    lanes = TenantLanes(kind="test")
+    for i in range(4):
+        lanes.append(Item(f"a{i}", "a"))
+    for i in range(2):
+        lanes.append(Item(f"b{i}", "b"))
+    # stride order with equal weights: strict interleave while both lanes
+    # hold items, per-lane FIFO always
+    order = [it.tag for it in lanes.fair_order()]
+    assert order == ["a0", "b0", "a1", "b1", "a2", "a3"]
+    # iteration (the duck-typed deque surface) matches the fair order and
+    # consumes nothing
+    assert [it.tag for it in lanes] == order
+    assert len(lanes) == 6
+    # popleft serves exactly that order; peek always previews it
+    assert lanes.peek().tag == "a0"
+    got = [lanes.popleft().tag for _ in range(3)]
+    assert got == ["a0", "b0", "a1"]
+    # requeue_front returns items to their OWN lanes, ahead, in order
+    back = [it for it in lanes.fair_order()]
+    lanes.requeue_front([i for i in back if i.tenant == "a"][:1])
+    assert lanes.peek().tenant in ("a", "b")
+    assert len(lanes) == 4
+
+
+def test_tenant_lanes_bounded_reject_and_overflow_fold():
+    from symbiont_tpu.engine.batcher import TenantLanes
+    from symbiont_tpu.resilience.admission import (
+        OVERFLOW_TENANT,
+        AdmissionReject,
+    )
+
+    class Item:
+        def __init__(self, tenant):
+            self.tenant = tenant
+            self.future = None
+
+    lanes = TenantLanes(kind="test", max_per_tenant=2, max_lanes=3)
+    lanes.append(Item("a"))
+    lanes.append(Item("a"))
+    with pytest.raises(AdmissionReject) as ei:
+        lanes.append(Item("a"))  # lane full -> bounded, shed
+    assert ei.value.reason == "engine_lane_full"
+    # the identity bound is CUMULATIVE (resolve_tenant stance, and the
+    # default lane is pre-seeded like the edge's): max_lanes=3 means
+    # {default, a, b} — every identity AFTER that shares the overflow
+    # lane forever, so cycling fresh tenant names grows no clock state
+    # and no gauge label cardinality
+    lanes.append(Item("b"))
+    assert lanes._lane_key(Item("c")) == OVERFLOW_TENANT
+    lanes.append(Item("c"))
+    lanes.append(Item("fresh-1"))
+    assert lanes._lane_key(Item("fresh-2")) == OVERFLOW_TENANT
+    # overflow lane is bounded too
+    with pytest.raises(AdmissionReject):
+        lanes.append(Item("fresh-2"))
+    # ...and DRAINING everything retires the clock debt: a drained lane's
+    # entry is forgotten (≤ one grant past the floor), so the vtime book
+    # tracks live lanes, not every identity ever seen
+    while len(lanes):
+        lanes.popleft()
+    assert lanes._clock._vtime == {}
+
+
+def test_tenant_depth_gauge_tracks_lanes():
+    from symbiont_tpu.engine.batcher import TenantLanes
+    from symbiont_tpu.utils.telemetry import metrics
+
+    class Item:
+        def __init__(self, tenant):
+            self.tenant = tenant
+            self.future = None
+
+    lanes = TenantLanes(kind="gaugetest")
+    lanes.append(Item("gold"))
+    lanes.append(Item("gold"))
+    assert metrics.gauge_get("batcher.tenant_depth",
+                             labels={"batcher": "gaugetest",
+                                     "tenant": "gold"}) == 2
+    lanes.popleft()
+    assert metrics.gauge_get("batcher.tenant_depth",
+                             labels={"batcher": "gaugetest",
+                                     "tenant": "gold"}) == 1
+
+
+def test_gen_batcher_threads_tenant_and_stays_bounded():
+    """GenBatcher lanes: tenant kwarg lands items in their lanes and the
+    gen lane bound rejects with the typed AdmissionReject."""
+    from types import SimpleNamespace
+
+    from symbiont_tpu.engine.batcher import GenBatcher
+
+    class FakeLm:
+        config = SimpleNamespace(gen_max_batch=8, gen_flush_deadline_ms=1.0,
+                                 new_token_buckets=[16], temperature=1.0,
+                                 top_k=0, gen_tenant_lane_depth=2)
+
+    async def scenario():
+        b = GenBatcher(FakeLm())  # _run not started: queue-only test
+        futs = [asyncio.ensure_future(
+            b.generate("p", 4, tenant="flood")) for _ in range(2)]
+        await asyncio.sleep(0)  # let the submits land
+        with pytest.raises(AdmissionReject):
+            await b.generate("p", 4, tenant="flood")
+        assert len(b._queue) == 2
+        for f in futs:
+            f.cancel()
+
+    asyncio.run(scenario())
+
+
+def test_stride_clock_shared_between_edge_and_lanes():
+    """The edge fair queue and the batcher lanes run the SAME scheduler
+    class (StrideClock) — weight semantics cannot drift between planes."""
+    from symbiont_tpu.engine.batcher import TenantLanes
+    from symbiont_tpu.resilience.admission import StrideClock
+
+    clock = StrideClock({"gold": 2.0})
+    # gold (weight 2) gets two grants per free grant
+    grants = []
+    for _ in range(6):
+        t = clock.pick(["gold", "free"])
+        grants.append(t)
+        clock.charge(t)
+    assert grants.count("gold") == 4 and grants.count("free") == 2
+    lanes = TenantLanes(kind="wtest", weights={"gold": 2.0})
+    assert lanes._clock.weights == {"gold": 2.0}

@@ -1,0 +1,381 @@
+"""Process-failure plane: the pure-Python symbus broker (bus/pybroker.py —
+wire/log parity with native/symbus) and the ProcessSupervisor
+(resilience/procsup.py) that turns "resilient in one process" into
+"resilient as a deployment".
+
+The `-m chaos` scenarios spawn REAL OS processes and kill them with real
+signals (SIGKILL / SIGSTOP) — the same plan `scripts/multiproc.sh` and the
+`load_multiproc` bench tier run at full scale.
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _connect(port):
+    from symbiont_tpu.bus.tcp import TcpBus
+
+    bus = TcpBus("127.0.0.1", port)
+    await bus.connect()
+    return bus
+
+
+# ---------------------------------------------------------------- pybroker
+
+
+def test_pybroker_pub_sub_queue_groups_and_request_reply():
+    """The native broker's core semantics (test_tcp_bus.py's suite) hold
+    against the Python twin — same client, same wire, no g++ needed."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+
+    async def main():
+        broker = PyBroker(port=0)
+        await broker.start()
+        port = broker.bound_port
+        a, b, c = [await _connect(port) for _ in range(3)]
+        try:
+            # fanout + wildcard + headers
+            sub = await b.subscribe("greet.*")
+            await asyncio.sleep(0.05)
+            await a.publish("greet.world", "привет".encode(),
+                            headers={"X-Trace-Id": "t1"})
+            msg = await sub.next(2)
+            assert msg is not None
+            assert msg.subject == "greet.world"
+            assert msg.data.decode() == "привет"
+            assert msg.headers["X-Trace-Id"] == "t1"
+
+            # queue-group sharding: exactly-once across members
+            s1 = await b.subscribe("jobs", queue="workers")
+            s2 = await c.subscribe("jobs", queue="workers")
+            await asyncio.sleep(0.05)
+            for i in range(10):
+                await a.publish("jobs", str(i).encode())
+            got1 = got2 = 0
+            deadline = time.time() + 3
+            while got1 + got2 < 10 and time.time() < deadline:
+                got1 += (await s1.next(0.05)) is not None
+                got2 += (await s2.next(0.05)) is not None
+            assert got1 + got2 == 10
+            assert got1 > 0 and got2 > 0
+
+            # request-reply + timeout on an unserved subject
+            esub = await b.subscribe("svc.echo")
+
+            async def responder():
+                m = await esub.next(3)
+                await b.publish(m.reply, b"pong:" + m.data)
+
+            task = asyncio.create_task(responder())
+            reply = await a.request("svc.echo", b"ping", timeout=3)
+            assert reply.data == b"pong:ping"
+            await task
+            with pytest.raises(TimeoutError):
+                await a.request("svc.nobody", b"x", timeout=0.2)
+        finally:
+            for bus in (a, b, c):
+                await bus.close()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+def test_pybroker_durable_redelivery_filter_and_dead_letter():
+    """streams.hpp semantics in the Python twin: ack-after-durable,
+    redelivery after ack_wait, filter auto-ack, max_deliver counted
+    dead-lettered (drop), stream stats surface."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+
+    async def main():
+        broker = PyBroker(port=0)
+        await broker.start()
+        a = await _connect(broker.bound_port)
+        b = await _connect(broker.bound_port)
+        try:
+            await a.add_stream("s", ["data.>"], ack_wait_s=0.15,
+                               max_deliver=3)
+            d = await b.durable_subscribe("s", "g",
+                                          filter_subject="data.keep.*")
+            await a.publish("data.keep.1", b"keep")
+            await a.publish("data.skip", b"skip")  # outside the filter
+            m = await d.next(2)
+            assert m is not None and m.data == b"keep"
+            assert m.headers["X-Symbus-Deliveries"] == "1"
+            # unacked: redelivers with the attempt counted
+            m2 = await d.next(2)
+            assert m2 is not None and m2.headers["X-Symbus-Deliveries"] == "2"
+            m3 = await d.next(2)
+            assert m3 is not None and m3.headers["X-Symbus-Deliveries"] == "3"
+            # budget exhausted -> dead-lettered (counted, no more retries)
+            assert await d.next(0.5) is None
+            stats = await a.stream_stats()
+            g = stats["s"]["groups"]["g"]
+            assert g["dead_lettered"] == 1
+            # the filtered-out message was auto-acked: floor past BOTH
+            assert g["ack_floor"] == 2
+        finally:
+            await a.close()
+            await b.close()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+def test_pybroker_symlog_replay_preserves_unacked_work(tmp_path):
+    """An UNACKED captured message survives a broker stop/start over the
+    same --data-dir and redelivers to a re-attached consumer — the
+    streams.hpp .symlog contract, byte-format included, in Python."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path))
+        await broker.start()
+        a = await _connect(broker.bound_port)
+        await a.add_stream("p", ["work.>"], ack_wait_s=0.2, max_deliver=5)
+        d = await a.durable_subscribe("p", "g")
+        await a.publish("work.1", b"acked")
+        m = await d.next(2)
+        assert m is not None and m.data == b"acked"
+        await a.ack(m)
+        await a.publish("work.2", b"survivor")
+        m = await d.next(2)
+        assert m is not None and m.data == b"survivor"
+        # NOT acked: must come back after the restart
+        await a.close()
+        await broker.stop()
+
+        # the log is the real on-disk artifact (same format as native)
+        assert (tmp_path / "p.symlog").exists()
+
+        broker2 = PyBroker(port=0, data_dir=str(tmp_path))
+        await broker2.start()
+        b = await _connect(broker2.bound_port)
+        try:
+            d2 = await b.durable_subscribe("p", "g")
+            m = await d2.next(3)
+            assert m is not None and m.data == b"survivor", m
+            assert int(m.headers["X-Symbus-Seq"]) == 2
+            await b.ack(m)
+            # the acked message from before the restart never reappears
+            assert await d2.next(0.5) is None
+            stats = await b.stream_stats()
+            assert stats["p"]["groups"]["g"]["ack_floor"] == 2
+        finally:
+            await b.close()
+            await broker2.stop()
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------------- supervisor
+
+# a deliberately tiny heartbeat worker (no jax import): boots in ~a second,
+# beats every 0.15s — the supervisor's contract is exercised by signals,
+# not by what the worker computes
+_TOY_WORKER = """
+import asyncio, sys
+from symbiont_tpu.bus.connect import connect
+
+async def main():
+    # connect() retries the initial dial (worker and broker start
+    # concurrently under the supervisor)
+    bus = await connect("symbus://127.0.0.1:" + sys.argv[1])
+    while True:
+        await bus.publish("_sys.heartbeat." + sys.argv[2], b"{}")
+        await asyncio.sleep(0.15)
+
+asyncio.run(main())
+"""
+
+
+def _toy_spec(port: int, role: str, timeout_s: float = 2.0):
+    from symbiont_tpu.resilience.procsup import WorkerSpec
+
+    return WorkerSpec(
+        role=role,
+        argv=[sys.executable, "-c", _TOY_WORKER, str(port), role],
+        heartbeat_timeout_s=timeout_s, boot_grace_s=30.0,
+        backoff_base_s=0.1, backoff_max_s=1.0)
+
+
+@pytest.mark.chaos
+def test_supervisor_restarts_sigkilled_worker_and_detects_sigstop(tmp_path):
+    """The two kill classes the plan throws at workers: SIGKILL (exit-code
+    path) restarts with backoff; SIGSTOP (the hang no exit code reveals)
+    is detected via stalled heartbeats, SIGKILLed, and restarted. Recovery
+    is measured from supervisor liveness confirmations — the same
+    machinery behind `load_proc_recovery_s`."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL)
+        sup.add_worker(_toy_spec(port, "toy"))
+        await sup.start()
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("toy", after=t0 - 1, timeout_s=30)
+
+            # SIGKILL → monitor sees rc=-9 → restart
+            t_kill = time.monotonic()
+            os.kill(sup.pid("toy"), signal.SIGKILL)
+            ts = await sup.wait_role_up("toy", after=t_kill, timeout_s=30)
+            assert sup.restarts("toy") == 1
+            assert ts - t_kill < 15
+
+            # SIGSTOP → heartbeats stall → hang detector SIGKILLs → restart
+            t_stop = time.monotonic()
+            os.kill(sup.pid("toy"), signal.SIGSTOP)
+            ts = await sup.wait_role_up("toy", after=t_stop + 2.0,
+                                        timeout_s=30)
+            assert sup.restarts("toy") == 2
+            assert ts - t_stop < 20
+        finally:
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_supervisor_broker_death_is_survived_by_worker_judgment(tmp_path):
+    """Kill the BROKER under a supervised fleet: the supervisor must (1)
+    restart it, (2) NOT kill healthy workers for the heartbeat gap its
+    death caused (the broker-respawn grace), and (3) see worker heartbeats
+    resume through the restarted broker."""
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        pybroker_spec,
+    )
+
+    async def main():
+        port = _free_port()
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL)
+        sup.add_worker(pybroker_spec(port, str(tmp_path / "bus"),
+                                     heartbeat_timeout_s=2.0))
+        sup.add_worker(_toy_spec(port, "toy", timeout_s=3.0))
+        await sup.start()
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("toy", after=t0 - 1, timeout_s=30)
+            t_kill = time.monotonic()
+            os.kill(sup.pid("broker"), signal.SIGKILL)
+            await sup.wait_role_up("broker", after=t_kill, timeout_s=30)
+            # worker heartbeats resume over the restarted broker (its
+            # client auto-reconnects + re-SUBs)
+            await sup.wait_role_up("toy", after=t_kill + 0.5, timeout_s=30)
+            assert sup.restarts("broker") == 1
+            # the worker was never collateral damage
+            assert sup.restarts("toy") == 0
+        finally:
+            await sup.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_zero_loss_pipeline_across_worker_sigkill_multiproc(tmp_path):
+    """A miniature of the load_multiproc hard gate, cheap enough for the
+    chaos suite: durable publisher → consumer PROCESS that acks after
+    'storing', SIGKILLed mid-stream — every message lands exactly once
+    across the restart (redelivery + idempotent dedup by the consumer)."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor, WorkerSpec
+
+    consumer_src = """
+import asyncio, sys
+from pathlib import Path
+from symbiont_tpu.bus.tcp import TcpBus
+
+async def main():
+    out = Path(sys.argv[2])
+    bus = TcpBus("127.0.0.1", int(sys.argv[1]))
+    await bus.connect()
+    await bus.add_stream("w", ["job.>"], ack_wait_s=0.5, max_deliver=20)
+    sub = await bus.durable_subscribe("w", "g")
+    hb = asyncio.get_running_loop().create_task(beat(bus))
+    while True:
+        msg = await sub.next(1.0)
+        if msg is None:
+            continue
+        # idempotent append (dedup on read side); fsync BEFORE ack —
+        # the ack-after-durable contract under test
+        with open(out, "a") as f:
+            f.write(msg.data.decode() + "\\n")
+            f.flush()
+        await bus.ack(msg)
+
+async def beat(bus):
+    while True:
+        await bus.publish("_sys.heartbeat.consumer", b"{}")
+        await asyncio.sleep(0.15)
+
+asyncio.run(main())
+"""
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        out = tmp_path / "landed.txt"
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL)
+        sup.add_worker(WorkerSpec(
+            role="consumer",
+            argv=[sys.executable, "-c", consumer_src, str(port), str(out)],
+            heartbeat_timeout_s=3.0, backoff_base_s=0.1, backoff_max_s=1.0))
+        await sup.start()
+        pub = await _connect(port)
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("consumer", after=t0 - 1, timeout_s=30)
+            for i in range(10):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+            # let some land, then kill mid-stream
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if out.exists() and len(out.read_text().splitlines()) >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            t_kill = time.monotonic()
+            os.kill(sup.pid("consumer"), signal.SIGKILL)
+            for i in range(10, 20):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+            await sup.wait_role_up("consumer", after=t_kill, timeout_s=30)
+            deadline = time.monotonic() + 30
+            want = {f"m{i}" for i in range(20)}
+            got = set()
+            while time.monotonic() < deadline:
+                if out.exists():
+                    got = set(out.read_text().splitlines())
+                if want <= got:
+                    break
+                await asyncio.sleep(0.1)
+            assert want <= got, sorted(want - got)
+        finally:
+            await pub.close()
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())
