@@ -744,6 +744,10 @@ void serve_sse(int fd, const HttpRequest& req) {
 // (reference: nats_to_sse_listener, main.rs:215-270; streaming deltas are
 // this framework's addition and ride the same SSE channel)
 void sse_bridge() {
+  // fleet liveness rides the bridge's bus client: the supervisor's hang
+  // detector (and the /api/fleet roll-up) covers the C++ gateway exactly
+  // like the Python runners (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
   for (;;) {
     symbus::Client bus;
     if (!symbiont::connect_with_retry(bus, SERVICE)) return;
@@ -752,6 +756,7 @@ void sse_bridge() {
     g_ready.store(true);  // bus live + subscribed: safe to take data paths
     while (bus.connected()) {
       auto msg = bus.next(1000);
+      symbiont::maybe_heartbeat(bus, hb);
       if (!msg) continue;
       g_hub.broadcast(msg->data, g_cfg.sse_capacity);
       g_metrics.inc("api.sse_broadcast");

@@ -184,6 +184,66 @@ inline bool drop_if_expired(symbus::Client& bus, const symbus::BusMsg& msg,
   return true;
 }
 
+// ---- fleet liveness heartbeats (runner.py _heartbeat_loop parity) -------
+//
+// The process supervisor (symbiont_tpu/resilience/procsup.py) judges hang
+// liveness on `_sys.heartbeat.<role>` — the signal a SIGSTOPped or
+// deadlocked worker cannot fake. Python runners beat when
+// SYMBIONT_RUNNER_HEARTBEAT_S > 0; these helpers give the C++ shells the
+// SAME contract (subject + payload byte-parity pinned by
+// tests/test_fleet.py's stub-json harness, which compiles on GCC 10 — no
+// json.hpp, no float to_chars), so procsup hang-detection and the
+// GET /api/fleet roll-up cover native workers, not just Python ones.
+
+inline const char* SYS_HEARTBEAT = "_sys.heartbeat";
+
+inline std::string heartbeat_subject(const std::string& role) {
+  return std::string(SYS_HEARTBEAT) + "." + role;
+}
+
+inline std::string heartbeat_payload(const std::string& role) {
+  // byte-for-byte what the Python runner publishes:
+  // json.dumps({"role": role, "pid": os.getpid()})
+  std::string out = "{\"role\": \"";
+  for (char c : role) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    out.push_back(c);
+  }
+  out += "\", \"pid\": " + std::to_string((long)getpid()) + "}";
+  return out;
+}
+
+struct Heartbeat {
+  std::string role;
+  uint64_t interval_ms = 0;  // 0 = disabled (the default, like Python)
+  uint64_t last_ms = 0;
+};
+
+inline Heartbeat heartbeat_from_env(const std::string& default_role) {
+  Heartbeat hb;
+  hb.role = env_or("SYMBIONT_RUNNER_ROLE", default_role);
+  double s = std::atof(env_or("SYMBIONT_RUNNER_HEARTBEAT_S", "0").c_str());
+  if (s > 0) hb.interval_ms = (uint64_t)(s * 1000.0);
+  return hb;
+}
+
+// Call once per worker-loop iteration (the loops wake at least every
+// bus.next timeout): publishes at most once per interval, and a publish
+// failure is a skipped beat, never a crash — the supervisor treats a
+// missing beat as evidence, and a broker gap already suppresses hang
+// verdicts fleet-wide.
+inline void maybe_heartbeat(symbus::Client& bus, Heartbeat& hb) {
+  if (hb.interval_ms == 0) return;
+  uint64_t now = now_ms();
+  if (hb.last_ms != 0 && now - hb.last_ms < hb.interval_ms) return;
+  hb.last_ms = now;
+  try {
+    bus.publish(heartbeat_subject(hb.role), heartbeat_payload(hb.role));
+  } catch (const std::exception&) {
+    // skip this beat; the client reconnects on its own backoff
+  }
+}
+
 // Bus URL: symbus://host:port (nats:// accepted as a reference-era alias,
 // same stance as symbiont_tpu/bus/connect.py).
 struct BusAddr {

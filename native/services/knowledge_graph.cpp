@@ -45,8 +45,13 @@ int main() try {
                   symbiont::subjects::Q_KNOWLEDGE_GRAPH);
   symbiont::logline("INFO", SERVICE, durable ? "ready (durable)" : "ready");
 
+  // fleet liveness: beat `_sys.heartbeat.<role>` so the process supervisor's
+  // hang detector covers this shell (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
+
   while (bus.connected()) {
     auto msg = bus.next(1000);
+    symbiont::maybe_heartbeat(bus, hb);
     if (!msg) continue;
     // expired-deadline drop (Service._run_handler parity): acked, never
     // retried — a mid-pipeline worker must not burn graph writes on work

@@ -319,8 +319,13 @@ int main() try {
                                symbiont::subjects::Q_PERCEPTION);
   symbiont::logline("INFO", SERVICE, "ready");
 
+  // fleet liveness: beat `_sys.heartbeat.<role>` so the process supervisor's
+  // hang detector covers this shell (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
+
   while (bus.connected()) {
     auto msg = bus.next(1000);
+    symbiont::maybe_heartbeat(bus, hb);
     if (!msg || msg->sid != sid) continue;
     // expired-deadline drop (Service._run_handler parity). Ingest mints no
     // deadline by default (zero-loss invariant) — this only fires for a

@@ -239,8 +239,13 @@ int main() try {
     for (const auto& d : batch.docs) pending_ids.erase(d.raw.id);
   };
 
+  // fleet liveness: beat `_sys.heartbeat.<role>` so the process supervisor's
+  // hang detector covers this shell (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
+
   while (bus.connected()) {
     auto msg = bus.next(1000);
+    symbiont::maybe_heartbeat(bus, hb);
 
     // expired in-flight batches: drop (docs stay unacked → durable
     // redelivery after ack_wait; core mode loses them, same as before)

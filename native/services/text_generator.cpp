@@ -107,8 +107,13 @@ int main() try {
   symbiont::logline("INFO", SERVICE,
                     lm_backend ? "ready (backend=lm)" : "ready (backend=markov)");
 
+  // fleet liveness: beat `_sys.heartbeat.<role>` so the process supervisor's
+  // hang detector covers this shell (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
+
   while (bus.connected()) {
     auto msg = bus.next(1000);
+    symbiont::maybe_heartbeat(bus, hb);
     if (!msg) continue;
     if (sid_train != 0 && msg->sid == sid_train) {
       try {

@@ -225,8 +225,13 @@ int main() try {
                       batch.docs.front().headers);
   };
 
+  // fleet liveness: beat `_sys.heartbeat.<role>` so the process supervisor's
+  // hang detector covers this shell (SYMBIONT_RUNNER_HEARTBEAT_S > 0)
+  symbiont::Heartbeat hb = symbiont::heartbeat_from_env(SERVICE);
+
   while (bus.connected()) {
     auto msg = bus.next(1000);
+    symbiont::maybe_heartbeat(bus, hb);
 
     uint64_t now = symbiont::now_ms();
     for (auto it = inflight.begin(); it != inflight.end();) {

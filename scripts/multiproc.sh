@@ -5,7 +5,13 @@
 # SIGSTOPping another, and SIGKILLing the broker itself mid-run — hard
 # gates: exact zero-loss ingest, Jain fairness >= 0.8, zero final queue
 # depth, and the kill->serving-again recovery time archived as
-# `load_proc_recovery_s`.
+# `load_proc_recovery_s`. Since the fleet telemetry plane (obs/fleet.py)
+# the tier also hard-gates the observability story of that deployment:
+# every supervised role (procsup's own gauges and the broker probe
+# included) in ONE role-labeled /metrics exposition, and a client-carried
+# trace across >= 3 OS processes returned as a single stitched tree
+# (`load_mp_fleet_roles` / `load_mp_trace_stitched`; roll-up archived as
+# `fleet_snapshot`).
 #
 #   scripts/multiproc.sh                 # chaos scenarios + the bench tier
 #   scripts/multiproc.sh --tests-only    # just the pytest chaos scenarios

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Standing pre-merge perf gate (ROADMAP item 1's unlanded half, docs/PERF.md
+# "Methodology notes"): one command that exits nonzero on any PRIMARY metric
+# regression beyond the noise bars, wrapping the existing
+# `python bench.py --gate` machinery (symbiont_tpu/bench/archive.py —
+# per-metric thresholds = max(family floor, 1.5x the baseline's archived
+# in-run spread); tunnel-bound fields are never gated).
+#
+# Usage:
+#   scripts/perf_gate.sh                 # run the host-only micro-tiers
+#                                        # (--only obs,serialization: ~1 min,
+#                                        # no device, no engine compile) and
+#                                        # gate them against the quick
+#                                        # baseline
+#   scripts/perf_gate.sh CANDIDATE.json  # gate an existing archive line
+#                                        # (e.g. a fresh full-run
+#                                        # BENCH_LATEST) without re-running
+#
+# Baseline resolution:
+#   PERF_GATE_BASELINE env var when set; else, for the quick-run mode,
+#   BENCH_GATE_BASELINE.json (the committed quick-tier baseline — the full
+#   BENCH_LATEST.json predates the quick tiers' primaries, so the two
+#   declare disjoint metric sets and bench.py --gate would correctly refuse
+#   the vacuous comparison); else BENCH_LATEST.json. Candidate mode defaults
+#   to BENCH_LATEST.json (full archives compare like for like).
+#
+# Exit code: 0 = no regression; nonzero = regression, lost primary, schema
+# problem, or a red bench run. tests/test_perf_gate.py (-m gate) pins both
+# directions so this script cannot rot.
+set -u
+cd "$(dirname "$0")/.."
+
+CANDIDATE="${1:-}"
+if [ -n "$CANDIDATE" ]; then
+  BASELINE="${PERF_GATE_BASELINE:-BENCH_LATEST.json}"
+else
+  if [ -n "${PERF_GATE_BASELINE:-}" ]; then
+    BASELINE="$PERF_GATE_BASELINE"
+  elif [ -f BENCH_GATE_BASELINE.json ]; then
+    BASELINE="BENCH_GATE_BASELINE.json"
+  else
+    BASELINE="BENCH_LATEST.json"
+  fi
+  CANDIDATE="$(mktemp /tmp/perf_gate_candidate.XXXXXX.json)"
+  trap 'rm -f "$CANDIDATE"' EXIT
+  # --only never persists BENCH_LATEST.json (a partial line must not
+  # become the doc's source) — exactly right for a gate probe. The
+  # embed-policy tier is deliberately NOT in the default set: it needs a
+  # real device to be meaningful and takes minutes of CPU without one.
+  TIERS="${PERF_GATE_TIERS:-obs,serialization}"
+  echo "perf_gate: running host-only micro-tiers (bench.py --only $TIERS)" >&2
+  if ! python bench.py --only "$TIERS" ${PERF_GATE_ARGS:-} > "$CANDIDATE"; then
+    echo "perf_gate: bench run FAILED (tier failure or missing primary —" \
+         "see the line above); refusing to gate a red run" >&2
+    exit 1
+  fi
+fi
+
+echo "perf_gate: gating $CANDIDATE against $BASELINE" >&2
+exec python bench.py --gate "$CANDIDATE" "$BASELINE"

@@ -154,9 +154,11 @@ def validate_line(d: dict) -> List[str]:
                             for k, x in v.items())):
                 problems.append(f"{key}: expected an object of "
                                 "tier name -> skip reason strings")
-        elif key == "metrics_snapshot":
-            # internal-gauge snapshot from the e2e tier (obs subsystem):
-            # one flat string -> finite number object
+        elif key in ("metrics_snapshot", "fleet_snapshot"):
+            # internal-gauge snapshots (obs subsystem): metrics_snapshot
+            # from the e2e tier, fleet_snapshot from load_multiproc (the
+            # flattened per-role roll-up — obs/fleet.py rollup()); both
+            # are one flat string -> finite number object
             if not isinstance(v, dict):
                 problems.append(f"{key}: expected an object")
             else:

@@ -559,6 +559,16 @@ co-located.
   compares primary metrics with per-metric noise-aware thresholds (the
   larger of a family floor and 1.5× the baseline's archived in-run spread;
   tunnel-bound fields are never gated) — `symbiont_tpu/bench/archive.py`.
+- The gate is STANDING, not optional: `scripts/perf_gate.sh` is the
+  one-command pre-merge check — with no argument it re-measures the
+  host-only micro-tiers (`--only obs,serialization`, ~1 min, no device)
+  and gates them against the committed quick baseline
+  (`BENCH_GATE_BASELINE.json`; `PERF_GATE_BASELINE` overrides); with a
+  candidate archive argument it gates that line against
+  `BENCH_LATEST.json` directly. Exit code nonzero on any primary
+  regression beyond the noise bars, a lost declared primary, or a red
+  bench run. `tests/test_perf_gate.py` (`pytest -m gate`) pins both the
+  green and red directions so the script cannot rot.
 """
 
 

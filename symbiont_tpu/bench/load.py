@@ -542,7 +542,8 @@ MP_GENERATIONS = 4
 
 @register("load_multiproc", primary_metrics=(
         "load_proc_recovery_s", "load_mp_zero_loss_ingest",
-        "load_mp_fairness_jain"))
+        "load_mp_fairness_jain", "load_mp_fleet_roles",
+        "load_mp_trace_stitched"))
 def tier_load_multiproc(results: dict, ctx) -> None:
     import asyncio
 
@@ -630,6 +631,10 @@ async def _drive_multiproc(results: dict, load_seed: int,
         # spelling — SYMBIONT_<SECTION>_<FIELD>)
         common = {
             "JAX_PLATFORMS": "cpu",
+            # fleet telemetry plane (obs/fleet.py): every role publishes
+            # metric deltas + finished spans fast enough for the stitching
+            # assertions below to converge within the tier's poll budget
+            "SYMBIONT_OBS_FLEET_PUBLISH_S": "0.3",
             "SYMBIONT_BUS_DURABLE": "1",
             "SYMBIONT_BUS_DURABLE_ACK_WAIT_S": "1.0",
             "SYMBIONT_BUS_DURABLE_MAX_DELIVER": "10",
@@ -668,7 +673,8 @@ async def _drive_multiproc(results: dict, load_seed: int,
 
         log_path = f"{td}/workers.log"
         stdio = open(log_path, "ab")
-        sup = ProcessSupervisor(bus_url=bus_url, stdio=stdio)
+        sup = ProcessSupervisor(bus_url=bus_url, stdio=stdio,
+                                fleet_publish_s=0.3)
         sup.add_worker(pybroker_spec(broker_port, f"{td}/symbus",
                                      heartbeat_timeout_s=4.0))
         hb = dict(heartbeat_s=0.4, heartbeat_timeout_s=4.0)
@@ -956,6 +962,129 @@ async def _drive_multiproc(results: dict, load_seed: int,
                 _pct(sorted(gen_ms), 0.99), 1)
             log(f"multiproc generation: {MP_GENERATIONS} tasks through the "
                 f"restarted worker, p99 {results['load_mp_gen_p99_ms']}ms")
+
+            # ---- phase H: fleet telemetry — one exposition, one trace --
+            # The tentpole's proof (obs/fleet.py): every supervised role
+            # (the broker probe and procsup's own gauges included) must
+            # appear in ONE federated /metrics exposition with a role
+            # label, and a client-carried trace crossing >= 3 OS processes
+            # must come back from the gateway as a single stitched tree
+            # with non-null per-hop self-times.
+            trace_id = f"mp-fleet-{load_seed}"
+            status, body = await http(
+                "POST", "/api/search/semantic",
+                {"query_text": "symbiont fleet probe", "top_k": 2},
+                {"X-Symbiont-Tenant": "fleet",
+                 "X-Trace-Id": trace_id, "X-Span-Id": "mp-fleet-root"},
+                timeout=30)
+            assert status == 200, (status, body)
+            # spans federate on the 0.3s publish cadence: poll the gateway
+            # until the tree carries hops from the embed AND memory roles
+            # alongside the gateway's own api.search span
+            deadline = time.monotonic() + 45
+            tree, tree_roles = None, set()
+            while time.monotonic() < deadline:
+                status, tree = await http("GET", f"/api/traces/{trace_id}",
+                                          timeout=10)
+                if status == 200:
+                    tree_roles = set()
+
+                    def note_roles(node):
+                        tree_roles.add(
+                            node.get("fields", {}).get("role", "gateway"))
+                        for c in node.get("children", []):
+                            note_roles(c)
+
+                    for root in tree.get("roots", []):
+                        note_roles(root)
+                    if {"gateway", "embed", "memory"} <= tree_roles:
+                        break
+                await asyncio.sleep(0.3)
+            results["load_mp_trace_processes"] = float(len(tree_roles))
+            stitched = (tree is not None
+                        and {"gateway", "embed", "memory"} <= tree_roles
+                        and len(tree.get("roots", [])) == 1)
+            status, cp = await http(
+                "GET", f"/api/traces/{trace_id}/critical_path", timeout=10)
+            hop_self_ok = (status == 200 and cp.get("chain")
+                           and all(isinstance(h.get("self_ms"),
+                                              (int, float))
+                                   for h in cp["chain"]))
+            results["load_mp_trace_stitched"] = float(
+                bool(stitched and hop_self_ok))
+            log(f"multiproc fleet trace: {sorted(tree_roles)} roles on one "
+                f"tree (roots={len((tree or {}).get('roots', []))}), "
+                f"critical path verdict: {cp.get('verdict') if status == 200 else status}")
+            if not stitched:
+                raise RuntimeError(
+                    f"cross-process trace NOT stitched: roles {tree_roles} "
+                    f"roots {len((tree or {}).get('roots', []))} "
+                    f"(log {log_path})")
+            if not hop_self_ok:
+                raise RuntimeError(
+                    f"critical path over the stitched trace lacks per-hop "
+                    f"self-times: {cp}")
+
+            # federated exposition: every role label in ONE scrape
+            import re as _re
+
+            expected_roles = {"gateway", "perception", "embed", "memory",
+                              "graphgen", "procsup"}
+
+            def _scrape() -> str:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{api_port}/metrics",
+                        timeout=10) as r:
+                    return r.read().decode()
+
+            # anchor the role check to a series each role's OWN exporter
+            # produces (fleet.publishes) — a bare role="..." regex would
+            # also match procsup's target-role verdict labels and go green
+            # with every worker exporter dead
+            role_rx = _re.compile(
+                r'symbiont_fleet_publishes_total\{[^}]*role="([^"]+)"')
+            deadline = time.monotonic() + 30
+            seen_roles: set = set()
+            while time.monotonic() < deadline:
+                try:
+                    exposition = await loop.run_in_executor(client_pool,
+                                                            _scrape)
+                except OSError:
+                    await asyncio.sleep(0.3)
+                    continue
+                seen_roles = set(role_rx.findall(exposition))
+                if expected_roles <= seen_roles:
+                    break
+                await asyncio.sleep(0.3)
+            results["load_mp_fleet_roles"] = float(len(
+                expected_roles & seen_roles))
+            log(f"multiproc federated /metrics: roles {sorted(seen_roles)}")
+            if not expected_roles <= seen_roles:
+                raise RuntimeError(
+                    f"federated exposition missing roles: "
+                    f"{sorted(expected_roles - seen_roles)} "
+                    f"(saw {sorted(seen_roles)}; log {log_path})")
+
+            # the /api/fleet roll-up, archived as the run's fleet snapshot
+            # (per-role up / restarts / hangs / heartbeat age from procsup
+            # — the broker's PING-probe verdict included — plus telemetry
+            # freshness), flattened to the archive's string->number shape
+            status, fleet = await http("GET", "/api/fleet", timeout=10)
+            assert status == 200 and fleet.get("available"), fleet
+            snap: dict = {}
+            for role, e in fleet.get("roles", {}).items():
+                for stat in ("up", "restarts", "hangs", "heartbeat_age_s",
+                             "telemetry_age_s"):
+                    v = e.get(stat)
+                    if isinstance(v, (int, float)):
+                        snap[f"{role}.{stat}"] = float(v)
+            results["fleet_snapshot"] = snap
+            if snap.get("broker.up") != 1.0:
+                raise RuntimeError(
+                    f"fleet roll-up lost the broker probe verdict: {snap}")
+            log(f"multiproc fleet roll-up: {len(fleet['roles'])} roles, "
+                f"broker up={snap.get('broker.up')}, restarts total="
+                f"{sum(v for k, v in snap.items() if k.endswith('.restarts'))}")
 
             # ---- no unbounded queues anywhere --------------------------
             status, snap = await http("GET", "/api/metrics")

@@ -15,9 +15,14 @@ seeded synthetic load:
 - `obs_critical_path_512_ms` (primary, lower is better): one
   `trace_tree` + `critical_path` compute over a 512-span synthetic trace
   (8 services × 64 spans, fan-out 4), the `GET …/critical_path` endpoint's
-  whole cost at flight-recorder scale.
+  whole cost at flight-recorder scale;
+- `obs_fleet_merge_per_s` (primary, higher is better): FleetAggregator
+  merge throughput on a synthetic 5-role telemetry stream (alternating
+  metric-delta and span-batch messages, obs/fleet.py) — the aggregation
+  hot path every federated scrape and stitched trace rides in a
+  multi-process deployment.
 
-Both are median-of-5 with in-run min/max (host-CPU timings on the one
+All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
 spread).
 """
@@ -68,12 +73,65 @@ def build_synthetic_trace(store, trace_id: str = "obs-bench",
     return trace_id
 
 
+FLEET_ROLES = 5          # synthetic roles in the merge-throughput sample
+FLEET_MSGS = 200         # telemetry messages per sample (metrics + spans)
+FLEET_DELTA_KEYS = 64    # flat keys per metrics delta
+FLEET_SPAN_BATCH = 32    # spans per span-batch message
+
+
+def build_fleet_stream() -> list:
+    """A deterministic (subject, payload-bytes) telemetry stream shaped
+    like 5 busy roles: full snapshots first, then alternating metric
+    deltas and span batches. Pure arithmetic — no clocks, no randomness —
+    so every sample merges identical bytes."""
+    import json
+
+    from symbiont_tpu import subjects
+
+    msgs = []
+    roles = [f"r{i}" for i in range(FLEET_ROLES)]
+    for i, role in enumerate(roles):
+        full = {f"gauge.batcher.queue_depth{{batcher=\"b{k}\"}}": float(k)
+                for k in range(FLEET_DELTA_KEYS)}
+        msgs.append((f"{subjects.SYS_TELEMETRY_METRICS}.{role}",
+                     json.dumps({"role": role, "pid": 1000 + i, "seq": 1,
+                                 "full": True, "ts": 0.0,
+                                 "metrics": full}).encode()))
+    sid = 0
+    for n in range(FLEET_MSGS - FLEET_ROLES):
+        role = roles[n % FLEET_ROLES]
+        if n % 2 == 0:
+            delta = {f"gauge.batcher.queue_depth{{batcher=\"b{k}\"}}":
+                     float(n + k) for k in range(FLEET_DELTA_KEYS)}
+            msgs.append((f"{subjects.SYS_TELEMETRY_METRICS}.{role}",
+                         json.dumps({"role": role, "seq": n + 2,
+                                     "full": False, "ts": 0.0,
+                                     "metrics": delta}).encode()))
+        else:
+            spans = []
+            for k in range(FLEET_SPAN_BATCH):
+                sid += 1
+                spans.append({"trace_id": f"t{sid % 64}",
+                              "span_id": f"s{sid}",
+                              "parent_id": f"s{sid - 1}" if k else None,
+                              "name": f"{role}.handle",
+                              "start_ms": 1000.0 + sid,
+                              "duration_ms": 2.0, "status": "ok",
+                              "fields": {}})
+            msgs.append((f"{subjects.SYS_TELEMETRY_SPANS}.{role}",
+                         json.dumps({"role": role, "pid": 1000,
+                                     "ts": 0.0, "spans": spans}).encode()))
+    return msgs
+
+
 @register("obs", primary_metrics=("obs_span_record_per_s",
-                                  "obs_critical_path_512_ms"), quick=True)
+                                  "obs_critical_path_512_ms",
+                                  "obs_fleet_merge_per_s"), quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
+    from symbiont_tpu.obs.fleet import FleetAggregator
     from symbiont_tpu.obs.trace_store import TraceStore
-    from symbiont_tpu.utils.telemetry import span
+    from symbiont_tpu.utils.telemetry import Metrics, span
 
     # ---- span-exit throughput: the real global path (registry + ring +
     # log formatting), with the log handler muted so the sample measures
@@ -114,6 +172,26 @@ def tier_obs(results: dict, ctx) -> None:
     one_cp_ms()
     stats.record(results, "obs_critical_path_512_ms",
                  [one_cp_ms() for _ in range(REPEATS)], digits=2)
+
+    # ---- fleet-aggregator merge throughput on a synthetic 5-role stream
+    # (obs/fleet.py): the hot path every federated scrape and stitched
+    # cross-process trace rides. Private store + registry — the sample
+    # must not depend on (or pollute) the process-global plane.
+    stream = build_fleet_stream()
+
+    def one_merge_sample() -> float:
+        agg = FleetAggregator(local_role="bench",
+                              store=TraceStore(capacity=8192),
+                              registry=Metrics())
+        t0 = time.perf_counter()
+        for subject, payload in stream:
+            agg.handle(subject, payload)
+        return len(stream) / (time.perf_counter() - t0)
+
+    one_merge_sample()  # warm allocator / json paths
+    stats.record(results, "obs_fleet_merge_per_s",
+                 [one_merge_sample() for _ in range(REPEATS)], digits=0)
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -122,4 +200,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_span_record_per_s_max']:.0f}]; critical path over "
         f"{TRACE_SPANS} spans {results['obs_critical_path_512_ms']:.2f} ms "
         f"[{results['obs_critical_path_512_ms_min']:.2f}–"
-        f"{results['obs_critical_path_512_ms_max']:.2f}]")
+        f"{results['obs_critical_path_512_ms_max']:.2f}]; fleet merge "
+        f"{results['obs_fleet_merge_per_s']:.0f} msg/s "
+        f"[{results['obs_fleet_merge_per_s_min']:.0f}–"
+        f"{results['obs_fleet_merge_per_s_max']:.0f}]")

@@ -362,12 +362,46 @@ class ObsConfig:
     # telemetry.DEFAULT_BUCKET_BOUNDS_MS. Applied by the runner at boot —
     # bounds are fixed per histogram at first observation.
     histogram_buckets_ms: List[float] = field(default_factory=list)
+    # Fleet telemetry plane (obs/fleet.py, docs/OBSERVABILITY.md "Fleet
+    # telemetry"): when this process runs as a named role in a supervised
+    # multi-process deployment (runner.role set, or heartbeats on), it
+    # publishes bounded metric-snapshot deltas + completed span records on
+    # `_sys.telemetry.{metrics,spans}.<role>` every fleet_publish_s; the
+    # API-role process hosts the FleetAggregator that merges them into one
+    # federated /metrics exposition (role label), stitched cross-process
+    # traces, and GET /api/fleet. Telemetry is SAMPLED under backpressure
+    # and dropped-with-a-counter, never queued unboundedly — it must not
+    # compete with the data path.
+    fleet_export: bool = True
+    fleet_publish_s: float = 2.0
+    # spans carried per publish; the pending ring holds fleet_pending_max
+    # finished spans between publishes (overflow counted in
+    # fleet.spans_dropped — sampling, not queueing)
+    fleet_spans_max: int = 256
+    fleet_pending_max: int = 2048
+    # metric delta entries per publish (overflow counted + retried next
+    # round via the delta mechanism itself)
+    fleet_metrics_max: int = 4096
+    # every Nth metrics publish is a FULL snapshot (a late-joining
+    # aggregator converges within full_every x publish_s)
+    fleet_full_every: int = 15
+    # distinct roles the aggregator tracks; past the bound new roles are
+    # counted in fleet.role_overflow and ignored (client-suppliable role
+    # names must not grow unbounded state)
+    fleet_roles_max: int = 64
 
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
             raise ValueError("obs.trace_capacity must be >= 1")
         if self.slo_interval_s <= 0:
             raise ValueError("obs.slo_interval_s must be positive")
+        if self.fleet_publish_s <= 0:
+            raise ValueError("obs.fleet_publish_s must be positive")
+        for name in ("fleet_spans_max", "fleet_pending_max",
+                     "fleet_metrics_max", "fleet_full_every",
+                     "fleet_roles_max"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"obs.{name} must be >= 1")
         if self.histogram_buckets_ms:
             b = self.histogram_buckets_ms
             if any(x <= 0 for x in b) or list(b) != sorted(set(b)):

@@ -5,8 +5,15 @@ the 400 ms hole between two hops. This module renders any recorded trace as
 Chrome Trace Format JSON (the "JSON Array/Object format" both
 chrome://tracing and https://ui.perfetto.dev open directly):
 
-- one track (pid 1, one tid) per SERVICE — the first dot-segment of the
-  span name, same convention the Prometheus service label uses;
+- one PROCESS lane per role: spans stitched across OS processes by the
+  fleet telemetry plane (obs/fleet.py) carry ``role``/``pid`` fields — each
+  role renders as its own pid with a ``process_name`` metadata event, so a
+  multi-process trace shows separate Perfetto process tracks instead of
+  collapsing every service into threads of one fake process. Spans without
+  role metadata (a single-process recording) keep the historical lane
+  (pid 1, "symbiont flight recorder") byte-for-byte;
+- one track (tid) per SERVICE within each process — the first dot-segment
+  of the span name, same convention the Prometheus service label uses;
 - every span is a complete event (``ph: "X"``, microsecond ``ts``/``dur``)
   carrying span/parent/trace ids and the span's recorded fields in
   ``args``;
@@ -19,47 +26,93 @@ Served at ``GET /api/traces/<id>/export?fmt=chrome`` (services/api.py);
 is pinned by a golden file (tests/goldens/chrome_trace_golden.json) — a
 format drift breaks the golden test, not an operator's tooling.
 
-Determinism contract (what the golden test relies on): events are ordered
-metadata first (process name, then thread names in tid order), then spans
-by (ts, span_id); tids are assigned to services in first-seen span-start
-order. No clocks, no randomness — the export is a pure function of the
-recorded spans.
+Determinism contract (what the golden test relies on): processes are
+ordered first-seen (by span start; the local lane uses pid 1), and within
+each process events are metadata first (process name, then thread names in
+tid order), then all spans by (ts, span_id); tids are assigned to services
+in first-seen span-start order within their process. When a role carries
+no OS pid, a synthetic pid is assigned in first-seen order from 100001.
+No clocks, no randomness — the export is a pure function of the recorded
+spans.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 from symbiont_tpu.obs.trace_store import SpanRecord
 
 _PID = 1
+_LOCAL_PROCESS_NAME = "symbiont flight recorder"
+_SYNTHETIC_PID_BASE = 100000
 
 
 def service_of(span_name: str) -> str:
     return span_name.split(".", 1)[0]
 
 
+def _lane_of(r: SpanRecord, synthetic: Dict[str, int],
+             assigned: Dict[int, str]) -> Tuple[int, str]:
+    """(pid, process_name) for one span. Local spans (no role field) keep
+    the historical single-process lane; stitched remote spans get one lane
+    per role, keyed on the origin's real pid when the telemetry carried it
+    — UNLESS that pid collides with the local lane (a containerized worker
+    runs as PID 1) or with another role's already-claimed pid, in which
+    case the role falls back to its deterministic synthetic pid: lanes
+    must never merge two processes into one flapping track."""
+    role = (r.fields or {}).get("role")
+    if not isinstance(role, str) or not role:
+        return _PID, _LOCAL_PROCESS_NAME
+
+    def synth() -> int:
+        if role not in synthetic:
+            synthetic[role] = _SYNTHETIC_PID_BASE + len(synthetic) + 1
+        return synthetic[role]
+
+    pid = (r.fields or {}).get("pid")
+    if isinstance(pid, (int, float)) and not isinstance(pid, bool) \
+            and int(pid) > 0 and int(pid) != _PID \
+            and assigned.setdefault(int(pid), role) == role:
+        return int(pid), role
+    return synth(), role
+
+
 def export_spans(trace_id: str, spans: Sequence[SpanRecord]) -> dict:
     """Render one trace's SpanRecords as a Chrome Trace Format object."""
     ordered = sorted(spans, key=lambda r: (r.start_s, r.span_id))
-    tids: Dict[str, int] = {}
+    synthetic: Dict[str, int] = {}
+    assigned: Dict[int, str] = {}  # real pid → the role that claimed it
+    # processes in first-seen order; per-process service → tid tables
+    proc_order: List[Tuple[int, str]] = []
+    tids: Dict[Tuple[int, str], int] = {}
+    lanes: List[Tuple[int, str]] = []
     for r in ordered:
-        tids.setdefault(service_of(r.name), len(tids) + 1)
+        lane = _lane_of(r, synthetic, assigned)
+        lanes.append(lane)
+        if lane not in proc_order:
+            proc_order.append(lane)
+        key = (lane[0], service_of(r.name))
+        if key not in tids:
+            tids[key] = sum(1 for (p, _s) in tids if p == lane[0]) + 1
 
-    events: List[dict] = [{
-        "ph": "M", "name": "process_name", "pid": _PID,
-        "args": {"name": "symbiont flight recorder"},
-    }]
-    for svc, tid in sorted(tids.items(), key=lambda kv: kv[1]):
-        events.append({"ph": "M", "name": "thread_name", "pid": _PID,
-                       "tid": tid, "args": {"name": svc}})
-    for r in ordered:
+    events: List[dict] = []
+    for pid, pname in proc_order:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": pname},
+        })
+        threads = sorted(((svc, tid) for (p, svc), tid in tids.items()
+                          if p == pid), key=lambda kv: kv[1])
+        for svc, tid in threads:
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": svc}})
+    for r, (pid, _pname) in zip(ordered, lanes):
         ev = {
             "ph": "X",
             "name": r.name,
             "cat": service_of(r.name),
-            "pid": _PID,
-            "tid": tids[service_of(r.name)],
+            "pid": pid,
+            "tid": tids[(pid, service_of(r.name))],
             "ts": round(r.start_s * 1e6, 1),       # µs, Chrome's unit
             "dur": round(r.duration_ms * 1e3, 1),  # µs
             "args": {

@@ -166,14 +166,31 @@ CONTENT_TYPE_OPENMETRICS = ("application/openmetrics-text; version=1.0.0; "
 
 
 def render(registry: Optional[Metrics] = None,
-           openmetrics: bool = False) -> str:
+           openmetrics: bool = False,
+           extra_labels: Optional[Dict[str, str]] = None) -> str:
     """Render the registry as Prometheus text exposition. With
     ``openmetrics=True``, histogram bucket samples carry exemplars and the
     output terminates with ``# EOF`` (serve it under
     CONTENT_TYPE_OPENMETRICS; the family naming stays shared between the
-    two renderings)."""
-    ex = (registry or _global_metrics).export()
+    two renderings). ``extra_labels`` (the fleet plane's ``role`` label)
+    merge under every sample's own labels — an explicitly-carried label of
+    the same name wins, so ``procsup.up{role="broker"}`` keeps naming its
+    TARGET role."""
     families: Dict[str, _Family] = {}
+    _render_registry_into(families, registry or _global_metrics,
+                          openmetrics, extra_labels)
+    return _format_families(families, openmetrics)
+
+
+def _render_registry_into(families: Dict[str, "_Family"],
+                          registry: Metrics, openmetrics: bool,
+                          extra_labels: Optional[Dict[str, str]] = None
+                          ) -> None:
+    ex = registry.export()
+    if extra_labels:
+        for kind in ("counters", "gauges", "histograms"):
+            ex[kind] = [(n, {**extra_labels, **lb}, v)
+                        for n, lb, v in ex[kind]]
 
     # OpenMetrics counter naming: the FAMILY (TYPE/HELP) name must not end
     # in the reserved `_total` suffix — samples carry it, the family does
@@ -254,6 +271,9 @@ def render(registry: Optional[Metrics] = None,
             gfam.samples.append(f"{gfam.name}{_fmt_labels(labels)} "
                                 f"{_fmt_value(summary[stat])}")
 
+
+def _format_families(families: Dict[str, "_Family"],
+                     openmetrics: bool) -> str:
     lines: List[str] = []
     for fam_name in sorted(families):
         fam = families[fam_name]
@@ -263,3 +283,141 @@ def render(registry: Optional[Metrics] = None,
     if openmetrics:
         lines.append("# EOF")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------- fleet federation render
+
+# flat-snapshot keys look like `counter.bus.consumed{service="api"}` /
+# `gauge.mesh.devices{axis="data"}` / `hist.span.api.search.ms.p99` —
+# the rendered-key format telemetry.Metrics.flat_snapshot emits
+_FLAT_KEY = re.compile(
+    r"^(counter|gauge|hist)\.([^{]+?)(\{.*\})?(?:\.(count|p50|p99|min|max))?$")
+_FLAT_LABEL = re.compile(r'([a-zA-Z0-9_]+)="((?:[^"\\]|\\.)*)"')
+# hist flat keys carry the stat OUTSIDE the label braces:
+# hist.<name>{labels}.p99 — and without labels: hist.<name>.p99
+_HIST_STATS = ("count", "p50", "p99", "min", "max")
+_HIST_QUANTILE = {"p50": "0.5", "p99": "0.99"}
+
+
+def parse_flat_key(key: str):
+    """One flat-snapshot key → (kind, raw_name, labels, stat|None); None
+    for a key this renderer cannot place (malformed keys are skipped, not
+    crashed on — a remote role's snapshot must never fail the scrape)."""
+    m = _FLAT_KEY.match(key)
+    if not m:
+        return None
+    kind, name, lbl, stat = m.group(1), m.group(2), m.group(3), m.group(4)
+    if kind == "hist" and stat is None:
+        # unlabeled hist key: the stat rode into the name capture
+        name, dot, tail = name.rpartition(".")
+        if dot and tail in _HIST_STATS:
+            stat = tail
+        else:
+            return None
+    if kind != "hist" and stat is not None:
+        # a counter/gauge whose NAME ends in `.p99` etc: keep it whole
+        name = f"{name}.{stat}"
+        stat = None
+    labels = dict(_FLAT_LABEL.findall(lbl)) if lbl else {}
+    return kind, name.strip("."), labels, stat
+
+
+def _locally_synthesized(kind: str, raw: str) -> bool:
+    """Families the AGGREGATOR itself produces per-role in the local
+    registry — remote span durations observed as `span.<name>.ms{role=}`
+    histograms, and the per-role SLO judgments over them (`slo.p99_ms` /
+    `slo.breaches`). The remote snapshot carries its own copy of each;
+    merging both would emit DUPLICATE series under one label set, and a
+    real Prometheus scraper rejects the whole exposition on the first
+    duplicate sample — so the local synthesis (richer: real `le` buckets,
+    exemplars, watchdog-fed) is the one source and the snapshot copy is
+    skipped."""
+    if kind == "hist":
+        sp = _span_series(raw)
+        if sp is not None and sp[0] == "ms":
+            return True
+    return raw in ("slo.p99_ms", "slo.breaches")
+
+
+def _merge_flat_role_into(families: Dict[str, "_Family"], role: str,
+                          flat: Dict[str, float],
+                          openmetrics: bool) -> None:
+    """Merge one remote role's flat metric snapshot (obs/fleet.py payload)
+    into the family table, under a `role` label. Counters and gauges keep
+    their exact local family names (fleet p99s come from the histogram
+    `_bucket` families only when scraped per process; federated summary
+    STATS render into the same summary/`_min`/`_max` families the local
+    process uses — honest per-role stats, never cross-role math). Span
+    durations and SLO series are deliberately NOT merged from snapshots —
+    the aggregator synthesizes them per role locally (see
+    _locally_synthesized; merging both halves would duplicate series)."""
+    for key in sorted(flat):
+        parsed = parse_flat_key(key)
+        if parsed is None:
+            continue
+        kind, raw, labels, stat = parsed
+        if _locally_synthesized(kind, raw):
+            continue
+        value = flat[key]
+        labels = {"role": role, **labels}
+        if kind == "counter":
+            sp = _span_series(raw)
+            if sp is not None and sp[0] == "errors":
+                base, labels = "span_errors", _span_labels(sp[1], labels)
+            else:
+                base, labels = _split_legacy(raw, labels)
+            sample_name = _metric_name(base) + "_total"
+            fam = _family(families,
+                          _metric_name(base) if openmetrics else sample_name,
+                          "counter", f"Counter {raw}.")
+            fam.samples.append(f"{sample_name}{_fmt_labels(labels)} "
+                               f"{_fmt_value(value)}")
+        elif kind == "gauge":
+            base, labels = _split_legacy(raw, labels)
+            fam = _family(families, _metric_name(base), "gauge",
+                          f"Gauge {raw}.")
+            fam.samples.append(f"{fam.name}{_fmt_labels(labels)} "
+                               f"{_fmt_value(value)}")
+        else:  # hist stat
+            sp = _span_series(raw)
+            if sp is not None and sp[0] == "ms":
+                base, labels = "span_duration_ms", _span_labels(sp[1], labels)
+                help_text = "Span duration in milliseconds by span name."
+            else:
+                base, labels = _split_legacy(raw, labels)
+                help_text = f"Distribution of {raw}."
+            if stat in _HIST_QUANTILE:
+                fam = _family(families, _metric_name(base), "summary",
+                              help_text)
+                qlabels = {**labels, "quantile": _HIST_QUANTILE[stat]}
+                fam.samples.append(f"{fam.name}{_fmt_labels(qlabels)} "
+                                   f"{_fmt_value(value)}")
+            elif stat == "count":
+                fam = _family(families, _metric_name(base), "summary",
+                              help_text)
+                fam.samples.append(f"{fam.name}_count{_fmt_labels(labels)} "
+                                   f"{_fmt_value(value)}")
+            else:  # min / max → the exact-extreme gauge companions
+                gfam = _family(families, _metric_name(base, f"_{stat}"),
+                               "gauge", f"Exact running {stat} of {raw}.")
+                gfam.samples.append(f"{gfam.name}{_fmt_labels(labels)} "
+                                    f"{_fmt_value(value)}")
+
+
+def render_fleet(local_role: str,
+                 role_snapshots: Dict[str, Dict[str, float]],
+                 registry: Optional[Metrics] = None,
+                 openmetrics: bool = False) -> str:
+    """The federated exposition (obs/fleet.py): the LOCAL registry rendered
+    with `role=<local_role>` merged under every sample, plus every remote
+    role's flat snapshot in the SAME family table — one scrape shows the
+    whole deployment, each series labeled with the role that produced it."""
+    families: Dict[str, _Family] = {}
+    _render_registry_into(families, registry or _global_metrics, openmetrics,
+                          extra_labels={"role": local_role})
+    for role in sorted(role_snapshots):
+        if role == local_role:
+            continue  # the local registry is already the fresher view
+        _merge_flat_role_into(families, role, role_snapshots[role],
+                              openmetrics)
+    return _format_families(families, openmetrics)

@@ -61,6 +61,23 @@ class TraceStore:
     def __init__(self, capacity: int = 4096):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        # taps: fn(SpanRecord) called on every record() AFTER the ring
+        # append, outside the lock (a tap may touch the metrics registry;
+        # holding our lock across foreign locks invites ordering
+        # deadlocks). The fleet exporter (obs/fleet.py) taps here to ship
+        # finished spans to the aggregator; a tap that raises is dropped
+        # from this record only, never unregistered.
+        self._taps: list = []
+
+    def add_tap(self, fn) -> None:
+        with self._lock:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
 
     @property
     def capacity(self) -> int:
@@ -75,6 +92,13 @@ class TraceStore:
     def record(self, rec: SpanRecord) -> None:
         with self._lock:
             self._ring.append(rec)
+            taps = list(self._taps) if self._taps else None
+        if taps:
+            for fn in taps:
+                try:
+                    fn(rec)
+                except Exception:
+                    pass  # a broken tap must never break span recording
 
     def clear(self) -> None:
         with self._lock:

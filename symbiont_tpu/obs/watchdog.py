@@ -59,9 +59,10 @@ class SloWatchdog:
         self.registry = registry or _global_metrics
         self.events: deque = deque(maxlen=max_events)
         self._task: Optional[asyncio.Task] = None
-        # observation count at the last evaluation, per span: an idle span
-        # must not re-alert every interval off the same old samples
-        self._seen_counts: Dict[str, int] = {}
+        # observation count at the last evaluation, per (span, label
+        # variant): an idle span must not re-alert every interval off the
+        # same old samples
+        self._seen_counts: Dict[tuple, int] = {}
         # pass listeners: fn(breaches) called at the END of every
         # evaluation — with the empty list too, which is what lets the
         # admission shed ladder (resilience/admission.DegradationLadder)
@@ -89,29 +90,41 @@ class SloWatchdog:
         upgrade path if it bites."""
         breaches: List[dict] = []
         for span_name, limit_ms in self.thresholds.items():
-            summary = self.registry.histogram_summary(f"span.{span_name}.ms")
-            if summary is None or not summary["count"]:
-                continue  # span never ran: nothing to judge
-            if summary["count"] == self._seen_counts.get(span_name):
-                continue  # idle since last pass: no fresh evidence to judge
-            self._seen_counts[span_name] = summary["count"]
-            p99 = summary["p99"]
-            self.registry.gauge_set("slo.p99_ms", p99,
-                                    labels={"span": span_name})
-            if p99 <= limit_ms:
-                continue
-            event = {
-                "event": "slo_breach",
-                "span": span_name,
-                "p99_ms": round(p99, 3),
-                "threshold_ms": limit_ms,
-                "count": summary["count"],
-                "ts": time.time(),
-            }
-            self.registry.inc("slo.breaches", labels={"span": span_name})
-            self.events.append(event)
-            breaches.append(event)
-            log.warning(json.dumps(event, ensure_ascii=False))
+            # every labeled variant is judged separately: the fleet plane
+            # (obs/fleet.py) federates remote roles' span durations as
+            # `span.<name>.ms{role=...}` histograms, and a breach in ONE
+            # role must not hide inside a fleet-wide blend — the unlabeled
+            # local series stays variant () and behaves exactly as before
+            variants = self.registry.histogram_summaries(
+                f"span.{span_name}.ms")
+            for labels, summary in variants:
+                if not summary["count"]:
+                    continue  # span never ran: nothing to judge
+                seen_key = (span_name,
+                            tuple(sorted(labels.items())))
+                if summary["count"] == self._seen_counts.get(seen_key):
+                    continue  # idle since last pass: no fresh evidence
+                self._seen_counts[seen_key] = summary["count"]
+                p99 = summary["p99"]
+                self.registry.gauge_set("slo.p99_ms", p99,
+                                        labels={"span": span_name, **labels})
+                if p99 <= limit_ms:
+                    continue
+                event = {
+                    "event": "slo_breach",
+                    "span": span_name,
+                    "p99_ms": round(p99, 3),
+                    "threshold_ms": limit_ms,
+                    "count": summary["count"],
+                    "ts": time.time(),
+                }
+                if labels:
+                    event["labels"] = dict(labels)
+                self.registry.inc("slo.breaches",
+                                  labels={"span": span_name, **labels})
+                self.events.append(event)
+                breaches.append(event)
+                log.warning(json.dumps(event, ensure_ascii=False))
         for fn in list(self.listeners):
             try:
                 fn(breaches)

@@ -108,7 +108,8 @@ class ProcessSupervisor:
     """
 
     def __init__(self, bus_url: str = "", heartbeat_poll_s: float = 0.25,
-                 stdio=None):
+                 stdio=None, fleet_telemetry: bool = True,
+                 fleet_publish_s: float = 2.0):
         self.bus_url = bus_url
         self.heartbeat_poll_s = heartbeat_poll_s
         self.workers: Dict[str, _Worker] = {}
@@ -116,6 +117,17 @@ class ProcessSupervisor:
         self._hb_task: Optional[asyncio.Task] = None
         self._mon_task: Optional[asyncio.Task] = None
         self._stopping = False
+        # fleet telemetry plane (obs/fleet.py): the supervisor's own
+        # `procsup.*` gauges live in a process with no HTTP server — an
+        # exporter publishes them under role "procsup" so the API-role
+        # aggregator federates them (the /api/fleet roll-up folds the
+        # per-role up/restarts/hangs verdicts, broker probe included, into
+        # each supervised role's entry); the supervisor also hosts its OWN
+        # aggregator so `sup.fleet.rollup()` answers without any HTTP hop.
+        self.fleet_telemetry = fleet_telemetry
+        self.fleet_publish_s = fleet_publish_s
+        self.fleet = None           # FleetAggregator once the bus is up
+        self._fleet_exporter = None
         self._broker_healthy = True
         self._last_probe = 0.0
         # after the broker (re)covers, worker clients reconnect on THEIR
@@ -150,6 +162,12 @@ class ProcessSupervisor:
         self._stopping = True
         for w in self.workers.values():
             w.stopping = True
+        if self._fleet_exporter is not None:
+            await self._fleet_exporter.stop()
+            self._fleet_exporter = None
+        if self.fleet is not None:
+            await self.fleet.detach()
+            self.fleet = None
         if self._hb_task:
             self._hb_task.cancel()
             self._hb_task = None
@@ -308,6 +326,8 @@ class ProcessSupervisor:
                     self._bus = await connect(self.bus_url, retries=1)
                     sub = await self._bus.subscribe(
                         subjects.SYS_HEARTBEAT + ".>")
+                    if self.fleet_telemetry:
+                        await self._start_fleet_telemetry()
                 except (ConnectionError, OSError):
                     self._bus = None
                     await asyncio.sleep(self.heartbeat_poll_s)
@@ -322,6 +342,25 @@ class ProcessSupervisor:
                     w.up_events.append(now)
                     del w.up_events[:-64]
             await self._probe_broker()
+
+    async def _start_fleet_telemetry(self) -> None:
+        """Attach the supervisor's fleet aggregator to the (re)connected
+        bus and start its own `procsup`-role exporter (once — the exporter
+        reads the live bus through a closure, so reconnects are free)."""
+        from symbiont_tpu.obs.fleet import (
+            FleetAggregator,
+            TelemetryExporter,
+            subscribe_telemetry,
+        )
+
+        if self.fleet is None:
+            self.fleet = FleetAggregator(local_role="procsup")
+        self.fleet.attach(await subscribe_telemetry(self._bus))
+        if self._fleet_exporter is None:
+            self._fleet_exporter = TelemetryExporter(
+                lambda: self._bus, role="procsup",
+                publish_s=self.fleet_publish_s)
+            self._fleet_exporter.start()
 
     async def _probe_broker(self) -> None:
         """PING→PONG the broker over a fresh socket. A SIGSTOPped broker

@@ -51,6 +51,11 @@ class SymbiontStack:
         self.api: Optional[ApiService] = None
         self.watchdog = None  # obs.watchdog.SloWatchdog when configured
         self._heartbeat_task: Optional[asyncio.Task] = None
+        # fleet telemetry plane (obs/fleet.py): the per-role exporter and,
+        # in the API-role process, the aggregator behind the federated
+        # /metrics + /api/fleet surfaces
+        self.fleet_exporter = None
+        self.fleet = None
 
     KNOWN_SERVICES = {"all", "perception", "preprocessing", "vector_memory",
                       "knowledge_graph", "text_generator", "api", "engine"}
@@ -324,6 +329,37 @@ class SymbiontStack:
             log.info("symbiont stack up: api on %s:%s", cfg.api.host, self.api.port)
         else:
             log.info("symbiont stack up (no api): %s", sorted(want))
+        # fleet telemetry plane (obs/fleet.py): active whenever this
+        # process runs as a NAMED role in a supervised deployment
+        # (runner.role set, or heartbeats on) — a default single-process
+        # stack keeps the pre-fleet /metrics byte-identical. The exporter
+        # ships this role's metric deltas + finished spans; the API-role
+        # process additionally hosts the aggregator that merges every
+        # role's telemetry into the federated /metrics, the stitched
+        # cross-process traces, and GET /api/fleet.
+        fleet_on = (cfg.obs.fleet_export
+                    and (bool(cfg.runner.role) or cfg.runner.heartbeat_s > 0))
+        if fleet_on:
+            from symbiont_tpu.obs.fleet import (
+                FleetAggregator,
+                TelemetryExporter,
+                subscribe_telemetry,
+            )
+
+            role = cfg.runner.role or "+".join(sorted(want))
+            if self.api is not None:
+                self.fleet = FleetAggregator(
+                    local_role=role, max_roles=cfg.obs.fleet_roles_max)
+                self.fleet.attach(await subscribe_telemetry(self.bus))
+                self.api.fleet = self.fleet
+            self.fleet_exporter = TelemetryExporter(
+                lambda: self.bus, role=role,
+                publish_s=cfg.obs.fleet_publish_s,
+                spans_max=cfg.obs.fleet_spans_max,
+                pending_max=cfg.obs.fleet_pending_max,
+                metrics_max=cfg.obs.fleet_metrics_max,
+                full_every=cfg.obs.fleet_full_every)
+            self.fleet_exporter.start()
         # process-failure plane: liveness heartbeats for the supervisor
         # (resilience/procsup.py). Started LAST — a heartbeat promises the
         # whole stack is placed and consuming, not just that python booted.
@@ -355,6 +391,12 @@ class SymbiontStack:
             await asyncio.sleep(interval_s)
 
     async def stop(self) -> None:
+        if self.fleet_exporter is not None:
+            await self.fleet_exporter.stop()
+            self.fleet_exporter = None
+        if self.fleet is not None:
+            await self.fleet.detach()
+            self.fleet = None
         if self._heartbeat_task is not None:
             self._heartbeat_task.cancel()
             try:

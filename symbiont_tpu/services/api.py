@@ -216,6 +216,12 @@ class ApiService:
         # that pre-connected with a client-minted id and dropped before
         # ever POSTing must not tombstone the id downstream
         self._gen_submitted: dict = {}
+        # fleet telemetry plane (obs/fleet.py): the runner attaches a
+        # FleetAggregator in supervised deployments — /metrics then serves
+        # the role-labeled federated exposition and GET /api/fleet the
+        # per-role roll-up; None keeps the pre-fleet single-process surface
+        # byte-identical
+        self.fleet = None
         # negative cache for the fused-search subject: after a timeout
         # (subject unserved — engine and store not co-located), skip the
         # fused attempt for a window instead of stalling every request
@@ -307,13 +313,21 @@ class ApiService:
                     # Prometheus text exposition (scrapers want text/plain,
                     # not the /api/metrics JSON snapshot). A scraper that
                     # negotiates OpenMetrics gets that flavor — same
-                    # families plus exemplars on histogram buckets.
+                    # families plus exemplars on histogram buckets. With a
+                    # FleetAggregator attached (obs/fleet.py, wired by the
+                    # runner in supervised deployments) the exposition is
+                    # FEDERATED: every role's series in one scrape, each
+                    # labeled with the role that produced it.
                     from symbiont_tpu.obs import prometheus
 
                     om = ("application/openmetrics-text"
                           in headers.get("accept", ""))
+                    if self.fleet is not None:
+                        body = self.fleet.render_exposition(openmetrics=om)
+                    else:
+                        body = prometheus.render(openmetrics=om)
                     await self._write_response(
-                        writer, 200, prometheus.render(openmetrics=om),
+                        writer, 200, body,
                         origin=headers.get("origin"),
                         content_type=(prometheus.CONTENT_TYPE_OPENMETRICS
                                       if om else
@@ -462,6 +476,19 @@ class ApiService:
                 return 200, json.dumps({"traces": trace_store.recent()})
             if path.startswith("/api/traces/") and method == "GET":
                 return self._trace_route(path[len("/api/traces/"):], query)
+            if path == "/api/fleet" and method == "GET":
+                # per-role deployment roll-up (obs/fleet.py): telemetry
+                # freshness, supervisor liveness verdicts (up / restarts /
+                # hangs / heartbeat age — broker probe included), and key
+                # engine gauges, one entry per role
+                if self.fleet is None:
+                    return 200, json.dumps(
+                        {"available": False, "roles": {},
+                         "message": ("no fleet aggregator on this process "
+                                     "— single-process stack, or "
+                                     "obs.fleet_export off")})
+                return 200, json.dumps(
+                    {"available": True, **self.fleet.rollup()})
             if path == "/api/dlq" and method == "GET":
                 return self._dlq_list()
             if path == "/api/dlq/replay" and method == "POST":

@@ -40,6 +40,17 @@ TASKS_GENERATION_CANCEL = "tasks.generation.cancel"
 # heartbeats out of durable-stream capture by convention.
 SYS_HEARTBEAT = "_sys.heartbeat"
 
+# fleet telemetry plane (obs/fleet.py): each supervised role publishes
+# bounded, periodic metric-snapshot deltas and completed span records under
+# these prefixes (+ ".<role>"); the FleetAggregator in the API-role process
+# (and the ProcessSupervisor) subscribes the wildcards and merges them into
+# the federated `GET /metrics` exposition, the stitched cross-process
+# flight-recorder traces, and the `GET /api/fleet` roll-up. Same `_` prefix
+# convention as heartbeats: telemetry never enters durable-stream capture
+# and never competes with the data path.
+SYS_TELEMETRY_METRICS = "_sys.telemetry.metrics"
+SYS_TELEMETRY_SPANS = "_sys.telemetry.spans"
+
 # request-reply (query path)
 TASKS_EMBEDDING_FOR_QUERY = "tasks.embedding.for_query"
 TASKS_SEARCH_SEMANTIC_REQUEST = "tasks.search.semantic.request"

@@ -361,6 +361,17 @@ class Metrics:
             h = self._hists.get((name, _label_key(labels)))
             return h.summary() if h is not None else None
 
+    def histogram_summaries(self, name: str) -> list:
+        """Every labeled variant of one histogram family:
+        [(labels_dict, summary), ...]. The SLO watchdog judges each variant
+        separately — remote-role span durations federated by the fleet
+        plane (obs/fleet.py) land as `{role: ...}`-labeled histograms, and
+        a breach in ONE role must not hide inside a fleet-wide blend."""
+        with self._lock:
+            found = [(dict(lk), h.summary())
+                     for (n, lk), h in self._hists.items() if n == name]
+        return found
+
     # --------------------------------------------------------------- gauges
 
     def gauge_set(self, name: str, value: float,

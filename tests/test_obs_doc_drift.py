@@ -92,6 +92,10 @@ def _boot_and_collect(tmp_path) -> set:
     )
     cfg.runner.services = ("perception,preprocessing,vector_memory,"
                            "knowledge_graph,text_generator,api")
+    # a named role turns the fleet telemetry plane on (obs/fleet.py):
+    # exporter + aggregator register their `fleet.*` families at start,
+    # so every one of them is doc-drift-enforced on this boot too
+    cfg.runner.role = "drift"
 
     async def scenario() -> set:
         stack = SymbiontStack(cfg, bus=InprocBus(), engine=_StubEngine(),

@@ -1,0 +1,127 @@
+"""The standing perf gate (scripts/perf_gate.sh) cannot rot.
+
+ROADMAP item 1's unlanded half: the `--gate` regression machinery existed
+since PR 1 but nothing RAN it pre-merge. scripts/perf_gate.sh is that one
+command; these tests pin its contract in both directions — rc 0 on the
+real archived numbers, rc != 0 on a synthetically regressed copy and on a
+lost primary — hermetically (candidate mode gates existing archives; no
+bench run, no jax import, sub-second). The quick-run mode (which actually
+re-measures the host-only micro-tiers) is exercised under `-m gate` +
+`slow` so a loaded CI box can't flake the fast tier on CPU timing noise.
+"""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "perf_gate.sh"
+
+pytestmark = pytest.mark.gate
+
+
+def _run_gate(*args, env=None):
+    import os
+
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    return subprocess.run(["bash", str(SCRIPT), *args], cwd=REPO,
+                          capture_output=True, text=True, env=full_env,
+                          timeout=300)
+
+
+def _gateable_primary(line: dict) -> str:
+    """A declared primary the gate actually compares (present, numeric,
+    not tunnel-bound)."""
+    from symbiont_tpu.bench.archive import _TUNNEL_BOUND
+
+    for key in line.get("primary_metrics", []):
+        v = line.get(key)
+        if isinstance(v, (int, float)) and v and not _TUNNEL_BOUND.match(key):
+            return key
+    raise AssertionError("no gateable primary in the archive line")
+
+
+def test_gate_passes_on_the_real_archive():
+    """The acceptance bar's green half: the committed BENCH_LATEST gates
+    clean against itself (zero deltas are inside every noise bar)."""
+    proc = _run_gate("BENCH_LATEST.json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no regression" in proc.stdout
+
+
+def test_gate_fails_on_synthetic_regression(tmp_path):
+    """The acceptance bar's red half: regress ONE gateable primary beyond
+    any noise bar and the same command must exit nonzero, naming it."""
+    from symbiont_tpu.bench.archive import _lower_is_better
+
+    line = json.loads((REPO / "BENCH_LATEST.json").read_text())
+    key = _gateable_primary(line)
+    line[key] = line[key] * 3 if _lower_is_better(key) else line[key] / 3
+    bad = tmp_path / "regressed.json"
+    bad.write_text(json.dumps(line))
+    proc = _run_gate(str(bad))
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert key in proc.stderr, proc.stderr
+
+
+def test_gate_fails_on_lost_primary(tmp_path):
+    """The r5 failure mode itself: a declared primary present in the
+    baseline but MISSING from the candidate is a failure, not a silently
+    narrowed comparison."""
+    line = json.loads((REPO / "BENCH_LATEST.json").read_text())
+    key = _gateable_primary(line)
+    del line[key]
+    bad = tmp_path / "lost.json"
+    bad.write_text(json.dumps(line))
+    proc = _run_gate(str(bad))
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert key in proc.stderr and "missing" in proc.stderr
+
+
+def test_quick_baseline_is_schema_valid_and_self_gates():
+    """BENCH_GATE_BASELINE.json (the committed quick-tier baseline the
+    no-candidate mode gates against — BENCH_LATEST predates the quick
+    tiers' primaries, so the two declare disjoint sets) must stay a valid
+    archive line that gates clean against itself."""
+    from symbiont_tpu.bench import archive
+
+    path = REPO / "BENCH_GATE_BASELINE.json"
+    assert archive.validate_file(path) == []
+    line = archive.load_archive(path)
+    # every quick-tier primary is declared AND measured in the baseline
+    for key in ("obs_span_record_per_s", "obs_critical_path_512_ms",
+                "obs_fleet_merge_per_s", "ser_frame_vs_json_bytes_x"):
+        assert key in line["primary_metrics"], key
+        assert isinstance(line.get(key), (int, float)), key
+    proc = _run_gate(str(path),
+                     env={"PERF_GATE_BASELINE": "BENCH_GATE_BASELINE.json"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_quick_run_mode_measures_and_gates():
+    """The full no-candidate mode: re-measure the host-only micro-tiers
+    and gate them against the committed quick baseline. Marked slow —
+    the measurement is real CPU timing and a loaded box may legitimately
+    sit outside the bars; the fast tier pins the plumbing above."""
+    proc = _run_gate()
+    # rc 0 (clean) or 1-with-a-GATE-line (a real regression verdict) are
+    # both "the gate WORKED"; anything else (usage error, crash, refusal
+    # to compare) is the rot this test exists to catch
+    if proc.returncode != 0:
+        assert "GATE:" in proc.stderr, proc.stdout + proc.stderr
+    else:
+        assert "no regression" in proc.stdout, proc.stdout + proc.stderr
+
+
+def test_script_is_executable_and_documented():
+    assert SCRIPT.exists()
+    assert SCRIPT.stat().st_mode & 0o111, "perf_gate.sh must be executable"
+    text = SCRIPT.read_text()
+    assert "--gate" in text and "BENCH_GATE_BASELINE" in text
+    # PERF.md documents the standing gate (doc.py methodology notes)
+    perf_doc = (REPO / "docs" / "PERF.md").read_text()
+    assert "perf_gate.sh" in perf_doc
