@@ -1,0 +1,13 @@
+"""Contract linter: AST/static analysis over the repo's own invariants.
+
+``python -m symbiont_tpu.lint`` — run every rule, print structured
+``file:line rule-id severity message`` findings, exit non-zero on any.
+See docs/LINTING.md for the rule catalog and allowlist policy."""
+
+from symbiont_tpu.lint.engine import (  # noqa: F401
+    Finding,
+    LintContext,
+    Rule,
+    repo_root,
+    run,
+)

@@ -1,0 +1,31 @@
+"""Rule registry: every rule module contributes its RULES list here.
+
+Order matters only for output grouping; findings are sorted by site. A new
+rule family = a new module with a ``RULES`` list + an import line below +
+a catalog row in docs/LINTING.md (and, if it takes exceptions, a table in
+allowlist.py)."""
+
+from __future__ import annotations
+
+from symbiont_tpu.lint.rules import (
+    asynchygiene,
+    dataplane,
+    jaxhygiene,
+    knobs,
+    locks,
+    parity,
+    wiring,
+)
+
+RULES = (
+    list(wiring.RULES)
+    + list(dataplane.RULES)
+    + list(asynchygiene.RULES)
+    + list(locks.RULES)
+    + list(jaxhygiene.RULES)
+    + list(parity.RULES)
+    + list(knobs.RULES)
+)
+
+_ids = [r.id for r in RULES]
+assert len(_ids) == len(set(_ids)), f"duplicate rule ids: {_ids}"

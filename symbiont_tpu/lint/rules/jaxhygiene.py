@@ -1,0 +1,229 @@
+"""Rule family: JAX recompile/tracing hygiene over ``engine/`` and
+``models/``.
+
+An unintended recompile (or an accidental per-scalar device sync) is the
+same slow-bleed class as a blocked event loop: nothing crashes, the bench
+just gets slower — and on TPU a cold XLA compile is 20-40s inside someone's
+request timeout. Three statically-checkable sub-rules:
+
+- ``jax-static-args``: every ``static_argnames`` entry on a jitted
+  function must name a real parameter (a typo'd name silently leaves the
+  arg traced — one recompile per distinct value, or a tracer leak), and
+  config-carrying params (``cfg``/``config`` — frozen hashable dataclasses
+  here by convention) must BE static (tracing a config dataclass fails at
+  best and retraces at worst).
+- ``jax-jit-in-function``: ``jax.jit(...)`` invoked inside a function body
+  builds a FRESH executable cache per call — the classic
+  compile-every-request bug. Module-level jit (decorators, constants) and
+  ``__init__``-time jit are free; anything else must be an allowlisted
+  executable-cache builder (the two engine sites that key compiled fns by
+  bucket signature).
+- ``jax-host-sync-in-loop``: ``np.asarray(x)`` / ``np.array(x)`` /
+  ``float(x)`` on a device value inside a ``for``/``while`` body of the
+  host dispatch layer (engine/engine.py, engine/lm.py, engine/batcher.py)
+  forces a device→host sync per iteration; ``.item()`` anywhere in the
+  scope is a per-SCALAR sync. The engine's idiom is one bulk
+  materialization per dispatched batch (engine/engine.py:61) — the
+  deliberate chunk/bucket-boundary syncs are allowlisted with reasons, so
+  the allowlist doubles as the inventory of every host sync point on the
+  hot path.
+
+Allowlist entries are ``(repo-relative-file, dotted-scope)`` pairs (tables
+JAX_JIT_IN_FUNCTION_ALLOWED / JAX_HOST_SYNC_ALLOWED in allowlist.py)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from symbiont_tpu.lint.engine import (
+    Finding,
+    LintContext,
+    Rule,
+    dotted_name,
+    iter_own_scope,
+    scoped_functions,
+)
+
+STATIC_RULE = "jax-static-args"
+JIT_RULE = "jax-jit-in-function"
+SYNC_RULE = "jax-host-sync-in-loop"
+
+SCOPE_DIRS = ("symbiont_tpu/engine", "symbiont_tpu/models")
+# host dispatch layer for the sync rule (models/ is trace-side; convert.py
+# is load-time host code — neither is a serving hot path)
+SYNC_FILES = ("symbiont_tpu/engine/engine.py", "symbiont_tpu/engine/lm.py",
+              "symbiont_tpu/engine/batcher.py")
+
+CONFIG_PARAM_NAMES = {"cfg", "config"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "float"}
+# static-under-tracing attributes: branching on these inside jit is legal
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[dict]:
+    """Parse `@jax.jit` / `@partial(jax.jit, static_argnames=...)` /
+    `@jax.jit(...)`; returns {"static": set[str] | None} or None."""
+    if dotted_name(dec) in ("jax.jit", "jit"):
+        return {"static": set()}
+    if not isinstance(dec, ast.Call):
+        return None
+    fn = dotted_name(dec.func)
+    args = list(dec.args)
+    if fn in ("partial", "functools.partial"):
+        if not args or dotted_name(args[0]) not in ("jax.jit", "jit"):
+            return None
+    elif fn not in ("jax.jit", "jit"):
+        return None
+    static: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            static |= _const_strings(kw.value)
+    return {"static": static}
+
+
+def _const_strings(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[str] = set()
+        for el in node.elts:
+            out |= _const_strings(el)
+        return out
+    return set()
+
+
+def _scoped_functions(tree: ast.AST):
+    """(node, dotted-scope) for every def/async-def (the shared walker,
+    class context dropped — these rules key sites by scope alone)."""
+    return [(fn, scope) for fn, scope, _cls in scoped_functions(tree)]
+
+
+def _check_static_args(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in ctx.py_files(*SCOPE_DIRS):
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for fn, scope in _scoped_functions(tree):
+            jit = None
+            for dec in getattr(fn, "decorator_list", []):
+                jit = jit or _jit_decorator(dec)
+            if jit is None:
+                continue
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)}
+            for name in sorted(jit["static"] - params):
+                findings.append(Finding(
+                    rel, fn.lineno, STATIC_RULE, "error",
+                    f"{scope}: static_argnames entry {name!r} names no "
+                    f"parameter of the jitted function (typo leaves the "
+                    f"real arg traced — recompile per value)"))
+            for name in sorted((params & CONFIG_PARAM_NAMES)
+                               - jit["static"]):
+                findings.append(Finding(
+                    rel, fn.lineno, STATIC_RULE, "error",
+                    f"{scope}: config param {name!r} is not in "
+                    f"static_argnames — configs are hashable statics here; "
+                    f"tracing one retraces per instance"))
+    return findings
+
+
+def _check_jit_in_function(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in ctx.py_files(*SCOPE_DIRS):
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for fn, scope in _scoped_functions(tree):
+            if fn.name == "__init__":
+                continue  # construction-time jit compiles once per object
+            # own scope only: a nested def is reported under ITS dotted
+            # scope by the same loop, never doubled under the encloser
+            for node in iter_own_scope(fn):
+                if (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in ("jax.jit", "jit",
+                                                       "_jax.jit")):
+                    if ctx.allowed(JIT_RULE, (rel, scope)):
+                        continue
+                    findings.append(Finding(
+                        rel, node.lineno, JIT_RULE, "error",
+                        f"{scope}: jax.jit() inside a function body builds "
+                        "a fresh executable per call — hoist to module "
+                        "level / __init__, or register the site as an "
+                        "executable-cache builder in the allowlist"))
+    return findings
+
+
+def _device_ish(arg: ast.AST) -> bool:
+    """Heuristic: expressions that can hold device arrays (names, attrs,
+    subscripts, call results) — literals and comprehensions are host data."""
+    return isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript,
+                            ast.Call, ast.Starred))
+
+
+def _check_host_sync(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in ctx.py_files(*SYNC_FILES):
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for fn, scope in _scoped_functions(tree):
+            if any(_jit_decorator(d)
+                   for d in getattr(fn, "decorator_list", [])):
+                continue  # traced code: np/float there is a different bug
+            # own scope only (nested defs report under their own scope)
+            own = list(iter_own_scope(fn))
+            loops = [n for n in own if isinstance(n, (ast.For, ast.While))]
+            in_loop: Set[int] = set()
+            for lp in loops:
+                for n in iter_own_scope(lp):
+                    in_loop.add(id(n))
+            for node in own:
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                is_item = (isinstance(node.func, ast.Attribute)
+                           and node.func.attr == "item" and not node.args)
+                if is_item:
+                    if ctx.allowed(SYNC_RULE, (rel, scope)):
+                        continue
+                    findings.append(Finding(
+                        rel, node.lineno, SYNC_RULE, "error",
+                        f"{scope}: .item() is a per-scalar device sync — "
+                        "materialize the whole batch once (np.asarray at "
+                        "the dispatch boundary) instead"))
+                    continue
+                if (d in _SYNC_CALLS and id(node) in in_loop
+                        and node.args and _device_ish(node.args[0])):
+                    if ctx.allowed(SYNC_RULE, (rel, scope)):
+                        continue
+                    findings.append(Finding(
+                        rel, node.lineno, SYNC_RULE, "error",
+                        f"{scope}: {d}() on a device value inside a loop "
+                        "forces a device→host sync per iteration — hoist "
+                        "the materialization out of the loop or allowlist "
+                        "the site as a deliberate chunk-boundary sync"))
+    return findings
+
+
+RULES = [
+    Rule(id=STATIC_RULE,
+         doc="jit static_argnames must name real params; config params "
+             "must be static",
+         check=_check_static_args),
+    Rule(id=JIT_RULE,
+         doc="jax.jit inside a function body (compile-per-call) unless an "
+             "allowlisted executable-cache builder",
+         check=_check_jit_in_function,
+         allow_key=JIT_RULE),
+    Rule(id=SYNC_RULE,
+         doc="per-iteration device→host syncs (.item()/np.asarray/float in "
+             "loops) in the host dispatch layer",
+         check=_check_host_sync,
+         allow_key=SYNC_RULE),
+]

@@ -150,8 +150,12 @@ class ProcessSupervisor:
 
     async def start(self) -> None:
         self._stopping = False
+        loop = asyncio.get_running_loop()
         for w in self.workers.values():
-            self._spawn(w)
+            # executor: Popen's fork+exec is blocking host work (tens of ms
+            # on a loaded box) and the supervisor's loop also runs the
+            # 0.25s-period liveness probes — never stall them on a spawn
+            await loop.run_in_executor(None, self._spawn, w)
             w.task = asyncio.create_task(self._monitor(w),
                                          name=f"procsup-{w.spec.role}")
         if self.bus_url:
@@ -194,13 +198,23 @@ class ProcessSupervisor:
                 await asyncio.sleep(0.05)
             if w.proc.poll() is None:
                 self._terminate(w, sig=signal.SIGKILL)
-                w.proc.wait(timeout=5)
+                # executor: wait() blocks up to its timeout — the loop may
+                # still be draining other workers' monitors
+                await asyncio.get_running_loop().run_in_executor(
+                    None, w.proc.wait, 5)
             metrics.gauge_set("procsup.up", 0,
                               labels={"role": w.spec.role})
 
     # -------------------------------------------------------------- spawn
 
     def _spawn(self, w: _Worker) -> None:
+        # runs on an executor thread (start/_monitor) — which opens a
+        # window where stop() flips the stopping flags while a restart is
+        # already past its flag check. Re-check HERE (and once more after
+        # the fork below): a supervisor that is stopping must never mint a
+        # child it will not be watching.
+        if self._stopping or w.stopping:
+            return
         env = {**os.environ, **w.spec.env}
         kwargs = {}
         if self._stdio is not None:
@@ -210,6 +224,22 @@ class ProcessSupervisor:
         # to the supervisor's group (and chaos plans kill by pid anyway)
         w.proc = subprocess.Popen(w.spec.argv, env=env,
                                   start_new_session=True, **kwargs)
+        if self._stopping or w.stopping:
+            # stop() ran while we were forking: its grace/kill loop may
+            # already have polled the OLD proc and finished — this child
+            # is ours to reap, fully (we are on an executor thread, so the
+            # blocking waits are fine), and none of the started-state
+            # below may run (the up gauge must stay 0 after stop()).
+            self._terminate(w, sig=signal.SIGTERM)
+            try:
+                w.proc.wait(timeout=5)
+            except Exception:
+                self._terminate(w, sig=signal.SIGKILL)
+                try:
+                    w.proc.wait(timeout=5)
+                except Exception:
+                    pass
+            return
         w.started_at = time.monotonic()
         w.last_heartbeat = 0.0
         if w.spec.is_broker:
@@ -284,7 +314,10 @@ class ProcessSupervisor:
                 return
             w.restarts += 1
             metrics.inc("procsup.restarts", labels={"role": w.spec.role})
-            self._spawn(w)
+            # executor, like start(): a restart storm must not freeze the
+            # sibling monitors and the broker probe behind serial forks
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._spawn, w)
 
     def _is_hung(self, w: _Worker) -> bool:
         if w.spec.is_broker:

@@ -1,260 +1,111 @@
-"""Static pipeline-wiring check: subjects.py vs actual call sites.
+"""Static pipeline-wiring checks — now a thin shim over the contract
+linter (symbiont_tpu/lint/, docs/LINTING.md).
 
-The reference SHIPPED a dead limb — knowledge_graph_service subscribed
-`data.processed_text.tokenized` while nothing published it (SURVEY.md fact
-#3, reference CHANGELOG.md:57-60): the whole knowledge-graph path was
-silently inert in v0.3.0. This test makes that bug class impossible to
-reintroduce here: it walks every Python AND native C++ source for
-`subjects.<NAME>` / `subjects::<NAME>` (and literal subject strings in the
-C++ tree), classifies each site as producer (publish / request /
-engine_call) or consumer (subscribe / durable_subscribe / _subscribe_loop),
-and fails on any subscribed-but-never-published subject.
+The scans that used to live inline here (subject wiring vs call sites,
+the per-float / asdict / frame-dtype data-plane bans) graduated into lint
+rules in PR 12; this file keeps the original test NAMES green while
+delegating to the same engine `python -m symbiont_tpu.lint` runs, so the
+contracts stay pinned from tier-1 exactly as before — plus the scanner
+ground-truth self-check that keeps the shared scan from rotting into
+vacuous passes.
+
+History preserved in the rule docstrings: the reference SHIPPED a dead
+limb (knowledge_graph_service subscribed data.processed_text.tokenized
+while nothing published it — SURVEY.md fact #3); the dead-limb rule makes
+that bug class impossible to reintroduce.
 """
 
-import re
-from pathlib import Path
+from __future__ import annotations
 
-import symbiont_tpu.subjects as subjects_mod
-from symbiont_tpu import subjects
+import pytest
 
-REPO = Path(__file__).resolve().parent.parent
+from symbiont_tpu.lint import LintContext, repo_root, run
+from symbiont_tpu.lint.rules import wiring
 
-# producer call tokens: the Python bus surface plus the native helper that
-# wraps request-reply to the engine plane (native/services/common.hpp)
-_PRODUCER_CALLS = ("publish(", "request(", "engine_call(")
-# consumer call tokens; "await sub(" covers engine_service's local alias
-# `sub = self._subscribe_loop`
-_CONSUMER_CALLS = ("durable_subscribe(", "_subscribe_loop(", "subscribe(",
-                   "await sub(")
-_NEITHER_CALLS = ("add_stream(",)  # capture config, not production
+pytestmark = pytest.mark.lint
 
-# Served-but-uncalled endpoints we KEEP deliberately: the engine plane is a
-# public RPC surface for native worker shells and external bus clients;
-# engine.embed.query is the non-fused query-embedding endpoint exported in
-# the generated C++ header for remote callers. Anything else showing up
-# here is a dead limb — fix the wiring, don't grow this list.
-ALLOWED_UNPRODUCED = {"ENGINE_EMBED_QUERY"}
+REPO = repo_root()
 
 
-def _subject_constants() -> dict:
-    """NAME -> value for every real subject constant (queue-group names are
-    subscription arguments, not subjects)."""
-    out = {}
-    for name in dir(subjects_mod):
-        if not name.isupper():
-            continue
-        value = getattr(subjects_mod, name)
-        if isinstance(value, str) and not value.startswith("q."):
-            out[name] = value
-    return out
+def _findings(rule_ids):
+    """Run the named rules over the real repo with the CENTRAL allowlists
+    (the same invocation the CLI makes), split into (violations, stale)."""
+    findings, _ = run(root=REPO, rule_ids=rule_ids)
+    stale = [f for f in findings if f.rule == "stale-allowlist"]
+    real = [f for f in findings if f.rule != "stale-allowlist"]
+    return real, stale
 
 
-def _classify(context: str):
-    """Nearest preceding call token wins (multi-line calls put the callee
-    before the subject argument)."""
-    best_pos, best_kind = -1, None
-    for token, kind in (
-            [(t, "producer") for t in _PRODUCER_CALLS]
-            + [(t, "consumer") for t in _CONSUMER_CALLS]
-            + [(t, None) for t in _NEITHER_CALLS]):
-        i = context.rfind(token)
-        if i > best_pos:
-            best_pos, best_kind = i, kind
-    return best_kind if best_pos >= 0 else None
+def _render(fs):
+    return "\n".join(f.render() for f in fs)
 
 
-def _scan():
-    consts = _subject_constants()
-    by_value = {v: k for k, v in consts.items()}
-    producers, consumers = {}, {}
-    files = [p for p in (REPO / "symbiont_tpu").rglob("*.py")
-             if p.name != "subjects.py"]
-    native_files = []
-    for ext in ("*.cpp", "*.hpp", "*.h"):
-        native_files += list((REPO / "native").rglob(ext))
-    const_ref = re.compile(r"subjects(?:\.|::)([A-Z][A-Z0-9_]*)")
-    for f in files + native_files:
-        text = f.read_text(errors="replace")
-        hits = [(m.start(), m.group(1)) for m in const_ref.finditer(text)
-                if m.group(1) in consts]
-        if f in native_files:
-            # native code may also use the literal subject string (e.g.
-            # knowledge_graph.cpp's engine_call(bus, "engine.graph.save"))
-            for value, name in by_value.items():
-                for m in re.finditer(re.escape(f'"{value}"'), text):
-                    hits.append((m.start(), name))
-        for pos, name in hits:
-            kind = _classify(text[max(0, pos - 200):pos])
-            target = {"producer": producers, "consumer": consumers}.get(kind)
-            if target is not None:
-                target.setdefault(name, set()).add(
-                    str(f.relative_to(REPO)))
-    return producers, consumers
+# ----------------------------------------------------------- subject wiring
 
 
 def test_no_subscribed_but_never_published_subject():
-    producers, consumers = _scan()
-    dead = set(consumers) - set(producers) - ALLOWED_UNPRODUCED
-    assert not dead, (
-        f"dead limbs: subscribed but never published anywhere "
-        f"(the reference's data.processed_text.tokenized bug class): "
-        f"{ {d: sorted(consumers[d]) for d in sorted(dead)} }")
+    real, _ = _findings(["subject-dead-limb"])
+    assert not real, _render(real)
 
 
 def test_allowlist_entries_are_still_served():
-    """The allowlist documents SERVED endpoints without in-repo callers; if
-    the subscription disappears the entry is stale — prune it."""
-    _, consumers = _scan()
-    stale = ALLOWED_UNPRODUCED - set(consumers)
-    assert not stale, f"ALLOWED_UNPRODUCED entries no longer subscribed: {stale}"
+    """The allowlist documents SERVED endpoints without in-repo callers;
+    if the subscription disappears the entry is stale — prune it."""
+    _, stale = _findings(["subject-dead-limb"])
+    assert not stale, _render(stale)
 
 
 def test_pipeline_subjects_have_consumers_and_producers():
-    """Both directions for the eight reference-parity pipeline subjects
-    (ALL_SUBJECTS): each must have at least one producer AND one consumer —
-    the full-duplex wiring SURVEY.md §1-L3 documents."""
-    producers, consumers = _scan()
-    name_by_value = {getattr(subjects, n): n for n in dir(subjects)
-                     if n.isupper() and isinstance(getattr(subjects, n), str)}
-    for value in subjects.ALL_SUBJECTS:
-        name = name_by_value[value]
-        assert name in producers, f"pipeline subject {value} has no producer"
-        assert name in consumers, f"pipeline subject {value} has no consumer"
+    """Both directions for the reference-parity pipeline subjects
+    (ALL_SUBJECTS): the full-duplex wiring SURVEY.md §1-L3 documents.
+    (The engine emits these as subject-full-duplex findings from the same
+    rule pass.)"""
+    real, _ = _findings(["subject-dead-limb"])
+    assert not [f for f in real if f.rule == "subject-full-duplex"], \
+        _render(real)
 
 
-# --------------------------------------------------------------------------
-# Data-plane guard: the binary tensor-frame plane (schema/frames) exists so
-# bulk floats never pass through per-float Python conversion on the message
-# hot path. A `[float(x) for x in ...]` list comprehension inside services/
-# is exactly the regression that rebuilt the old wall — ban it statically,
-# with an allowlist for the small query-reply paths where a handful of
-# floats is not a data plane.
-
-# (file relative to repo root, enclosing function) pairs that may keep a
-# per-float conversion: bounded, latency-path payloads (top-k scores).
-# Anything new showing up here is the hot path regressing to JSON float
-# lists — route it through schema/frames (or ndarray.tolist()) instead.
-FLOAT_LIST_ALLOWED = {
-    ("symbiont_tpu/services/engine_service.py",
-     "EngineService._rerank.op"),
-}
-
-_FLOAT_LIST = re.compile(r"\[\s*float\(")
-_SCOPE = re.compile(r"^(\s*)(?:(?:async\s+)?def|class)\s+(\w+)")
-
-
-def _pattern_sites(pattern: re.Pattern):
-    """(file, dotted-scope-path) for every `pattern` hit in services/ — an
-    indent stack qualifies nested scopes (`EngineService._rerank.op`), so
-    allowlist entries name one exact site, not every handler's inner
-    `op`. Comment lines are skipped: a ban is about code, and the docs
-    that EXPLAIN the ban must be allowed to name it."""
-    sites = set()
-    for f in sorted((REPO / "symbiont_tpu" / "services").glob("*.py")):
-        stack: list = []  # (indent, name)
-        for line in f.read_text().splitlines():
-            m = _SCOPE.match(line)
-            if m:
-                indent = len(m.group(1))
-                while stack and stack[-1][0] >= indent:
-                    stack.pop()
-                stack.append((indent, m.group(2)))
-            if line.lstrip().startswith("#"):
-                continue
-            if pattern.search(line):
-                path = ".".join(n for _, n in stack) or "<module>"
-                sites.add((str(f.relative_to(REPO)), path))
-    return sites
-
-
-def _float_list_sites():
-    return _pattern_sites(_FLOAT_LIST)
+# --------------------------------------------------------------- data plane
 
 
 def test_no_per_float_conversion_on_message_paths():
-    sites = _float_list_sites()
-    offenders = sites - FLOAT_LIST_ALLOWED
-    assert not offenders, (
-        "per-float Python conversion on a services/ message path — the "
-        "serialization wall the tensor-frame data plane removed "
-        "(docs/PERF.md 'data plane' section). Use schema/frames or "
-        f"ndarray.tolist() instead: {sorted(offenders)}")
+    real, _ = _findings(["no-per-float-conversion"])
+    assert not real, _render(real)
 
 
 def test_float_list_allowlist_entries_still_exist():
-    """A stale allowlist entry means the conversion was removed — prune it
-    so the guard stays tight."""
-    stale = FLOAT_LIST_ALLOWED - _float_list_sites()
-    assert not stale, f"FLOAT_LIST_ALLOWED entries no longer present: {stale}"
-
-
-# --------------------------------------------------------------------------
-# Object-churn guard: `dataclasses.asdict` recursively materializes a dict
-# per field per call — on the ingest hot-path services that was exactly the
-# per-message churn the zero-churn decode removed (vector_memory built one
-# QdrantPointPayload dataclass + asdict dict PER SENTENCE). Payload dicts on
-# message paths are built directly now (their keys pinned by
-# tests/test_store_wire_fixtures.py); anything re-introducing asdict inside
-# services/ shows up here. `dataclasses.replace` stays fine — it is O(1)
-# per call and carries no per-row cost.
-
-ASDICT_ALLOWED: set = set()  # no current site may use it; keep it that way
-
-_ASDICT = re.compile(r"\basdict\s*\(")
+    _, stale = _findings(["no-per-float-conversion"])
+    assert not stale, _render(stale)
 
 
 def test_no_dataclass_asdict_on_ingest_services():
-    offenders = _pattern_sites(_ASDICT) - ASDICT_ALLOWED
-    assert not offenders, (
-        "dataclasses.asdict on a services/ message path — per-message "
-        "dict churn the zero-churn ingest decode removed (schema/frames "
-        "decode_embeddings_lazy + direct payload dict build). Build the "
-        f"dict directly instead: {sorted(offenders)}")
+    real, _ = _findings(["no-asdict-on-ingest"])
+    assert not real, _render(real)
 
 
 def test_asdict_allowlist_entries_still_exist():
-    stale = ASDICT_ALLOWED - _pattern_sites(_ASDICT)
-    assert not stale, f"ASDICT_ALLOWED entries no longer present: {stale}"
-
-
-# --------------------------------------------------------------------------
-# Frame-dtype guard: the SYTF dtype registry (name ↔ header byte ↔ numpy
-# dtype ↔ content type) lives in schema/frames.py and NOWHERE else. A
-# service hand-rolling a frame header, magic, dtype byte, or dtype-name
-# literal is how a future dtype ends up half-wired (decodable on one hop,
-# garbage on another). One allowlisted encoder may map a negotiated
-# encoding value to a dtype name; everything else calls frames helpers
-# with no dtype knowledge at all.
-
-FRAME_DTYPE_ALLOWED = {
-    ("symbiont_tpu/services/engine_service.py",
-     "EngineService._embed_batch.op"),
-}
-
-# hand-rolled content types, the frame magic, dtype-constant references,
-# or quoted dtype-name literals — anywhere in services/
-_FRAME_DTYPE = re.compile(
-    r"""tensor/f|SYTF|DTYPE_F|["']f(?:16|32)["']""")
+    _, stale = _findings(["no-asdict-on-ingest"])
+    assert not stale, _render(stale)
 
 
 def test_no_hardcoded_frame_dtype_in_services():
-    offenders = _pattern_sites(_FRAME_DTYPE) - FRAME_DTYPE_ALLOWED
-    assert not offenders, (
-        "hard-coded frame dtype outside schema/frames.py — the dtype "
-        "registry is centralized there so new dtypes (f16 was the first) "
-        "wire every hop at once. Call frames.attach_frame/encode_frame "
-        f"with a negotiated name instead: {sorted(offenders)}")
+    real, _ = _findings(["no-hardcoded-frame-dtype"])
+    assert not real, _render(real)
 
 
 def test_frame_dtype_allowlist_entries_still_exist():
-    stale = FRAME_DTYPE_ALLOWED - _pattern_sites(_FRAME_DTYPE)
-    assert not stale, f"FRAME_DTYPE_ALLOWED entries no longer present: {stale}"
+    _, stale = _findings(["no-hardcoded-frame-dtype"])
+    assert not stale, _render(stale)
+
+
+# ---------------------------------------------------- scanner ground truth
 
 
 def test_scanner_sees_known_ground_truth():
     """Self-check so the scanner can't silently rot into vacuous passes:
     a few known call sites must classify as expected."""
-    producers, consumers = _scan()
+    ctx = LintContext(REPO)
+    producers, consumers = wiring.scan(ctx)
     # api publishes the perceive task; perception consumes it
     assert any("services/api.py" in f
                for f in producers["TASKS_PERCEIVE_URL"])
