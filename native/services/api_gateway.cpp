@@ -37,7 +37,9 @@
 #include <condition_variable>
 #include <deque>
 #include <mutex>
+#include <set>
 #include <thread>
+#include <utility>
 #include <vector>
 
 #include "../../generated/cpp/symbiont_schema.hpp"
@@ -71,6 +73,12 @@ class Metrics {
 };
 
 Metrics g_metrics;
+
+// per-tenant admission (common.hpp AdmissionGate — the Python
+// resilience/admission.py quota check, ported so the C++ gateway is no
+// longer the one ingress a hot tenant could walk around; engine-plane
+// tenant lanes stay the second line of defense behind this edge)
+symbiont::AdmissionGate g_admission;
 
 // ------------------------------------------------------------------ sse hub
 
@@ -286,17 +294,18 @@ std::string cors_headers(const std::map<std::string, std::string>& headers) {
 
 void write_response(int fd, int status, const std::string& body,
                     const std::map<std::string, std::string>& req_headers,
-                    bool keep_alive) {
+                    bool keep_alive, const std::string& extra_headers = "") {
   const char* reason = status == 200   ? "OK"
                        : status == 400 ? "Bad Request"
                        : status == 404 ? "Not Found"
                        : status == 413 ? "Payload Too Large"
+                       : status == 429 ? "Too Many Requests"
                        : status == 503 ? "Service Unavailable"
                                        : "Internal Server Error";
   std::string head = "HTTP/1.1 " + std::to_string(status) + " " + reason +
                      "\r\nContent-Type: application/json\r\nContent-Length: " +
                      std::to_string(body.size()) + "\r\n" +
-                     cors_headers(req_headers) +
+                     cors_headers(req_headers) + extra_headers +
                      (keep_alive ? "Connection: keep-alive\r\n\r\n"
                                  : "Connection: close\r\n\r\n");
   send_all(fd, head + body);
@@ -837,6 +846,37 @@ void handle_connection(int fd) {
       if (!keep_alive) break;
       continue;
     }
+    if (req.method == "POST" &&
+        (req.path == "/api/submit-url" || req.path == "/api/generate-text" ||
+         req.path == "/api/search/semantic")) {
+      // per-tenant quota check (Python _edge_admit parity): an exhausted
+      // bucket answers 429 + Retry-After at the edge — never an unbounded
+      // queue, and never a bus publish for work nobody can absorb
+      using Gate = symbiont::AdmissionGate;
+      Gate::Class klass = req.path == "/api/submit-url" ? Gate::INGEST
+                          : req.path == "/api/generate-text"
+                              ? Gate::GENERATE
+                              : Gate::SEARCH;
+      const char* cls_name = klass == Gate::INGEST     ? "ingest"
+                             : klass == Gate::GENERATE ? "generate"
+                                                       : "search";
+      std::string tenant = symbiont::http_tenant_of(req.headers);
+      double retry_after_s = 1.0;
+      if (!g_admission.admit(klass, tenant, &retry_after_s)) {
+        g_metrics.inc(std::string("admission.throttled.") + cls_name);
+        json::Value o = json::Value::object();
+        o.set("message", json::Value("tenant '" + tenant + "' over its " +
+                                     cls_name + " quota"));
+        o.set("reason", json::Value("quota"));
+        o.set("task_id", json::Value());
+        long retry = (long)retry_after_s + 1;  // ceil-ish, minimum 1
+        write_response(fd, 429, o.dump(), req.headers, keep_alive,
+                       "Retry-After: " + std::to_string(retry) + "\r\n");
+        if (!keep_alive) break;
+        continue;
+      }
+      g_metrics.inc(std::string("admission.admitted.") + cls_name);
+    }
     if (req.method == "OPTIONS") {
       status = 200;
       body = "";
@@ -910,6 +950,7 @@ int main() {
       symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_DOWN_S", "60").c_str()));
   g_cfg.fused_max_top_k = std::atoi(
       symbiont::env_or("SYMBIONT_API_FUSED_SEARCH_MAX_TOP_K", "16").c_str());
+  g_admission.configure();  // SYMBIONT_ADMISSION_* (docs/RESILIENCE.md)
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) return 1;

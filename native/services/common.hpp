@@ -8,16 +8,21 @@
 // against JAX or any ML runtime.
 #pragma once
 
+#include <algorithm>
 #include <array>
 #include <cctype>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
 #include <map>
+#include <mutex>
 #include <random>
+#include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "../symbus/client.hpp"
@@ -201,15 +206,23 @@ inline std::string heartbeat_subject(const std::string& role) {
   return std::string(SYS_HEARTBEAT) + "." + role;
 }
 
-inline std::string heartbeat_payload(const std::string& role) {
+inline std::string heartbeat_payload(const std::string& role,
+                                     bool draining = false) {
   // byte-for-byte what the Python runner publishes:
-  // json.dumps({"role": role, "pid": os.getpid()})
+  // json.dumps({"role": role, "pid": os.getpid(),
+  //             "capacity": 0|1, "draining": false|true})
+  // capacity/draining are the elastic-autoscaler fields (resilience/
+  // autoscale.py): capacity 1 = serving, 0 = draining out. The C++
+  // shells do not implement the drain protocol yet, so they always beat
+  // serving — the supervisor retires them with the SIGTERM fallback.
   std::string out = "{\"role\": \"";
   for (char c : role) {
     if (c == '"' || c == '\\') out.push_back('\\');
     out.push_back(c);
   }
-  out += "\", \"pid\": " + std::to_string((long)getpid()) + "}";
+  out += "\", \"pid\": " + std::to_string((long)getpid()) +
+         ", \"capacity\": " + (draining ? "0" : "1") +
+         ", \"draining\": " + (draining ? "true" : "false") + "}";
   return out;
 }
 
@@ -242,6 +255,140 @@ inline void maybe_heartbeat(symbus::Client& bus, Heartbeat& hb) {
   } catch (const std::exception&) {
     // skip this beat; the client reconnects on its own backoff
   }
+}
+
+// ---- per-tenant admission (resilience/admission.py parity) ---------------
+//
+// The C++ gateway was the ONE ingress where a hot tenant could bypass the
+// overload-protection plane entirely (ROADMAP item 1's last named
+// admission gap): per-tenant token buckets per request class (ingest /
+// search / generate, tenant from X-Symbiont-Tenant), exhaustion answered
+// 429 + Retry-After, and the client-suppliable tenant universe BOUNDED —
+// past max_tenants every new identity shares the "(overflow)" bucket, so
+// minting fresh tenant headers buys no fresh burst and grows no state.
+// Header-only and json-free so the GCC10 stub-json harness
+// (tests/test_native_services.py) can compile AND run it.
+
+struct TokenBucket {
+  double rate = 1.0, burst = 1.0, tokens = 1.0;
+  int64_t last_ms = 0;
+
+  void refill(int64_t now_ms) {
+    tokens = std::min(burst, tokens + (now_ms - last_ms) / 1000.0 * rate);
+    last_ms = now_ms;
+  }
+  bool try_take(int64_t now_ms) {
+    refill(now_ms);
+    if (tokens >= 1.0) {
+      tokens -= 1.0;
+      return true;
+    }
+    return false;
+  }
+  double retry_after_s(int64_t now_ms) {
+    refill(now_ms);
+    return (1.0 - tokens) / rate > 0.0 ? (1.0 - tokens) / rate : 0.0;
+  }
+};
+
+class AdmissionGate {
+ public:
+  enum Class { INGEST = 0, SEARCH = 1, GENERATE = 2 };
+
+  // read SYMBIONT_ADMISSION_* (defaults in lockstep with AdmissionConfig,
+  // symbiont_tpu/config.py; knob rows in docs/RESILIENCE.md)
+  void configure() {
+    std::string on = env_or("SYMBIONT_ADMISSION_ENABLED", "true");
+    enabled_ = (on != "false" && on != "0" && on != "no");
+    rate_[INGEST] = env_num("SYMBIONT_ADMISSION_INGEST_RATE", 200.0);
+    burst_[INGEST] = env_num("SYMBIONT_ADMISSION_INGEST_BURST", 400.0);
+    rate_[SEARCH] = env_num("SYMBIONT_ADMISSION_SEARCH_RATE", 100.0);
+    burst_[SEARCH] = env_num("SYMBIONT_ADMISSION_SEARCH_BURST", 200.0);
+    rate_[GENERATE] = env_num("SYMBIONT_ADMISSION_GENERATE_RATE", 20.0);
+    burst_[GENERATE] = env_num("SYMBIONT_ADMISSION_GENERATE_BURST", 40.0);
+    max_tenants_ = (size_t)env_num("SYMBIONT_ADMISSION_MAX_TENANTS", 1024.0);
+    for (int c = 0; c < 3; ++c) {
+      if (rate_[c] <= 0 || burst_[c] <= 0) {
+        // a typo'd knob must not silently admit everything at rate 0 —
+        // the loudest stance a process without a config validator has
+        logline("ERROR", "admission",
+                "rate/burst must be positive; using class defaults");
+        rate_[c] = c == INGEST ? 200.0 : c == SEARCH ? 100.0 : 20.0;
+        burst_[c] = 2 * rate_[c];
+      }
+    }
+  }
+
+  bool enabled() const { return enabled_; }
+  uint64_t tenant_overflows() const { return overflow_; }
+
+  // one admission decision; on refusal returns false and sets
+  // *retry_after_s (the Retry-After hint a 429 carries). now_ms defaults
+  // to the steady clock; injectable for the compile-harness test.
+  bool admit(Class klass, const std::string& raw_tenant,
+             double* retry_after_s, int64_t now_ms = -1) {
+    if (!enabled_) return true;
+    if (now_ms < 0)
+      now_ms = (int64_t)std::chrono::duration_cast<
+                   std::chrono::milliseconds>(
+                   std::chrono::steady_clock::now().time_since_epoch())
+                   .count();
+    std::lock_guard<std::mutex> g(mu_);
+    std::string tenant = resolve_locked(raw_tenant);
+    auto key = std::make_pair(tenant, (int)klass);
+    auto it = buckets_.find(key);
+    if (it == buckets_.end()) {
+      TokenBucket b;
+      b.rate = rate_[klass];
+      b.burst = burst_[klass];
+      b.tokens = b.burst;
+      b.last_ms = now_ms;
+      it = buckets_.emplace(key, b).first;
+    }
+    if (it->second.try_take(now_ms)) return true;
+    if (retry_after_s) *retry_after_s = it->second.retry_after_s(now_ms);
+    return false;
+  }
+
+ private:
+  static double env_num(const char* name, double dflt) {
+    std::string v = env_or(name, "");
+    return v.empty() ? dflt : std::atof(v.c_str());
+  }
+
+  // bounded tenant universe (admission.py resolve_tenant): known tenants
+  // resolve to themselves; past the bound every NEW identity shares one
+  // overflow bucket set
+  std::string resolve_locked(const std::string& tenant) {
+    if (seen_.count(tenant)) return tenant;
+    if (seen_.size() >= max_tenants_) {
+      ++overflow_;
+      return "(overflow)";
+    }
+    seen_.insert(tenant);
+    return tenant;
+  }
+
+  bool enabled_ = true;
+  double rate_[3] = {200.0, 100.0, 20.0};
+  double burst_[3] = {400.0, 200.0, 40.0};
+  size_t max_tenants_ = 1024;
+  uint64_t overflow_ = 0;
+  std::mutex mu_;
+  std::map<std::pair<std::string, int>, TokenBucket> buckets_;
+  std::set<std::string> seen_{"default"};
+};
+
+// tenant identity from LOWERCASED http headers (the gateway lowercases
+// keys on read; admission.py tenant_of parity: trim, default tenant)
+inline std::string http_tenant_of(
+    const std::map<std::string, std::string>& headers) {
+  auto it = headers.find("x-symbiont-tenant");
+  if (it == headers.end()) return "default";
+  const std::string& t = it->second;
+  size_t b = t.find_first_not_of(" \t");
+  if (b == std::string::npos) return "default";
+  return t.substr(b, t.find_last_not_of(" \t") - b + 1);
 }
 
 // Bus URL: symbus://host:port (nats:// accepted as a reference-era alias,

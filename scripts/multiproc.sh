@@ -16,6 +16,13 @@
 #   scripts/multiproc.sh                 # chaos scenarios + the bench tier
 #   scripts/multiproc.sh --tests-only    # just the pytest chaos scenarios
 #   scripts/multiproc.sh --seed 7        # replay a specific kill plan
+#   scripts/multiproc.sh --ramp          # the traffic-ramp AUTOSCALER
+#                                        # phase standalone (load_ramp
+#                                        # tier: 4x open-loop ramp, kill
+#                                        # plan firing, scale-out + drained
+#                                        # scale-in hard gates — docs/
+#                                        # RESILIENCE.md "Elastic
+#                                        # autoscaling")
 #
 # Device-free: workers run tiny real engines on the JAX CPU backend; the
 # broker is the pure-Python symbus twin (bus/pybroker.py) where the native
@@ -26,14 +33,24 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 seed=1
 tests_only=0
+ramp=0
 prev=""
 for arg in "$@"; do
   case "$arg" in
     --tests-only) tests_only=1 ;;
+    --ramp) ramp=1 ;;
     --seed) prev="seed" ;;
     *) if [[ "$prev" == "seed" ]]; then seed="$arg"; prev=""; fi ;;
   esac
 done
+
+if [[ "$ramp" -eq 1 ]]; then
+  echo "== drain-protocol chaos scenarios (scale-out/in, mid-drain kill) ==" >&2
+  python -m pytest tests/test_autoscale.py -m chaos -q
+  echo "== load_ramp bench tier (4x traffic ramp + autoscaler, seed ${seed}) ==" >&2
+  exec python bench.py --only load_ramp --ramp \
+    --load-seed "${seed}" --chaos-seed "${seed}"
+fi
 
 echo "== process-failure chaos scenarios (pybroker + supervisor) ==" >&2
 python -m pytest tests/test_procsup.py -m chaos -q

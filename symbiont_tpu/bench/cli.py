@@ -8,6 +8,8 @@
     python bench.py --mesh dp4xtp2             # multichip tier mesh shape
     python bench.py --only load_multiproc --multiproc   # kill-chaos, real
                                                # multi-process deployment
+    python bench.py --only load_ramp --ramp    # traffic-ramp autoscaler
+                                               # phase (scale-out + drain)
     python bench.py --render-doc BENCH_rNN.json > docs/PERF.md
     python bench.py --gate NEW.json BASELINE.json   # regression gate
     python bench.py --validate ARCHIVE.json [...]   # schema check
@@ -256,7 +258,13 @@ def main(argv=None) -> int:
                                 # seeded kill-chaos (bench/load.py); without
                                 # the flag that tier skips (it spawns real
                                 # OS processes — explicit opt-in only)
-                                multiproc="--multiproc" in argv)
+                                multiproc="--multiproc" in argv,
+                                # --ramp arms the load_ramp tier: the same
+                                # deployment under a 4x traffic ramp with
+                                # the elastic autoscaler driving scale-out
+                                # and a drained scale-in (scripts/
+                                # multiproc.sh --ramp)
+                                ramp="--ramp" in argv)
     _maybe_register_injection()
 
     quick = "--quick" in argv

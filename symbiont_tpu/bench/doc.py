@@ -718,13 +718,14 @@ proves:
   skipped → recovery with hysteresis), observed live in the tier.
 
 """
+    autoscale = _render_autoscale(f)
     if "load_search_p99_ms" not in f:
         return header + (
             "This archive predates the load tier, so its measured fields "
             "(`load_search_p99_ms`, `load_ttft_p99_ms`, "
             "`load_zero_loss_ingest`, `load_fairness_jain`, the 429/shed "
             "counts) will appear from the next full `python bench.py` "
-            "run.\n\n")
+            "run.\n\n") + autoscale
     measured = (
         f"Measured this run (seeds load={_fmt(f.get('load_seed', 0))} "
         f"chaos={_fmt(f.get('chaos_seed', 0))}): "
@@ -743,7 +744,47 @@ proves:
         f"{_fmt(f.get('load_gen_streams', 0))} SSE streams; shed ladder "
         f"escalated to rung {_fmt(f.get('load_ladder_max_level', 0))} and "
         f"recovered={bool(f.get('load_ladder_recovered', 0))}")
-    return header + measured + ".\n\n"
+    return header + measured + ".\n\n" + autoscale
+
+
+def _render_autoscale(f: dict) -> str:
+    """The elastic-autoscaler paragraph (resilience/autoscale.py, the
+    `load_ramp` tier behind `scripts/multiproc.sh --ramp`): prose is
+    archive-agnostic; the measured sentence appears once a run archives
+    the ramp phase's primaries."""
+    header = (
+        "### Elastic autoscaling under a traffic ramp\n\n"
+        "The `load_ramp` tier (run standalone: `scripts/multiproc.sh "
+        "--ramp`) drives the supervised multi-process deployment through "
+        "a 4× open-loop ingest ramp with the seeded kill plan still "
+        "firing, and the SLO-driven autoscaler "
+        "(`resilience/autoscale.py`) attached to the supervisor. Hard "
+        "gates: at least one scale-out (a new `embed-N` replica joins the "
+        "durable queue groups), at least one drained scale-in (consumer "
+        "detach → coalescer flush → `draining: true` heartbeat → rc-0 "
+        "exit, with a submit wave landing DURING the drain), exact "
+        "zero-loss ingest, Jain ≥ 0.8, no flap (dwell-respecting decision "
+        "log), and no rung-2 shed while capacity was addable.\n\n")
+    if "load_mp_scaleout_s" not in f:
+        return header + (
+            "This archive predates the ramp phase, so its primaries "
+            "(`load_mp_scaleout_s` — ramp start → new replica serving — "
+            "and `load_mp_drain_loss`, the exact points lost across a "
+            "drained scale-in, which must be 0) will appear from the next "
+            "`scripts/multiproc.sh --ramp` archive.\n\n")
+    return header + (
+        f"Measured this run: scale-out answered the ramp in "
+        f"**{f['load_mp_scaleout_s']} s** (ramp start → replica serving, "
+        f"{_fmt(f.get('load_ramp_scale_decisions', 0))} scale decisions, "
+        f"0 flaps), the drained scale-in retired its replica "
+        f"{'cleanly' if f.get('load_ramp_drain_clean') else 'by deadline'}"
+        f" in {_fmt(f.get('load_ramp_drain_s', 0))} s, and "
+        f"`load_mp_drain_loss` = **{_fmt(f.get('load_mp_drain_loss', 0))}"
+        f"** ({_fmt(f.get('load_ramp_landed_points', 0))}/"
+        f"{_fmt(f.get('load_ramp_expected_points', 0))} points landed "
+        f"across kill plan + resize), Jain "
+        f"**{f.get('load_mp_ramp_fairness_jain', 0)}**, shed-ladder "
+        f"level {_fmt(f.get('load_ramp_shed_level', 0))}.\n\n")
 
 
 def _render_overlap(f: dict) -> str:

@@ -556,6 +556,74 @@ class AdmissionConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """SLO-driven elastic autoscaling (resilience/autoscale.py,
+    docs/RESILIENCE.md "Elastic autoscaling"): the ProcessSupervisor's
+    policy engine that grows and shrinks role-split fleets from the
+    pressure signals the admission plane and fleet telemetry already
+    measure. Off by default — a fixed-size deployment behaves exactly as
+    before. Scale-in always retires through the drain protocol (the
+    worker detaches its durable consumers, flushes its coalescer,
+    finishes in-flight work, beats `draining: true`, and exits), with
+    `drain_deadline_s` + SIGKILL + durable redelivery as the safety net."""
+
+    enabled: bool = False
+    # elastic roles and their replica bounds: "embed=1:4,decode=1:2".
+    # Every listed role must exist as a supervised worker; the base
+    # replica (index 1) is never retired, so min >= 1.
+    roles: str = ""
+    # seconds between policy evaluations
+    eval_s: float = 2.0
+    # scale-out pressure: per-replica engine queue depth (the federated
+    # `batcher.queue_depth` + `batcher.tenant_depth` gauges) above
+    # queue_high is full pressure; below queue_low counts as a clean
+    # (scale-in-eligible) pass
+    queue_high: float = 64.0
+    queue_low: float = 4.0
+    # KV-occupancy pressure for decode roles: allocated KV rows
+    # (`lm.kv_rows_allocated`) above this is full pressure; 0 disables
+    kv_high_rows: float = 0.0
+    # breaker-style hysteresis (the DegradationLadder shape): a scale-out
+    # needs out_dwell_s since the role's last change; a scale-in needs
+    # in_clean_passes CONSECUTIVE low-pressure evaluations AND
+    # in_dwell_s — a flapping signal parks the fleet at its size instead
+    # of thrashing spawn/drain cycles
+    out_dwell_s: float = 10.0
+    in_dwell_s: float = 60.0
+    in_clean_passes: int = 5
+    # global scale budget: at most budget_ops scale operations (out or
+    # in, all roles together) per budget_window_s — a runaway signal or
+    # crash-looping role cannot thrash the box
+    budget_ops: int = 6
+    budget_window_s: float = 300.0
+    # drain enforcement: a retiring worker that has not exited this many
+    # seconds after the drain request is SIGKILLed (its unacked durable
+    # deliveries redeliver to the surviving replicas — zero loss either
+    # way)
+    drain_deadline_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        for name in ("eval_s", "out_dwell_s", "budget_window_s",
+                     "drain_deadline_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"autoscale.{name} must be positive")
+        if self.in_dwell_s < 0 or self.kv_high_rows < 0:
+            raise ValueError(
+                "autoscale.in_dwell_s and kv_high_rows must be >= 0")
+        if self.queue_high <= 0 or self.queue_low < 0 \
+                or self.queue_low >= self.queue_high:
+            raise ValueError(
+                "autoscale.queue_low must be >= 0 and < queue_high")
+        if self.in_clean_passes < 1 or self.budget_ops < 1:
+            raise ValueError(
+                "autoscale.in_clean_passes and budget_ops must be >= 1")
+        # malformed role bounds fail at boot, not silently never scale
+        from symbiont_tpu.resilience.autoscale import parse_role_bounds
+
+        parse_role_bounds(self.roles)
+
+
+@dataclass
 class RunnerConfig:
     """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
 
@@ -597,6 +665,7 @@ class SymbiontConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
 
     def __post_init__(self) -> None:
         # cross-section invariant: every top_k the gateway routes to the

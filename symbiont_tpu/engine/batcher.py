@@ -49,6 +49,20 @@ log = logging.getLogger(__name__)
 # admission.max_tenants (the tenant header is client-supplied)
 MAX_TENANT_LANES = 256
 
+# interactive lane class: a tenant's single query embed must never FIFO
+# behind that SAME tenant's hundreds-deep bulk-ingest lane — measured by
+# the load_ramp tier (4x ingest ramp: same-tenant query embeds waited out
+# the whole backlog, 10s bus timeouts) — so interactive work rides
+# "<tenant>#q", which the stride clock interleaves fairly against the
+# tenant's bulk lane. At most 2x the lane cardinality, still bounded by
+# MAX_TENANT_LANES.
+INTERACTIVE_LANE_SUFFIX = "#q"
+
+
+def interactive_lane(tenant: str) -> str:
+    """The fairness-lane identity for one tenant's INTERACTIVE work."""
+    return f"{tenant}{INTERACTIVE_LANE_SUFFIX}"
+
 
 class TenantLanes:
     """Per-tenant FIFO lanes drained in stride-fair order (engine-plane

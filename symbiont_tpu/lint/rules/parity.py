@@ -24,8 +24,9 @@ the Python tree and diffs them against the native tree:
   sizes, and ``tensor/<name>`` content types must agree with the C++
   decoder;
 - **heartbeat payload**: the JSON keys (and their order — the C++ side
-  string-builds the payload for byte parity) published by
-  ``runner._heartbeat_loop`` must match ``common.hpp heartbeat_payload``.
+  string-builds the payload for byte parity) built by
+  ``runner._heartbeat_payload`` (capacity/draining autoscaler fields
+  included) must match ``common.hpp heartbeat_payload``.
 
 No allowlist: parity has no legitimate exceptions — fix whichever side
 drifted."""
@@ -279,19 +280,24 @@ def _py_elem_sizes(ctx: LintContext) -> Dict[str, int]:
 
 
 def _runner_heartbeat_keys(ctx: LintContext) -> List[str]:
+    """The runner's heartbeat JSON keys, in publish order: the first
+    json.dumps(dict-literal) inside `_heartbeat_payload` (the builder the
+    loop and the drain protocol's final beat share) or, for older trees,
+    `_heartbeat_loop` itself."""
     tree = ctx.tree(ctx.root / PY_RUNNER)
     if tree is None:
         return []
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.AsyncFunctionDef)
-                and node.name == "_heartbeat_loop"):
-            for sub in ast.walk(node):
-                if (isinstance(sub, ast.Call)
-                        and isinstance(sub.func, ast.Attribute)
-                        and sub.func.attr == "dumps" and sub.args
-                        and isinstance(sub.args[0], ast.Dict)):
-                    return [k.value for k in sub.args[0].keys
-                            if isinstance(k, ast.Constant)]
+    for fn_name in ("_heartbeat_payload", "_heartbeat_loop"):
+        for node in ast.walk(tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == fn_name):
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "dumps" and sub.args
+                            and isinstance(sub.args[0], ast.Dict)):
+                        return [k.value for k in sub.args[0].keys
+                                if isinstance(k, ast.Constant)]
     return []
 
 

@@ -466,16 +466,19 @@ class FleetAggregator:
                 "roles": roles}
 
 
-_PROCSUP_STATS = {"gauge": ("up", "heartbeat_age_s"),
-                  "counter": ("restarts", "hangs")}
+_PROCSUP_STATS = {"gauge": ("up", "heartbeat_age_s", "draining",
+                            "crashlooped"),
+                  "counter": ("restarts", "hangs", "scale_out", "scale_in",
+                              "drain_timeouts")}
 
 
 def _parse_procsup_key(key: str):
     """``gauge.procsup.up{role="embed"}`` → ("up", "embed"); None for
-    everything else. Covers up / heartbeat_age_s gauges and restarts /
-    hangs counters — the supervisor-side liveness verdicts the roll-up
-    folds into each supervised role's entry (broker probe included).
-    One key grammar, one parser: prometheus.parse_flat_key."""
+    everything else. Covers up / heartbeat_age_s / draining / crashlooped
+    gauges and restarts / hangs / scale_out / scale_in / drain_timeouts
+    counters — the supervisor-side liveness + elastic-scaling verdicts
+    the roll-up folds into each supervised role's entry (broker probe
+    included). One key grammar, one parser: prometheus.parse_flat_key."""
     from symbiont_tpu.obs.prometheus import parse_flat_key
 
     parsed = parse_flat_key(key)

@@ -45,6 +45,8 @@ each worker so a driver can measure kill→serving-again recovery
 from __future__ import annotations
 
 import asyncio
+import dataclasses
+import json
 import logging
 import os
 import signal
@@ -53,6 +55,7 @@ import struct
 import subprocess
 import sys
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -85,6 +88,10 @@ class WorkerSpec:
     is_broker: bool = False
     probe_host: str = "127.0.0.1"
     probe_port: int = 0
+    # elastic roles (scale_role): replicas of a base role are workers named
+    # "<role>-<i>" carrying the base role here, so the autoscaler can count
+    # and retire them as one fleet. Empty = this worker IS its base role.
+    base_role: str = ""
 
 
 class _Worker:
@@ -97,6 +104,21 @@ class _Worker:
         self.up_events: List[float] = []  # heartbeat/probe confirmations
         self.task: Optional[asyncio.Task] = None
         self.stopping = False
+        # drain protocol (scale-in): set by _drain_worker — an exit while
+        # draining is retirement, never a restart; hang verdicts are
+        # suppressed (a flushing worker legitimately stops beating last)
+        self.draining = False
+        self.drain_clean: Optional[bool] = None  # exited before the deadline?
+        # what the worker itself reports in its heartbeat payload
+        # (runner._heartbeat_payload: capacity 0 + draining true while the
+        # drain protocol runs) — surfaced via procsup.draining / /api/fleet
+        self.reported_draining = False
+        self.reported_capacity = 1.0
+        # restart-storm budget: timestamps of recent restarts; a worker
+        # exceeding the storm bound parks in the `crashlooped` state
+        # instead of burning CPU on an unbounded backoff loop
+        self.restart_times: deque = deque()
+        self.crashlooped = False
 
 
 class ProcessSupervisor:
@@ -109,10 +131,33 @@ class ProcessSupervisor:
 
     def __init__(self, bus_url: str = "", heartbeat_poll_s: float = 0.25,
                  stdio=None, fleet_telemetry: bool = True,
-                 fleet_publish_s: float = 2.0):
+                 fleet_publish_s: float = 2.0,
+                 drain_deadline_s: float = 30.0,
+                 storm_max_restarts: int = 8, storm_window_s: float = 60.0,
+                 crashloop_cooloff_s: float = 300.0):
         self.bus_url = bus_url
         self.heartbeat_poll_s = heartbeat_poll_s
         self.workers: Dict[str, _Worker] = {}
+        # drain enforcement (scale_role scale-in): a worker that has not
+        # exited this long after the drain request is SIGKILLed — durable
+        # redelivery makes even a hung drain lossless
+        self.drain_deadline_s = drain_deadline_s
+        # restart-storm budget: more than storm_max_restarts restarts
+        # inside storm_window_s parks the worker in `crashlooped` (up=0,
+        # procsup.crashlooped=1, no respawns) for crashloop_cooloff_s,
+        # then allows ONE probe restart with a fresh budget — jittered
+        # backoff alone caps at backoff_max_s and burns CPU forever on a
+        # permanently-broken argv/env
+        self.storm_max_restarts = storm_max_restarts
+        self.storm_window_s = storm_window_s
+        self.crashloop_cooloff_s = crashloop_cooloff_s
+        # scale/drain audit trail consumed by the autoscaler's flap gate
+        # and the ramp bench phase: (monotonic ts, base_role, "out"/"in",
+        # replica name) appended by scale_role; drain_events records each
+        # retirement's outcome as (ts, replica, clean, duration_s) —
+        # clean=False means the deadline SIGKILL fired
+        self.scale_events: List[tuple] = []
+        self.drain_events: List[tuple] = []
         self._bus = None
         self._hb_task: Optional[asyncio.Task] = None
         self._mon_task: Optional[asyncio.Task] = None
@@ -146,6 +191,8 @@ class ProcessSupervisor:
     def add_worker(self, spec: WorkerSpec) -> None:
         if spec.role in self.workers:
             raise ValueError(f"duplicate worker role {spec.role!r}")
+        if not spec.base_role:
+            spec = dataclasses.replace(spec, base_role=spec.role)
         self.workers[spec.role] = _Worker(spec)
 
     async def start(self) -> None:
@@ -273,14 +320,204 @@ class ProcessSupervisor:
     def restarts(self, role: str) -> int:
         return self.workers[role].restarts
 
+    # ------------------------------------------------------- elastic scaling
+
+    def replicas(self, base_role: str) -> List[str]:
+        """Replica worker names of one base role, base first, then by
+        replica index — the retirement order is the reverse (newest
+        drains first; the base replica never retires)."""
+        names = [name for name, w in self.workers.items()
+                 if w.spec.base_role == base_role]
+        names.sort(key=lambda n: (n != base_role, len(n), n))
+        return names
+
+    def _replica_spec(self, base: WorkerSpec, index: int) -> WorkerSpec:
+        """Spec for replica `index` (>= 2) of an elastic role: same argv,
+        the role name (and SYMBIONT_RUNNER_ROLE, when the base is a
+        runner) suffixed `-<index>` so heartbeats, fleet telemetry and
+        the drain subject all address this replica individually, while
+        the worker's queue-group subscriptions (named by SERVICE, not by
+        role) share the durable streams with its siblings — fan-in is
+        free."""
+        name = f"{base.base_role or base.role}-{index}"
+        env = dict(base.env)
+        # always exported (harmless to non-runner workers): the replica
+        # must identify as ITSELF on heartbeats and the drain subject
+        env["SYMBIONT_RUNNER_ROLE"] = name
+        return dataclasses.replace(base, role=name, env=env,
+                                   base_role=base.base_role or base.role)
+
+    async def scale_role(self, base_role: str, n: int) -> dict:
+        """Resize an elastic role to `n` replicas (the autoscaler's one
+        write surface). Scale-out spawns `<role>-<i>` workers joining the
+        existing queue groups; scale-in retires the newest replicas
+        through the drain protocol (`_drain_worker`): a `_sys.drain`
+        request, a deadline, SIGKILL + durable redelivery as the safety
+        net. n < 1 is rejected — the base replica always stays. Returns
+        {"added": [...], "drained": [...]}."""
+        base = self.workers.get(base_role)
+        if base is None:
+            raise ValueError(f"unknown role {base_role!r}")
+        if n < 1:
+            raise ValueError("scale_role target must be >= 1 "
+                             "(the base replica never retires)")
+        names = self.replicas(base_role)
+        added: List[str] = []
+        drained: List[str] = []
+        loop = asyncio.get_running_loop()
+        if n > len(names):
+            # next replica indices resume past every name ever MINTED (the
+            # scale_events audit trail remembers retired ones), so a dead
+            # replica's role — whose final draining:true beat can still be
+            # in flight — is never reused by a different process
+            def _idx(nm: str):
+                tail = nm.rsplit("-", 1)[-1]
+                return int(tail) if nm != base_role and tail.isdigit() \
+                    else None
+            used = {i for i in map(_idx, names) if i is not None}
+            used |= {i for i in (_idx(ev[3]) for ev in self.scale_events
+                                 if ev[1] == base_role) if i is not None}
+            idx = 2
+            while len(names) + len(added) < n:
+                while idx in used:
+                    idx += 1
+                spec = self._replica_spec(base.spec, idx)
+                used.add(idx)
+                self.add_worker(spec)
+                w = self.workers[spec.role]
+                await loop.run_in_executor(None, self._spawn, w)
+                w.task = asyncio.create_task(
+                    self._monitor(w), name=f"procsup-{w.spec.role}")
+                added.append(spec.role)
+                metrics.inc("procsup.scale_out",
+                            labels={"role": base_role})
+                log.info("procsup: scale-out %s -> %s", base_role,
+                         spec.role)
+                self.scale_events.append(
+                    (time.monotonic(), base_role, "out", spec.role))
+        elif n < len(names):
+            for name in reversed(names[n:]):  # newest retires first
+                metrics.inc("procsup.scale_in", labels={"role": base_role})
+                self.scale_events.append(
+                    (time.monotonic(), base_role, "in", name))
+                await self._drain_worker(self.workers[name])
+                drained.append(name)
+        return {"added": added, "drained": drained}
+
+    async def _drain_worker(self, w: _Worker,
+                            deadline_s: Optional[float] = None) -> None:
+        """Retire one worker through the drain protocol: publish
+        `_sys.drain.<role>` (the worker detaches its durable consumers,
+        flushes its coalescer, finishes in-flight work, beats
+        `draining: true`, and exits rc 0), enforce the deadline, SIGKILL a
+        hung drain (its unacked deliveries redeliver — still lossless),
+        and remove the worker from supervision."""
+        from symbiont_tpu import subjects
+
+        role = w.spec.role
+        w.draining = True
+        t_drain = time.monotonic()
+        metrics.gauge_set("procsup.draining", 1, labels={"role": role})
+        deadline_s = self.drain_deadline_s if deadline_s is None \
+            else deadline_s
+        sent = False
+        if self._bus is not None:
+            try:
+                await self._bus.publish(f"{subjects.SYS_DRAIN}.{role}",
+                                        b"{}")
+                sent = True
+            except Exception:
+                log.warning("procsup: drain publish for %s failed", role)
+        if not sent:
+            # no bus (broker down, or a bus-less supervisor): SIGTERM is
+            # the degraded drain — the runner's signal handler stops the
+            # stack, whose service stops still flush-on-stop
+            self._terminate(w, sig=signal.SIGTERM)
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + deadline_s
+        # a publish to a subject nobody subscribes SUCCEEDS (C++ shells
+        # have no drain subscription yet): if the worker neither exits nor
+        # reports draining within a grace, escalate to SIGTERM so its
+        # graceful-terminate path runs instead of burning the whole
+        # deadline into a SIGKILL (common.hpp's promised fallback)
+        term_at = time.monotonic() + min(5.0, deadline_s / 3.0) \
+            if sent else None
+        while time.monotonic() < deadline:
+            if w.proc is None or w.proc.poll() is not None:
+                break
+            if (term_at is not None and not w.reported_draining
+                    and time.monotonic() >= term_at):
+                term_at = None
+                log.info("procsup: %s never acknowledged the bus drain; "
+                         "escalating to SIGTERM", role)
+                self._terminate(w, sig=signal.SIGTERM)
+            await asyncio.sleep(0.05)
+        w.drain_clean = w.proc is None or w.proc.poll() is not None
+        if not w.drain_clean:
+            # the safety net: a hung drain still loses nothing — its
+            # durable deliveries were never acked and redeliver to the
+            # surviving replicas after ack_wait
+            metrics.inc("procsup.drain_timeouts", labels={"role": role})
+            log.warning("procsup: %s drain exceeded %.1fs; SIGKILL "
+                        "(durable redelivery covers its in-flight work)",
+                        role, deadline_s)
+            self._terminate(w, sig=signal.SIGKILL)
+        w.stopping = True
+        if w.task is not None:
+            w.task.cancel()
+            await asyncio.gather(w.task, return_exceptions=True)
+            w.task = None
+        if w.proc is not None:
+            # reap off-loop; bounded — a zombie wait can't stall siblings
+            try:
+                await loop.run_in_executor(None, w.proc.wait, 10)
+            except Exception:
+                pass
+        metrics.gauge_set("procsup.up", 0, labels={"role": role})
+        metrics.gauge_set("procsup.draining", 0, labels={"role": role})
+        log.info("procsup: %s drained (%s)", role,
+                 "clean" if w.drain_clean else "deadline -> SIGKILL")
+        self.drain_events.append((time.monotonic(), role, w.drain_clean,
+                                  round(time.monotonic() - t_drain, 3)))
+        self.workers.pop(role, None)
+
     # ----------------------------------------------------------- liveness
 
     async def _monitor(self, w: _Worker) -> None:
         """Exit-code + hang supervision for one worker, with jittered
-        exponential backoff between restarts (supervisor.py policy)."""
+        exponential backoff between restarts (supervisor.py policy) and
+        the restart-storm budget (crashloop parking)."""
         delay = w.spec.backoff_base_s
         while not self._stopping and not w.stopping:
             rc = w.proc.poll() if w.proc is not None else None
+            if w.draining:
+                # retirement in progress (_drain_worker owns the deadline
+                # + SIGKILL): an exit now is the PROTOCOL, not a crash —
+                # never restart, never judge hangs
+                if rc is None:
+                    await asyncio.sleep(self.heartbeat_poll_s)
+                    continue
+                metrics.gauge_set("procsup.up", 0,
+                                  labels={"role": w.spec.role})
+                return
+            if rc == 0 and w.reported_draining:
+                # a drain the supervisor did not initiate (operator-
+                # published `_sys.drain.<role>`): the worker's last beat
+                # announced the retirement and it exited clean — honoring
+                # it beats respawning a process someone asked to go away.
+                # REMOVED from supervision like a scale_role drain, so the
+                # autoscaler/fleet stop counting a dead process as a live
+                # serving replica
+                log.info("procsup: %s retired after a self-reported drain",
+                         w.spec.role)
+                metrics.gauge_set("procsup.up", 0,
+                                  labels={"role": w.spec.role})
+                metrics.gauge_set("procsup.draining", 0,
+                                  labels={"role": w.spec.role})
+                self.drain_events.append(
+                    (time.monotonic(), w.spec.role, True, 0.0))
+                self.workers.pop(w.spec.role, None)
+                return
             hung = self._is_hung(w)
             if rc is None and not hung:
                 # healthy run resets the backoff after a stable period
@@ -308,20 +545,61 @@ class ProcessSupervisor:
             metrics.gauge_set("procsup.up", 0, labels={"role": w.spec.role})
             if self._stopping or w.stopping:
                 return
+            if not await self._respect_storm_budget(w):
+                return  # stop() interrupted the crashloop cool-off
             await asyncio.sleep(jittered(delay))
             delay = min(delay * 2, w.spec.backoff_max_s)
             if self._stopping or w.stopping:
                 return
             w.restarts += 1
+            w.restart_times.append(time.monotonic())
             metrics.inc("procsup.restarts", labels={"role": w.spec.role})
             # executor, like start(): a restart storm must not freeze the
             # sibling monitors and the broker probe behind serial forks
             await asyncio.get_running_loop().run_in_executor(
                 None, self._spawn, w)
 
+    async def _respect_storm_budget(self, w: _Worker) -> bool:
+        """The restart-storm budget: a worker past `storm_max_restarts`
+        restarts inside `storm_window_s` PARKS in the `crashlooped` state
+        (up=0, `procsup.crashlooped{role}`=1, surfaced in /api/fleet) for
+        `crashloop_cooloff_s` instead of burning CPU on fork/exec forever
+        — jittered backoff alone is bounded per cycle, not per hour. After
+        the cool-off, ONE probe restart runs with a fresh budget. Returns
+        False when stop() interrupted the wait."""
+        now = time.monotonic()
+        while w.restart_times and now - w.restart_times[0] \
+                > self.storm_window_s:
+            w.restart_times.popleft()
+        if len(w.restart_times) < self.storm_max_restarts:
+            return True
+        w.crashlooped = True
+        metrics.gauge_set("procsup.crashlooped", 1,
+                          labels={"role": w.spec.role})
+        log.error("procsup: %s CRASHLOOPED (%d restarts in %.0fs); parked "
+                  "for %.0fs", w.spec.role, len(w.restart_times),
+                  self.storm_window_s, self.crashloop_cooloff_s)
+        deadline = now + self.crashloop_cooloff_s
+        while time.monotonic() < deadline:
+            if self._stopping or w.stopping:
+                return False
+            await asyncio.sleep(min(0.5, self.heartbeat_poll_s * 2))
+        w.crashlooped = False
+        w.restart_times.clear()
+        metrics.gauge_set("procsup.crashlooped", 0,
+                          labels={"role": w.spec.role})
+        log.warning("procsup: %s cool-off elapsed; probing one restart",
+                    w.spec.role)
+        return True
+
     def _is_hung(self, w: _Worker) -> bool:
         if w.spec.is_broker:
             return False  # judged by the probe loop (needs a round-trip)
+        if w.draining:
+            # a draining worker detaches its consumers and may stop
+            # beating while it flushes: the DRAIN deadline (not the hang
+            # detector) is its bound
+            return False
         if w.spec.heartbeat_timeout_s <= 0:
             return False
         if not self._broker_healthy:
@@ -374,7 +652,31 @@ class ProcessSupervisor:
                     w.last_heartbeat = now
                     w.up_events.append(now)
                     del w.up_events[:-64]
+                    self._note_heartbeat_payload(w, msg.data)
             await self._probe_broker()
+
+    @staticmethod
+    def _note_heartbeat_payload(w: _Worker, data: bytes) -> None:
+        """Fold the beat's capacity/draining fields (runner
+        `_heartbeat_payload`) into the worker's state: a worker reporting
+        `draining: true` is mid-retirement — the roll-up shows it and the
+        autoscaler stops counting it as serving headroom. Pre-field beats
+        (C++ shells on an old image, the toy test workers' `{}`) read as
+        serving at full capacity."""
+        try:
+            hb = json.loads(data) if data else {}
+        except ValueError:
+            hb = {}
+        if not isinstance(hb, dict):
+            return
+        w.reported_draining = bool(hb.get("draining", False))
+        try:
+            w.reported_capacity = float(hb.get("capacity", 1))
+        except (TypeError, ValueError):
+            w.reported_capacity = 1.0
+        metrics.gauge_set("procsup.draining",
+                          1 if (w.reported_draining or w.draining) else 0,
+                          labels={"role": w.spec.role})
 
     async def _start_fleet_telemetry(self) -> None:
         """Attach the supervisor's fleet aggregator to the (re)connected

@@ -51,6 +51,14 @@ class SymbiontStack:
         self.api: Optional[ApiService] = None
         self.watchdog = None  # obs.watchdog.SloWatchdog when configured
         self._heartbeat_task: Optional[asyncio.Task] = None
+        # drain protocol (resilience/autoscale.py scale-in): flipped by a
+        # `_sys.drain.<role>` message from the supervisor; `drained` wakes
+        # main() so the process exits once the drain completes
+        self.draining = False
+        self.drained = asyncio.Event()
+        self._drain_sub = None
+        self._drain_task: Optional[asyncio.Task] = None
+        self._hb_role = ""
         # fleet telemetry plane (obs/fleet.py): the per-role exporter and,
         # in the API-role process, the aggregator behind the federated
         # /metrics + /api/fleet surfaces
@@ -361,25 +369,43 @@ class SymbiontStack:
                 full_every=cfg.obs.fleet_full_every)
             self.fleet_exporter.start()
         # process-failure plane: liveness heartbeats for the supervisor
-        # (resilience/procsup.py). Started LAST — a heartbeat promises the
-        # whole stack is placed and consuming, not just that python booted.
+        # (resilience/procsup.py), plus the drain subscription the elastic
+        # autoscaler's scale-in rides (resilience/autoscale.py). Started
+        # LAST — a heartbeat promises the whole stack is placed and
+        # consuming, not just that python booted.
+        if cfg.runner.heartbeat_s > 0 or cfg.runner.role:
+            role = self._hb_role = cfg.runner.role or "+".join(sorted(want))
+            self._drain_sub = await self.bus.subscribe(
+                f"{subjects.SYS_DRAIN}.{role}")
+            self._drain_task = asyncio.create_task(
+                self._drain_loop(), name="runner-drain")
         if cfg.runner.heartbeat_s > 0:
-            role = cfg.runner.role or "+".join(sorted(want))
             self._heartbeat_task = asyncio.create_task(
-                self._heartbeat_loop(role, cfg.runner.heartbeat_s),
+                self._heartbeat_loop(self._hb_role, cfg.runner.heartbeat_s),
                 name="runner-heartbeat")
 
-    async def _heartbeat_loop(self, role: str, interval_s: float) -> None:
+    def _heartbeat_payload(self, role: str) -> bytes:
+        """One liveness beat. `capacity`/`draining` are the elastic-
+        autoscaler fields: capacity 1 means this replica is serving, 0
+        means it is draining out and the supervisor should neither route
+        hang verdicts at it nor count it as serving headroom. Keys and
+        their order are BYTE-PARITY with common.hpp heartbeat_payload
+        (cpp-parity lint rule + tests/test_fleet.py pin both)."""
         import json
         import os
 
+        return json.dumps({"role": role, "pid": os.getpid(),
+                           "capacity": 0 if self.draining else 1,
+                           "draining": self.draining}).encode()
+
+    async def _heartbeat_loop(self, role: str, interval_s: float) -> None:
         from symbiont_tpu.utils.telemetry import metrics
 
-        payload = json.dumps({"role": role, "pid": os.getpid()}).encode()
         while True:
             try:
                 await self.bus.publish(
-                    f"{subjects.SYS_HEARTBEAT}.{role}", payload)
+                    f"{subjects.SYS_HEARTBEAT}.{role}",
+                    self._heartbeat_payload(role))
                 metrics.inc("runner.heartbeats", labels={"role": role})
             except ConnectionError:
                 # broker gap: the TcpBus send-gate already waited its
@@ -390,7 +416,62 @@ class SymbiontStack:
                 return  # bus closed: stack is stopping
             await asyncio.sleep(interval_s)
 
+    async def _drain_loop(self) -> None:
+        """Wait for the supervisor's drain request and run the protocol.
+        One-shot: the first `_sys.drain.<role>` message retires this
+        process."""
+        async for _msg in self._drain_sub:
+            await self.drain()
+            return
+
+    async def drain(self) -> None:
+        """The worker half of the drain protocol (scale-in,
+        resilience/autoscale.py): stop pulling new durable deliveries
+        (consumers detach — unacked work redelivers to surviving queue-
+        group members), let in-flight handlers finish, flush the
+        UpsertCoalescer (ack-after-flush waits release), finish in-flight
+        generation sessions, publish a final heartbeat with
+        `draining: true`, and wake main() to exit. Idempotent."""
+        from symbiont_tpu.utils.telemetry import metrics
+
+        if self.draining:
+            return
+        self.draining = True
+        metrics.gauge_set("runner.draining", 1)
+        log.info("drain requested: detaching consumers and flushing")
+        if self.api is not None:
+            # a draining gateway goes /readyz 503 first so the LB routes
+            # around it before the socket disappears
+            self.api.mark_not_ready()
+        for s in self.services:
+            await s.drain()
+        if self._lm_batcher is not None:
+            # finishes in-flight generation sessions (close() runs every
+            # pending flush to completion before failing the leftovers)
+            await self._lm_batcher.close()
+        if self._hb_role:
+            try:
+                # the final beat: tells the supervisor (and /api/fleet)
+                # this exit is a DRAIN, not a death
+                await self.bus.publish(
+                    f"{subjects.SYS_HEARTBEAT}.{self._hb_role}",
+                    self._heartbeat_payload(self._hb_role))
+            except Exception:
+                log.debug("final draining heartbeat failed", exc_info=True)
+        log.info("drain complete: exiting")
+        self.drained.set()
+
     async def stop(self) -> None:
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            try:
+                await self._drain_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._drain_task = None
+        if self._drain_sub is not None:
+            self._drain_sub.close()
+            self._drain_sub = None
         if self.fleet_exporter is not None:
             await self.fleet_exporter.stop()
             self.fleet_exporter = None
@@ -432,7 +513,17 @@ async def main() -> None:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:
             pass
-    await stop.wait()
+    # exit on an operator signal OR a completed drain (the supervisor's
+    # scale-in request — resilience/autoscale.py): a drained worker's last
+    # act is a clean rc-0 exit, which the supervisor treats as retirement,
+    # not a crash
+    waits = [asyncio.ensure_future(stop.wait()),
+             asyncio.ensure_future(stack.drained.wait())]
+    try:
+        await asyncio.wait(waits, return_when=asyncio.FIRST_COMPLETED)
+    finally:
+        for w in waits:
+            w.cancel()
     await stack.stop()
 
 

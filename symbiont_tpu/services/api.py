@@ -233,6 +233,12 @@ class ApiService:
     def mark_ready(self) -> None:
         self._ready = True
 
+    def mark_not_ready(self) -> None:
+        """Drain protocol: a gateway being retired flips /readyz back to
+        503 (and re-engages the data-path 503 gate) so the load balancer
+        routes around it before the process exits."""
+        self._ready = False
+
     # ---------------------------------------------------------------- server
 
     async def start(self) -> None:

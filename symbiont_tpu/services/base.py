@@ -231,6 +231,45 @@ class Service:
             else:
                 await invoke()
 
+    async def drain(self) -> None:
+        """Stop pulling NEW work, let everything already here land — the
+        scale-in half of the drain protocol (resilience/autoscale.py).
+
+        Closing a durable subscription DETACHES the consumer (TcpBus sends
+        UNSUB and forgets it, so a reconnect never re-attaches): deliveries
+        this worker pulled but never acked redeliver after `ack_wait` to
+        the surviving queue-group members. The close sentinel lands BEHIND
+        any locally-queued deliveries, so the dispatch loop runs the
+        backlog to completion before exiting — those handlers' acks
+        (including coalesced ack-after-flush waits, which the subclass
+        drain() overrides switch to immediate-flush first) release
+        normally.
+
+        Request-reply subscriptions close the same way: they are
+        at-most-once hops with no redelivery, so the loss window must be
+        the one UNSUB round-trip (deliveries racing the close), never the
+        locally-queued backlog — a storm's worth of requests already
+        routed to this member is dispatched and ANSWERED below before the
+        loops end, instead of being dropped into caller timeouts the way
+        a plain stop()'s loop-cancel would. The supervisor-side deadline,
+        not this method, is the bound on a drain that hangs."""
+        self._running = False
+        for s in self._subs:
+            s.close()
+        if self._loops:
+            # NO cancel: each loop dispatches its queued backlog, then
+            # ends on the close sentinel (which close() enqueues BEHIND
+            # the backlog); supervise exits on the clean return
+            done, pending = await asyncio.wait(self._loops, timeout=30.0)
+            for t in pending:
+                t.cancel()
+            await asyncio.gather(*self._loops, return_exceptions=True)
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks),
+                                 return_exceptions=True)
+        self._loops.clear()
+        self._subs.clear()
+
     async def stop(self) -> None:
         self._running = False
         for s in self._subs:

@@ -110,6 +110,7 @@ class UpsertCoalescer:
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        self._draining = False
         self._labels = {"service": name}
 
     async def start(self) -> None:
@@ -120,6 +121,17 @@ class UpsertCoalescer:
                 "coalesce.pending_rows", self,
                 lambda c: None if c._closed else c._pending_rows,
                 labels=self._labels)
+
+    def drain_mode(self) -> None:
+        """Drain protocol (resilience/autoscale.py scale-in): from now on
+        every pending batch flushes IMMEDIATELY — the age window is
+        skipped, so in-flight handlers' ack-waits resolve without waiting
+        out `max_age_ms`, and `Service.drain()`'s wait-for-handlers can
+        never deadlock behind a long window. New `add()`s still work (a
+        handler mid-flight may add after this flips); they flush on the
+        next cycle."""
+        self._draining = True
+        self._wake.set()
 
     async def stop(self) -> None:
         """Flush-on-stop: everything pending lands (and its acks release)
@@ -166,7 +178,8 @@ class UpsertCoalescer:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
-            if self._pending_rows < self.max_rows and not self._closed:
+            if (self._pending_rows < self.max_rows and not self._closed
+                    and not self._draining):
                 # age window: give the next messages a chance to batch up
                 wait = self._oldest_t + self.max_age_s - time.monotonic()
                 if wait > 0:
@@ -175,12 +188,14 @@ class UpsertCoalescer:
                     except asyncio.TimeoutError:
                         pass
             trigger = ("stop" if self._closed
+                       else "drain" if self._draining
                        else "rows" if self._pending_rows >= self.max_rows
                        else "age")
             await self._flush(trigger)
 
     async def _sleep_until_full(self) -> None:
-        while self._pending_rows < self.max_rows and not self._closed:
+        while (self._pending_rows < self.max_rows and not self._closed
+               and not self._draining):
             self._wake.clear()
             await self._wake.wait()
 

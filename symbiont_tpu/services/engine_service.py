@@ -147,6 +147,14 @@ class EngineService(Service):
 
         self._warm_task = asyncio.create_task(warm(), name="fused-warmup")
 
+    async def drain(self) -> None:
+        # drain protocol (resilience/autoscale.py): immediate-flush mode
+        # first so in-flight upsert requests' reply-after-flush waits
+        # resolve without the age window — see VectorMemoryService.drain
+        if self._upsert_coalescer is not None:
+            self._upsert_coalescer.drain_mode()
+        await super().drain()
+
     async def stop(self) -> None:
         if self._warm_task is not None:
             self._warm_task.cancel()
@@ -294,8 +302,14 @@ class EngineService(Service):
             text = req["text"]
             if not isinstance(text, str):
                 raise ValueError("text must be a string")
+            # interactive lane: never FIFO a query behind the same
+            # tenant's bulk backlog (see preprocessing._handle_query_
+            # embedding; load_ramp measured the starvation)
+            from symbiont_tpu.engine.batcher import interactive_lane
+
             vecs = await self.batcher.embed(
-                [text], tenant=admission.tenant_of(msg.headers))
+                [text],
+                tenant=interactive_lane(admission.tenant_of(msg.headers)))
             return {"vector": np.asarray(vecs[0], np.float32).tolist(),
                     "model_name": self.engine.config.model_name}
         await self._handle(msg, "embed.query", op)

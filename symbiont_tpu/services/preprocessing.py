@@ -133,9 +133,15 @@ class PreprocessingService(Service):
             await self.bus.publish(msg.reply, to_json_bytes(err))
             return
         try:
+            # interactive lane (batcher.interactive_lane): the query must
+            # stride-interleave against this tenant's own bulk-ingest lane,
+            # not FIFO behind it — a deep ingest backlog otherwise turns
+            # every same-tenant search into a bus-timeout (load_ramp tier)
+            from symbiont_tpu.engine.batcher import interactive_lane
+
             vecs = await self.batcher.embed(
                 [task.text_to_embed],
-                tenant=admission.tenant_of(msg.headers))
+                tenant=interactive_lane(admission.tenant_of(msg.headers)))
             if frames.wants_frame(msg.headers):
                 # negotiated reply frame (X-Symbiont-Accept-Frame): the
                 # [1, dim] block rides appended to a schema-valid reply

@@ -77,6 +77,16 @@ class VectorMemoryService(Service):
             await self._coalescer.start()
         await super().start()
 
+    async def drain(self) -> None:
+        # drain protocol: flip the coalescer to immediate-flush FIRST, so
+        # the in-flight handlers stop() waits on resolve their
+        # ack-after-flush futures right away instead of waiting out a
+        # long age window — then the shared stop path (detach durable
+        # consumers → wait handlers → coalescer flush-on-stop) runs
+        if self._coalescer is not None:
+            self._coalescer.drain_mode()
+        await super().drain()
+
     async def stop(self) -> None:
         # order matters: super().stop() drains in-flight handlers first
         # (their ack-waits resolve via the still-running age flush), THEN

@@ -40,6 +40,16 @@ TASKS_GENERATION_CANCEL = "tasks.generation.cancel"
 # heartbeats out of durable-stream capture by convention.
 SYS_HEARTBEAT = "_sys.heartbeat"
 
+# elastic-autoscaler drain protocol (resilience/autoscale.py +
+# resilience/procsup.py scale_role): the supervisor publishes
+# `_sys.drain.<role>` to retire one replica gracefully — the runner stops
+# pulling new durable deliveries (detaching its consumers so unacked work
+# redelivers to the surviving group members), flushes its UpsertCoalescer,
+# finishes in-flight work, publishes a final heartbeat with
+# `draining: true`, and exits. The supervisor enforces a deadline: a hung
+# drain is SIGKILLed, and durable redelivery still loses nothing.
+SYS_DRAIN = "_sys.drain"
+
 # fleet telemetry plane (obs/fleet.py): each supervised role publishes
 # bounded, periodic metric-snapshot deltas and completed span records under
 # these prefixes (+ ".<role>"); the FleetAggregator in the API-role process

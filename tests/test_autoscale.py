@@ -1,0 +1,836 @@
+"""Elastic autoscaler + drain protocol (resilience/autoscale.py,
+ProcessSupervisor.scale_role).
+
+Three layers, mirroring how the plane is built:
+
+- pure policy units (injected clock + signals — no processes): bounds
+  parsing, the ops budget, scale-out dwell, scale-in clean passes, the
+  no-flap guarantee under an oscillating signal;
+- in-process drain-protocol units over the inproc durable bus: a drained
+  service detaches its durable consumers (new work goes to the surviving
+  group member only), the UpsertCoalescer flushes immediately in drain
+  mode, and the full runner stack drains end to end (flush + final
+  `draining: true` heartbeat + /readyz 503);
+- `-m chaos` scenarios with REAL OS processes over the pybroker: a
+  scale-out replica shards the durable queue group, a drained scale-in
+  loses nothing with traffic still flowing, a SIGKILL mid-drain loses
+  nothing (redelivery), a drain that exceeds its deadline is SIGKILLed
+  and still loses nothing, and a crash-looping worker parks in the
+  `crashlooped` state instead of restarting forever.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from symbiont_tpu.config import AutoscaleConfig
+from symbiont_tpu.resilience.autoscale import (
+    Autoscaler,
+    OpsBudget,
+    RoleSignals,
+    parse_role_bounds,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ policy units
+
+
+def test_parse_role_bounds():
+    assert parse_role_bounds("") == {}
+    out = parse_role_bounds("embed=1:4, decode=2:2")
+    assert out["embed"].min == 1 and out["embed"].max == 4
+    assert out["decode"].min == 2 and out["decode"].max == 2
+    for bad in ("embed", "embed=4", "embed=0:4", "embed=3:2", "embed=a:b"):
+        with pytest.raises(ValueError):
+            parse_role_bounds(bad)
+    # the config section validates at construction (env-typo = boot error)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(roles="embed=0:3")
+    with pytest.raises(ValueError):
+        AutoscaleConfig(queue_high=4.0, queue_low=8.0)
+
+
+def test_ops_budget_sliding_window():
+    t = [0.0]
+    b = OpsBudget(2, 10.0, clock=lambda: t[0])
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()
+    assert b.remaining() == 0
+    t[0] = 10.5  # both ops age out of the window together
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()
+
+
+class _FakeWorker:
+    draining = False
+
+
+class _FakeSup:
+    """Records scale_role calls; replica bookkeeping like the real one."""
+
+    _broker_healthy = True
+
+    def __init__(self, roles=("embed",)):
+        self.calls = []
+        self.n = {r: 1 for r in roles}
+        self.drain_deadline_s = 30.0
+        self.workers = {}
+        self._sync()
+
+    def _sync(self):
+        self.workers = {}
+        for r, k in self.n.items():
+            for i in range(k):
+                name = r if i == 0 else f"{r}-{i + 1}"
+                self.workers[name] = _FakeWorker()
+
+    def replicas(self, role):
+        return [n for n in self.workers
+                if n == role or n.startswith(role + "-")]
+
+    async def scale_role(self, role, n):
+        self.calls.append((role, n))
+        self.n[role] = n
+        self._sync()
+
+
+def _policy(sup, sig, t, **over):
+    kw = dict(enabled=True, roles="embed=1:3", eval_s=0.1, queue_high=10.0,
+              queue_low=1.0, out_dwell_s=1.0, in_dwell_s=2.0,
+              in_clean_passes=2, budget_ops=4, budget_window_s=60.0,
+              drain_deadline_s=5.0)
+    kw.update(over)
+    cfg = AutoscaleConfig(**kw)
+    return Autoscaler(sup, cfg, signals=lambda b: sig, clock=lambda: t[0])
+
+
+def test_scale_out_respects_dwell_and_bounds():
+    t = [0.0]
+    sup = _FakeSup()
+    sig = {"embed": RoleSignals(queue_depth=50.0)}
+    a = _policy(sup, sig, t)
+
+    async def main():
+        await a.evaluate_once()               # first breach acts now
+        assert sup.calls == [("embed", 2)]
+        t[0] += 0.5
+        await a.evaluate_once()               # inside the dwell: holds
+        assert sup.calls == [("embed", 2)]
+        t[0] += 1.0
+        await a.evaluate_once()               # past the dwell: grows
+        assert sup.calls[-1] == ("embed", 3)
+        t[0] += 2.0
+        await a.evaluate_once()               # at max: holds
+        assert sup.calls[-1] == ("embed", 3)
+        assert a.flaps() == 0
+
+    asyncio.run(main())
+
+
+def test_scale_in_needs_consecutive_clean_passes_and_dwell():
+    t = [0.0]
+    sup = _FakeSup()
+    sup.n["embed"] = 3
+    sup._sync()
+    sig = {"embed": RoleSignals(queue_depth=0.5)}
+    a = _policy(sup, sig, t)
+
+    async def main():
+        t[0] += 10.0
+        await a.evaluate_once()               # clean pass 1: holds
+        assert sup.calls == []
+        # a noisy (dead-band) pass resets the streak
+        sig["embed"] = RoleSignals(queue_depth=5.0)
+        await a.evaluate_once()
+        sig["embed"] = RoleSignals(queue_depth=0.5)
+        await a.evaluate_once()               # clean 1 again
+        assert sup.calls == []
+        await a.evaluate_once()               # clean 2 + dwell: shrinks
+        assert sup.calls == [("embed", 2)]
+
+    asyncio.run(main())
+
+
+def test_oscillating_signal_never_flaps():
+    """The tentpole's hysteresis claim: breach, clear, breach, clear …
+    every pass — the fleet must park, not thrash spawn/drain cycles."""
+    t = [0.0]
+    sup = _FakeSup()
+    sig = {"embed": RoleSignals(queue_depth=50.0)}
+    a = _policy(sup, sig, t)
+
+    async def main():
+        for i in range(40):
+            hot = i % 2 == 0
+            sig["embed"] = RoleSignals(queue_depth=50.0 if hot else 0.0)
+            await a.evaluate_once()
+            t[0] += 0.3
+        # scale-outs may accumulate to max (each past its dwell), but the
+        # clean streak resets on every hot pass, so NOTHING scales in —
+        # and no reversal lands inside a hysteresis window
+        assert all(d == "out" for _, _, d, _ in a.decisions)
+        assert a.flaps() == 0
+
+    asyncio.run(main())
+
+
+def test_budget_exhaustion_blocks_scaling():
+    t = [0.0]
+    sup = _FakeSup()
+    sig = {"embed": RoleSignals(queue_depth=50.0)}
+    a = _policy(sup, sig, t, budget_ops=1)
+
+    async def main():
+        await a.evaluate_once()
+        assert sup.calls == [("embed", 2)]
+        t[0] += 5.0                            # past every dwell
+        await a.evaluate_once()                # budget empty: refused
+        assert sup.calls == [("embed", 2)]
+
+    asyncio.run(main())
+
+
+def test_broker_down_skips_the_pass():
+    t = [0.0]
+    sup = _FakeSup()
+    sup._broker_healthy = False
+    sig = {"embed": RoleSignals(queue_depth=50.0)}
+    a = _policy(sup, sig, t)
+
+    async def main():
+        await a.evaluate_once()   # stale signals + unpublishable drain
+        assert sup.calls == []
+
+    asyncio.run(main())
+
+
+# --------------------------------------------- drain protocol (in-process)
+
+
+def test_drain_detaches_durable_consumer_new_work_goes_to_survivor():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.services.base import Service
+
+    class Consumer(Service):
+        name = "toy"
+
+        def __init__(self, bus, seen):
+            super().__init__(bus)
+            self.seen = seen
+
+        async def _setup(self):
+            await self._subscribe_loop("job.*", self._handle, queue="g",
+                                       durable_stream="s")
+
+        async def _handle(self, msg):
+            self.seen.append(bytes(msg.data))
+
+    async def main():
+        bus = InprocBus()
+        await bus.add_stream("s", ["job.>"], ack_wait_s=0.2, max_deliver=10)
+        seen_a, seen_b = [], []
+        a, b = Consumer(bus, seen_a), Consumer(bus, seen_b)
+        await a.start()
+        await b.start()
+        for i in range(6):
+            await bus.publish(f"job.{i}", f"m{i}".encode())
+        deadline = time.monotonic() + 5
+        while len(seen_a) + len(seen_b) < 6 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        assert len(seen_a) + len(seen_b) == 6
+        await a.drain()
+        frozen = len(seen_a)
+        for i in range(6, 16):
+            await bus.publish(f"job.{i}", f"m{i}".encode())
+        deadline = time.monotonic() + 5
+        while len(seen_b) < 16 - frozen and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        # the drained member pulled NOTHING new; the survivor got it all,
+        # exactly once (no redelivery: every pre-drain handler acked)
+        assert len(seen_a) == frozen
+        assert sorted(seen_a + seen_b) == sorted(
+            f"m{i}".encode() for i in range(16))
+        await b.stop()
+        await a.stop()  # idempotent after drain
+        await bus.close()
+
+    asyncio.run(main())
+
+
+def test_coalescer_drain_mode_flushes_without_age_window():
+    from symbiont_tpu.services.coalesce import UpsertCoalescer
+
+    import numpy as np
+
+    flushed = []
+
+    def flush_fn(ids, rows, payloads):
+        flushed.append(list(ids))
+        return len(ids)
+
+    async def main():
+        c = UpsertCoalescer(flush_fn, max_rows=10_000,
+                            max_age_ms=60_000.0, name="t")
+        await c.start()
+        add = asyncio.create_task(
+            c.add(["a", "b"], np.zeros((2, 4), np.float32), [{}, {}]))
+        await asyncio.sleep(0.05)
+        assert not flushed  # neither rows nor age triggered
+        c.drain_mode()
+        n = await asyncio.wait_for(add, 2.0)  # resolves promptly
+        assert n == 2 and flushed == [["a", "b"]]
+        # adds DURING drain mode still flush (a handler mid-flight may
+        # land one after the flip)
+        n = await asyncio.wait_for(
+            c.add(["c"], np.zeros((1, 4), np.float32), [{}]), 2.0)
+        assert n == 1 and flushed[-1] == ["c"]
+        await c.stop()
+
+    asyncio.run(main())
+
+
+def test_heartbeat_payload_parse_tolerates_all_shapes():
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        WorkerSpec,
+        _Worker,
+    )
+
+    w = _Worker(WorkerSpec(role="r", argv=["true"]))
+    note = ProcessSupervisor._note_heartbeat_payload
+    note(w, b"")                       # toy workers beat empty payloads
+    assert not w.reported_draining and w.reported_capacity == 1.0
+    note(w, b"not json")
+    assert not w.reported_draining
+    note(w, json.dumps({"role": "r", "pid": 1}).encode())  # pre-field beat
+    assert not w.reported_draining and w.reported_capacity == 1.0
+    note(w, json.dumps({"role": "r", "pid": 1, "capacity": 0,
+                        "draining": True}).encode())
+    assert w.reported_draining and w.reported_capacity == 0.0
+
+
+def test_fleet_rollup_folds_draining_and_crashlooped():
+    from symbiont_tpu.obs.fleet import FleetAggregator
+    from symbiont_tpu.obs.trace_store import TraceStore
+    from symbiont_tpu.utils.telemetry import Metrics
+
+    agg = FleetAggregator(local_role="gateway", store=TraceStore(16),
+                          registry=Metrics())
+    agg.merge_metrics("procsup", {"full": True, "pid": 1, "metrics": {
+        'gauge.procsup.up{role="embed-2"}': 1.0,
+        'gauge.procsup.draining{role="embed-2"}': 1.0,
+        'gauge.procsup.crashlooped{role="embed-2"}': 0.0,
+        'counter.procsup.scale_out{role="embed"}': 2.0,
+        'counter.procsup.scale_in{role="embed"}': 1.0,
+        'counter.procsup.drain_timeouts{role="embed"}': 0.0,
+    }})
+    roles = agg.rollup()["roles"]
+    assert roles["embed-2"]["draining"] == 1.0
+    assert roles["embed-2"]["crashlooped"] == 0.0
+    assert roles["embed"]["scale_out"] == 2.0
+    assert roles["embed"]["scale_in"] == 1.0
+    assert roles["embed"]["drain_timeouts"] == 0.0
+
+
+# C++ gateway admission parity (common.hpp AdmissionGate): stub json
+# DECLARATIONS only — nothing odr-uses the inline json helpers, so this
+# compiles and RUNS on GCC 10 where the full native tree cannot build
+# (same harness stance as tests/test_fleet.py's heartbeat parity).
+CPP_ADMISSION_HARNESS = r"""
+#include <string>
+#include <vector>
+
+namespace json {
+struct Value {
+  std::string dump() const;
+  const Value& at(const std::string&) const;
+  bool is_null() const;
+  std::string as_string() const;
+  double as_number() const;
+  bool has(const std::string&) const;
+  const std::vector<Value>& as_array() const;
+};
+Value parse(const std::string&);
+}  // namespace json
+
+#include "services/common.hpp"
+#include <cassert>
+#include <cstdio>
+
+int main() {
+  setenv("SYMBIONT_ADMISSION_SEARCH_RATE", "2", 1);
+  setenv("SYMBIONT_ADMISSION_SEARCH_BURST", "3", 1);
+  setenv("SYMBIONT_ADMISSION_MAX_TENANTS", "2", 1);
+  symbiont::AdmissionGate g;
+  g.configure();
+  double ra = 0.0;
+  int64_t t = 0;
+  using G = symbiont::AdmissionGate;
+  // burst of 3, then refused with a refill-shaped Retry-After hint
+  assert(g.admit(G::SEARCH, "t0", &ra, t));
+  assert(g.admit(G::SEARCH, "t0", &ra, t));
+  assert(g.admit(G::SEARCH, "t0", &ra, t));
+  assert(!g.admit(G::SEARCH, "t0", &ra, t));
+  assert(ra > 0.0 && ra <= 0.5 + 1e-9);
+  // rate 2/s: one second later exactly two tokens are back
+  t += 1000;
+  assert(g.admit(G::SEARCH, "t0", &ra, t));
+  assert(g.admit(G::SEARCH, "t0", &ra, t));
+  assert(!g.admit(G::SEARCH, "t0", &ra, t));
+  // tenant universe bounded at 2 ("default" pre-seeded + t0): every
+  // fresh identity shares ONE overflow bucket — minting tenant headers
+  // buys no fresh burst (3 total across fresh-a/b/c, then refused)
+  assert(g.admit(G::SEARCH, "fresh-a", &ra, t));
+  assert(g.admit(G::SEARCH, "fresh-b", &ra, t));
+  assert(g.admit(G::SEARCH, "fresh-c", &ra, t));
+  assert(!g.admit(G::SEARCH, "fresh-d", &ra, t));
+  assert(g.tenant_overflows() >= 4);
+  std::printf("OK\n");
+  return 0;
+}
+"""
+
+
+def test_cpp_admission_gate_via_stub_json_harness(tmp_path):
+    import shutil
+    import tempfile  # noqa: F401
+
+    gxx = shutil.which("g++") or shutil.which("clang++")
+    if gxx is None:
+        pytest.skip("no C++ compiler on this host")
+    src = tmp_path / "adm.cpp"
+    src.write_text(CPP_ADMISSION_HARNESS)
+    exe = tmp_path / "adm"
+    proc = subprocess.run(
+        [gxx, "-std=c++17", "-O1", "-I", str(REPO / "native"),
+         str(src), "-o", str(exe)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        "the stub-json admission TU must compile even where json.hpp "
+        f"cannot (GCC 10):\n{proc.stderr[:2000]}")
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "OK"
+
+
+def test_runner_stack_drains_end_to_end():
+    """The worker half of the protocol in the REAL stack (stub engine,
+    inproc durable bus): a `_sys.drain.<role>` message stops durable
+    pulls, flushes the UpsertCoalescer (the pending row lands even with a
+    60s age window), publishes a final `draining: true` heartbeat, flips
+    the gateway's /readyz to 503, and wakes the drained event main()
+    exits on."""
+    import tempfile
+
+    import numpy as np
+
+    from symbiont_tpu import subjects
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.config import (
+        ApiConfig,
+        EngineConfig,
+        GraphStoreConfig,
+        SymbiontConfig,
+        TextGeneratorConfig,
+        VectorStoreConfig,
+    )
+    from symbiont_tpu.runner import SymbiontStack
+
+    class _ModelCfg:
+        hidden_size = 16
+
+    class StubEngine:
+        def __init__(self):
+            self.config = EngineConfig(embedding_dim=16, max_batch=16,
+                                       flush_deadline_ms=2.0)
+            self.model_cfg = _ModelCfg()
+            self.cross_params = None
+            self.stats = {"embed_calls": 0, "compiles": 0}
+
+        def embed_texts(self, texts):
+            return np.zeros((len(texts), 16), np.float32)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            cfg = SymbiontConfig(
+                vector_store=VectorStoreConfig(
+                    dim=16, data_dir=f"{td}/vs",
+                    # only the drain may flush: proves flush-on-drain
+                    coalesce_max_age_ms=60_000.0),
+                graph_store=GraphStoreConfig(data_dir=f"{td}/gs"),
+                text_generator=TextGeneratorConfig(markov_state_path=None),
+                api=ApiConfig(host="127.0.0.1", port=0))
+            cfg.runner.services = "perception,preprocessing,vector_memory,api"
+            cfg.runner.role = "worker"
+            cfg.runner.heartbeat_s = 0.1
+            cfg.bus.durable = True
+            bus = InprocBus()
+            beats = []
+            sub = await bus.subscribe(subjects.SYS_HEARTBEAT + ".>")
+
+            async def collect():
+                async for m in sub:
+                    beats.append(json.loads(m.data))
+
+            collector = asyncio.create_task(collect())
+            stack = SymbiontStack(
+                cfg, bus=bus, engine=StubEngine(),
+                fetcher=lambda url: "<html><p>one sentence.</p></html>")
+            await stack.start()
+            await asyncio.sleep(0.25)
+            assert beats and beats[0]["capacity"] == 1 \
+                and beats[0]["draining"] is False
+            from symbiont_tpu.utils.telemetry import metrics
+
+            base_msgs = metrics.get("coalesce.messages",
+                                    labels={"service": "vector_memory"})
+            await bus.publish(subjects.TASKS_PERCEIVE_URL,
+                              json.dumps({"url": "http://x/1"}).encode())
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if metrics.get("coalesce.messages",
+                               labels={"service": "vector_memory"}) \
+                        > base_msgs:
+                    break
+                await asyncio.sleep(0.02)
+            assert stack.vector_store.count() == 0  # parked in the window
+            await bus.publish(f"{subjects.SYS_DRAIN}.worker", b"{}")
+            await asyncio.wait_for(stack.drained.wait(), 10)
+            assert stack.vector_store.count() == 1  # flush-on-drain landed
+            final = [b for b in beats if b.get("draining")]
+            assert final and final[-1]["capacity"] == 0
+            assert stack.api._ready is False  # /readyz went 503 first
+            await stack.stop()
+            await bus.close()
+            collector.cancel()
+
+    asyncio.run(main())
+
+
+# -------------------------------------------------- chaos (real processes)
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _connect(port):
+    from symbiont_tpu.bus.tcp import TcpBus
+
+    bus = TcpBus("127.0.0.1", port)
+    await bus.connect()
+    return bus
+
+
+# A drain-aware durable consumer worker (no jax import: boots fast). argv:
+# port, out_path, role, drain_mode (clean|slow|ignore). It consumes the
+# "w" stream in queue group "g" (fsync-before-ack), beats with the real
+# payload shape, and on `_sys.drain.<role>` runs the worker half of the
+# protocol: detach the durable consumer, final draining beat, exit 0.
+_DRAIN_WORKER = """
+import asyncio, json, os, sys, time
+from pathlib import Path
+from symbiont_tpu.bus.connect import connect
+
+PORT, OUT, MODE = int(sys.argv[1]), Path(sys.argv[2]), sys.argv[4]
+# replicas spawned by scale_role inherit the base argv but carry their own
+# identity in SYMBIONT_RUNNER_ROLE (procsup._replica_spec) — same contract
+# as the real runner
+ROLE = os.environ.get("SYMBIONT_RUNNER_ROLE") or sys.argv[3]
+
+def payload(draining):
+    return json.dumps({"role": ROLE, "pid": os.getpid(),
+                       "capacity": 0 if draining else 1,
+                       "draining": draining}).encode()
+
+async def main():
+    bus = await connect("symbus://127.0.0.1:%d" % PORT)
+    await bus.add_stream("w", ["job.>"], ack_wait_s=0.5, max_deliver=50)
+    sub = await bus.durable_subscribe("w", "g")
+    drain_sub = await bus.subscribe("_sys.drain." + ROLE)
+    draining = asyncio.Event()
+
+    async def beat():
+        while True:
+            await bus.publish("_sys.heartbeat." + ROLE,
+                              payload(draining.is_set()))
+            await asyncio.sleep(0.15)
+
+    async def drain_watch():
+        await drain_sub.next(None)
+        draining.set()
+
+    hb = asyncio.get_running_loop().create_task(beat())
+    dw = asyncio.get_running_loop().create_task(drain_watch())
+    while not draining.is_set():
+        msg = await sub.next(0.1)
+        if msg is None:
+            continue
+        with open(OUT, "a") as f:
+            f.write(msg.data.decode() + chr(10))
+            f.flush()
+            os.fsync(f.fileno())
+        await bus.ack(msg)
+    if MODE == "ignore":
+        # a truly WEDGED drain: deaf to the bus request AND to the
+        # supervisor's SIGTERM escalation — only the deadline SIGKILL
+        # can clear it
+        import signal as _signal
+        _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
+        while True:
+            await asyncio.sleep(1)
+    sub.close()              # detach: unacked work redelivers elsewhere
+    if MODE == "slow":
+        await asyncio.sleep(3.0)     # mid-drain SIGKILL window
+    await bus.publish("_sys.heartbeat." + ROLE, payload(True))
+    await bus.flush()
+    sys.exit(0)
+
+asyncio.run(main())
+"""
+
+
+def _drain_spec(port: int, out, role: str, mode: str = "clean",
+                timeout_s: float = 3.0):
+    from symbiont_tpu.resilience.procsup import WorkerSpec
+
+    return WorkerSpec(
+        role=role,
+        argv=[sys.executable, "-c", _DRAIN_WORKER, str(port), str(out),
+              role, mode],
+        heartbeat_timeout_s=timeout_s, boot_grace_s=30.0,
+        backoff_base_s=0.1, backoff_max_s=1.0)
+
+
+def _landed(out) -> set:
+    return set(out.read_text().splitlines()) if out.exists() else set()
+
+
+@pytest.mark.chaos
+def test_scale_out_shards_group_and_drained_scale_in_loses_nothing(
+        tmp_path):
+    """The full elastic cycle with real processes: scale_role(2) spawns a
+    replica that joins the durable queue group (fan-in free), scale_role(1)
+    retires it through the drain protocol WHILE traffic still flows, and
+    every message lands exactly once."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        out = tmp_path / "landed.txt"
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL,
+                                drain_deadline_s=10.0)
+        sup.add_worker(_drain_spec(port, out, "embed"))
+        await sup.start()
+        pub = await _connect(port)
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("embed", after=t0 - 1, timeout_s=30)
+            r = await sup.scale_role("embed", 2)
+            assert r["added"] == ["embed-2"]
+            assert sup.replicas("embed") == ["embed", "embed-2"]
+            await sup.wait_role_up("embed-2", after=t0, timeout_s=30)
+            for i in range(20):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+            deadline = time.monotonic() + 15
+            while len(_landed(out)) < 20 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert len(_landed(out)) == 20
+
+            # retire the replica with traffic STILL flowing: messages in
+            # flight during the drain redeliver to the survivor
+            scale_in = asyncio.create_task(sup.scale_role("embed", 1))
+            for i in range(20, 40):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+                await asyncio.sleep(0.01)
+            r = await scale_in
+            assert r["drained"] == ["embed-2"]
+            assert sup.replicas("embed") == ["embed"]
+            want = {f"m{i}" for i in range(40)}
+            deadline = time.monotonic() + 20
+            while not want <= _landed(out) \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            assert want <= _landed(out), sorted(want - _landed(out))
+        finally:
+            await pub.close()
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_drain_loses_nothing(tmp_path):
+    """The ISSUE's kill-chaos-during-resize scenario: a worker is
+    SIGKILLed in the middle of its drain (consumer already detached,
+    process still flushing). Its unacked deliveries redeliver to the
+    surviving replica — exact zero loss."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        out = tmp_path / "landed.txt"
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL,
+                                drain_deadline_s=15.0)
+        sup.add_worker(_drain_spec(port, out, "embed"))
+        await sup.start()
+        pub = await _connect(port)
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("embed", after=t0 - 1, timeout_s=30)
+            # the replica being retired drains SLOWLY (3s between detach
+            # and exit) — the SIGKILL window
+            from symbiont_tpu.resilience.procsup import WorkerSpec  # noqa
+            spec = _drain_spec(port, out, "embed", mode="clean")
+            slow = _drain_spec(port, out, "embed-2", mode="slow")
+            slow.base_role = "embed"
+            sup.add_worker(slow)
+            w2 = sup.workers["embed-2"]
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, sup._spawn, w2)
+            w2.task = asyncio.create_task(sup._monitor(w2))
+            await sup.wait_role_up("embed-2", after=t0, timeout_s=30)
+            for i in range(30):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+            await asyncio.sleep(0.5)  # some in flight, some landed
+            scale_in = asyncio.create_task(sup.scale_role("embed", 1))
+            await asyncio.sleep(1.0)  # drain started, worker in its sleep
+            pid = sup.pid("embed-2")
+            if pid is not None:
+                os.kill(pid, signal.SIGKILL)
+            await scale_in
+            want = {f"m{i}" for i in range(30)}
+            deadline = time.monotonic() + 20
+            while not want <= _landed(out) \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            assert want <= _landed(out), sorted(want - _landed(out))
+        finally:
+            await pub.close()
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_drain_deadline_exceeded_sigkills_and_redelivers(tmp_path):
+    """A worker that IGNORES the drain request: the supervisor's deadline
+    SIGKILLs it (counted in procsup.drain_timeouts), its unacked work
+    redelivers, and nothing is lost."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+    from symbiont_tpu.utils.telemetry import metrics
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        out = tmp_path / "landed.txt"
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL,
+                                drain_deadline_s=1.5)
+        sup.add_worker(_drain_spec(port, out, "embed"))
+        await sup.start()
+        pub = await _connect(port)
+        try:
+            t0 = time.monotonic()
+            await sup.wait_role_up("embed", after=t0 - 1, timeout_s=30)
+            stubborn = _drain_spec(port, out, "embed-2", mode="ignore")
+            stubborn.base_role = "embed"
+            sup.add_worker(stubborn)
+            w2 = sup.workers["embed-2"]
+            await asyncio.get_running_loop().run_in_executor(
+                None, sup._spawn, w2)
+            w2.task = asyncio.create_task(sup._monitor(w2))
+            await sup.wait_role_up("embed-2", after=t0, timeout_s=30)
+            for i in range(20):
+                await pub.publish(f"job.{i}", f"m{i}".encode())
+            await asyncio.sleep(0.3)
+            before = metrics.get("procsup.drain_timeouts",
+                                 labels={"role": "embed-2"}) or 0
+            t_drain = time.monotonic()
+            r = await sup.scale_role("embed", 1)
+            assert r["drained"] == ["embed-2"]
+            # deadline enforced: the wait did not exceed ~deadline + slack
+            assert time.monotonic() - t_drain < 10
+            assert metrics.get("procsup.drain_timeouts",
+                               labels={"role": "embed-2"}) == before + 1
+            assert "embed-2" not in sup.workers
+            want = {f"m{i}" for i in range(20)}
+            deadline = time.monotonic() + 20
+            while not want <= _landed(out) \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            assert want <= _landed(out), sorted(want - _landed(out))
+        finally:
+            await pub.close()
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_restart_storm_parks_worker_crashlooped(tmp_path):
+    """A worker whose argv dies instantly: after storm_max_restarts inside
+    the window it PARKS (crashlooped=True, procsup.crashlooped=1, no more
+    respawns) instead of fork/exec'ing forever."""
+    from symbiont_tpu.bus.pybroker import PyBroker
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        WorkerSpec,
+    )
+    from symbiont_tpu.utils.telemetry import metrics
+
+    async def main():
+        broker = PyBroker(port=0, data_dir=str(tmp_path / "bus"))
+        await broker.start()
+        port = broker.bound_port
+        sup = ProcessSupervisor(bus_url=f"symbus://127.0.0.1:{port}",
+                                stdio=subprocess.DEVNULL,
+                                storm_max_restarts=3, storm_window_s=60.0,
+                                crashloop_cooloff_s=600.0)
+        sup.add_worker(WorkerSpec(
+            role="broken", argv=[sys.executable, "-c", "raise SystemExit(1)"],
+            backoff_base_s=0.05, backoff_max_s=0.1))
+        await sup.start()
+        try:
+            deadline = time.monotonic() + 20
+            w = sup.workers["broken"]
+            while not w.crashlooped and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert w.crashlooped, f"restarts={w.restarts}"
+            assert metrics.gauge_get("procsup.crashlooped",
+                                     labels={"role": "broken"}) == 1
+            parked_at = sup.restarts("broken")
+            assert parked_at == 3
+            await asyncio.sleep(1.0)
+            # parked: the restart counter stays frozen during the cool-off
+            assert sup.restarts("broken") == parked_at
+        finally:
+            await sup.stop()
+            await broker.stop()
+
+    asyncio.run(main())

@@ -679,5 +679,8 @@ def test_cpp_heartbeat_parity_via_stub_json_harness():
         assert parsed["role"] == "text_generator"
         assert isinstance(parsed["pid"], int) and parsed["pid"] > 0
         # byte parity with the Python runner's heartbeat payload
+        # (runner._heartbeat_payload: capacity/draining are the elastic-
+        # autoscaler fields; the C++ shells always beat serving)
         assert payload == json.dumps({"role": "text_generator",
-                                      "pid": parsed["pid"]})
+                                      "pid": parsed["pid"],
+                                      "capacity": 1, "draining": False})
