@@ -47,6 +47,10 @@ else
   # become the doc's source) — exactly right for a gate probe. The
   # embed-policy tier is deliberately NOT in the default set: it needs a
   # real device to be meaningful and takes minutes of CPU without one.
+  # The obs tier's primaries cover the whole telemetry hot path: span
+  # exits, critical-path compute, fleet merge, AND the engine-timeline
+  # record cost every decode chunk boundary pays
+  # (obs_timeline_record_per_s).
   TIERS="${PERF_GATE_TIERS:-obs,serialization}"
   echo "perf_gate: running host-only micro-tiers (bench.py --only $TIERS)" >&2
   if ! python bench.py --only "$TIERS" ${PERF_GATE_ARGS:-} > "$CANDIDATE"; then

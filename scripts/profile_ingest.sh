@@ -13,8 +13,52 @@
 #       its critical path — per-hop self-times, the dominant-hop verdict,
 #       and gap_ms (untraced time: bus queueing / scheduling / span-less
 #       native hops). A growing gap_ms is host overlap regressing.
+#
+#   scripts/profile_ingest.sh --decode [host:port]   # against a RUNNING
+#       stack (default localhost:8080): print the newest engine-timeline
+#       summary (GET /api/engine/timeline, obs/engine_timeline.py) the way
+#       the ingest mode prints hop self-times — decode batch occupancy,
+#       stranded KV rows, prefix share, TTFT/TPOT, embed packing
+#       opportunity, and the dominant-stall verdict.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--decode" ]; then
+  python3 - "${2:-localhost:8080}" <<'EOF'
+import json
+import sys
+import urllib.request
+
+api = sys.argv[1]
+with urllib.request.urlopen(f"http://{api}/api/engine/timeline",
+                            timeout=10) as r:
+    s = json.load(r)["summary"]
+if not s["decode_steps"] and not s["embed_flushes"]:
+    sys.exit("no engine timeline recorded yet — drive some embed/decode "
+             "traffic first")
+print(f"engine timeline window: {s['decode_steps']} decode steps, "
+      f"{s['decode_admits']} admits, {s['decode_finishes']} finishes, "
+      f"{s['decode_cancels']} cancels, {s['embed_flushes']} embed flushes")
+rows = [
+    ("decode batch occupancy", f"{s['decode_occupancy_pct']}%"),
+    ("stranded KV rows", f"{s['decode_kv_stranded_pct']}% of allocated"),
+    ("prompt prefix share", f"{s['decode_prefix_share_pct']}%"),
+    ("TTFT p50 / p99", f"{s['decode_ttft_ms_p50']} / "
+                       f"{s['decode_ttft_ms_p99']} ms"),
+    ("TPOT p50", f"{s['decode_tpot_ms_p50']} ms/token"),
+    ("prefill vs decode wall", f"{s['decode_prefill_ms_total']} / "
+                               f"{s['decode_step_ms_total']} ms"),
+    ("embed packing opportunity", f"{s['packing_opportunity_pct']}%"),
+]
+for name, val in rows:
+    print("  " + name.ljust(28) + val)
+print("dominant stall:", s["dominant_stall"])
+print(f"(Perfetto view: curl http://{api}"
+      "'/api/engine/timeline?fmt=chrome' > tl.json, open in "
+      "ui.perfetto.dev)")
+EOF
+  exit 0
+fi
 
 if [ $# -ge 1 ]; then
   python3 - "$1" <<'EOF'

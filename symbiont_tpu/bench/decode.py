@@ -176,3 +176,65 @@ def tier_streaming(results: dict, ctx) -> None:
     results["stream_total_128_s"] = round(best_total, 2)
     log(f"streaming (GPT-2 geom, prompt 64, 128 new, chunk 16): first text "
         f"delta {best_first * 1000:.0f}ms, full stream {best_total:.2f}s")
+
+
+@register("decode_timeline")
+def tier_decode_timeline(results: dict, ctx) -> None:
+    """Decode-plane flight recorder under a REAL continuous-batching
+    session mix (obs/engine_timeline.py): a GenBatcher over a small
+    synthetic LM serves a first wave of shared-prefix requests plus a
+    second wave that ADMITS mid-flight, then the tier archives the
+    timeline's summary — per-step batch occupancy, KV rows stranded by
+    the dense slabs, the prefix-share the radix cache of ROADMAP item 2
+    would exploit, and engine-side TTFT/TPOT. These are the measured
+    'before' numbers every paged-KV / shared-prefix / packing PR moves."""
+    import asyncio
+
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.batcher import GenBatcher
+    from symbiont_tpu.engine.lm import LmEngine
+    from symbiont_tpu.obs.engine_timeline import engine_timeline
+
+    engine_timeline.clear()  # the window must be THIS tier's traffic
+    eng = LmEngine(LmConfig(
+        enabled=True, arch="gpt2", hidden_size=128, num_layers=2,
+        num_heads=2, intermediate_size=256, max_positions=256,
+        dtype="float32", prompt_buckets=[32], new_token_buckets=[32],
+        stream_chunk=8, gen_max_batch=8, gen_flush_deadline_ms=5.0,
+        session_min_rows=4, temperature=0.0))
+    shared = "symbiont rag template: answer from the retrieved context. "
+
+    async def drive() -> None:
+        batcher = GenBatcher(eng)
+        await batcher.start()
+        try:
+            wave1 = [asyncio.ensure_future(batcher.generate(
+                shared + f"query {i}", 24, tenant=f"t{i % 2}"))
+                for i in range(4)]
+            await asyncio.sleep(0.05)  # wave 2 lands mid-decode: admission
+            wave2 = [asyncio.ensure_future(batcher.generate(
+                shared + f"late {i}", 8, tenant="t2"))
+                for i in range(3)]
+            done = await asyncio.gather(*wave1, *wave2)
+            assert all(isinstance(t, str) for t in done), done
+        finally:
+            await batcher.close()
+
+    asyncio.run(drive())
+    s = engine_timeline.summary()
+    if not s["decode_steps"]:
+        raise RuntimeError("decode session recorded no timeline steps")
+    results["decode_occupancy_pct"] = s["decode_occupancy_pct"]
+    results["decode_kv_stranded_pct"] = s["decode_kv_stranded_pct"]
+    results["decode_prefix_share_pct"] = s["decode_prefix_share_pct"]
+    results["decode_ttft_ms_p50"] = s["decode_ttft_ms_p50"]
+    results["decode_tpot_ms_p50"] = s["decode_tpot_ms_p50"]
+    results["decode_timeline_steps"] = s["decode_steps"]
+    results["decode_timeline_admits"] = s["decode_admits"]
+    log(f"decode timeline: {s['decode_steps']} steps, occupancy "
+        f"{s['decode_occupancy_pct']}%, stranded KV "
+        f"{s['decode_kv_stranded_pct']}%, prefix share "
+        f"{s['decode_prefix_share_pct']}%, TTFT p50 "
+        f"{s['decode_ttft_ms_p50']}ms, TPOT p50 "
+        f"{s['decode_tpot_ms_p50']}ms; dominant stall: "
+        f"{s['dominant_stall']}")

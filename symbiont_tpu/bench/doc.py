@@ -404,6 +404,7 @@ vs the JSON-equivalent bytes they displaced, plus encode/decode seconds.
     multichip_section = _render_multichip(f)
     overlap_section = _render_overlap(f)
     load_section = _render_load(f)
+    decode_timeline_section = _render_decode_timeline(f)
     attribution_section = _render_attribution(r, f)
 
     mfu768 = ""
@@ -514,7 +515,7 @@ tries the fused `engine.query.search` hop first (for
 back to the reference's 2-hop orchestration when engine and store are not
 co-located.
 
-{frames_section}{quant_section}{multichip_section}{overlap_section}{load_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
+{frames_section}{quant_section}{multichip_section}{overlap_section}{load_section}{decode_timeline_section}{e2e_section}{attribution_section}{roofline_section}## Where the embedding win comes from (SURVEY.md §5.7/§7)
 
 1. **Length-bucketed static shapes** — the reference pads every sentence to
    the model max (514); the mixed-length corpus here pads to {{64, 128}}.
@@ -745,6 +746,43 @@ proves:
         f"escalated to rung {_fmt(f.get('load_ladder_max_level', 0))} and "
         f"recovered={bool(f.get('load_ladder_recovered', 0))}")
     return header + measured + ".\n\n" + autoscale
+
+
+def _render_decode_timeline(f: dict) -> str:
+    """The decode-plane flight-recorder section (obs/engine_timeline.py,
+    the `decode_timeline` tier): prose is archive-agnostic, the measured
+    sentence appears once a run archives the tier's fields — the 'before'
+    numbers ROADMAP items 2-3 (paged KV, shared-prefix cache, packing)
+    will move."""
+    header = """## Decode-plane flight recorder (the paged-KV / radix-cache baseline)
+
+The `decode_timeline` tier drives a real continuous-batching session mix
+(shared-prefix request waves, mid-flight admissions) through GenBatcher
+and archives the engine timeline's summary (`obs/engine_timeline.py`,
+served live at `GET /api/engine/timeline`): per-step batch occupancy, the
+KV rows stranded by dense max-length slabs (`lm.kv_stranded_rows` — what
+a paged layout reclaims), the prompt prefix share a radix cache would
+prefill once (`lm.prefix_share_ratio`), engine-side TTFT/TPOT, and the
+embed-side packing opportunity. Every decode-plane PR of ROADMAP items
+2-3 measures itself against these fields.
+
+"""
+    if "decode_occupancy_pct" not in f:
+        return header + (
+            "This archive predates the decode-timeline tier, so its "
+            "measured fields (`decode_occupancy_pct`, "
+            "`decode_kv_stranded_pct`, `decode_prefix_share_pct`, "
+            "`decode_ttft_ms_p50`, `decode_tpot_ms_p50`) will appear from "
+            "the next full `python bench.py` run.\n\n")
+    return header + (
+        f"Measured this run over "
+        f"{_fmt(f.get('decode_timeline_steps', 0))} decode steps / "
+        f"{_fmt(f.get('decode_timeline_admits', 0))} admissions: batch "
+        f"occupancy **{f['decode_occupancy_pct']} %**, stranded KV rows "
+        f"**{f['decode_kv_stranded_pct']} %** of allocated slabs, prompt "
+        f"prefix share **{f['decode_prefix_share_pct']} %**, TTFT p50 "
+        f"{f.get('decode_ttft_ms_p50', '—')} ms, TPOT p50 "
+        f"{f.get('decode_tpot_ms_p50', '—')} ms/token.\n\n")
 
 
 def _render_autoscale(f: dict) -> str:

@@ -201,6 +201,17 @@ async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
         await stack.start()
         loop = asyncio.get_running_loop()
         port = stack.api.port
+        # host CPU context for the whole chaos window (bench/sampler.py —
+        # PR 1's resource sampler, now wired into the chaos tiers too):
+        # the in-proc stack is one process, so the decomposition is the
+        # driving process itself (engine_host) + wall, enough to tell "the
+        # SLO numbers above ran on a saturated host core" from "idle host"
+        from symbiont_tpu.bench.sampler import (
+            ResourceSampler,
+            archive_decomposition,
+        )
+
+        sampler = ResourceSampler({}).start()
 
         # the load generator gets ITS OWN thread pool: a storm of blocking
         # HTTP clients on the default executor would starve the very embed
@@ -503,6 +514,10 @@ async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
             if queued != 0:
                 raise RuntimeError(
                     f"fair queue not drained at end of run: {queued}")
+
+            # host CPU decomposition over the whole simulated-traffic
+            # window (load_cpu_s_engine_host / load_host_cpu_utilization)
+            archive_decomposition(results, "load", sampler.stop())
         finally:
             sse_task.cancel()
             client_pool.shutdown(wait=False)
@@ -854,6 +869,22 @@ async def _drive_multiproc(results: dict, load_seed: int,
                 f"{broker_recovered:.2f}s")
 
             # ---- phase F: search storm, one hot tenant -----------------
+            # per-process resource sampler (bench/sampler.py) over the
+            # storm window: pids are re-read AFTER the kill chaos so every
+            # role's restarted process is the one accounted — the chaos
+            # tiers finally archive host CPU + broker bus-bytes context
+            from symbiont_tpu.bench.sampler import (
+                ResourceSampler,
+                archive_decomposition,
+            )
+
+            roles = {}
+            for role in ("broker", "gateway", "perception", "embed",
+                         "memory", "graphgen"):
+                pid = sup.pid(role)
+                if pid is not None:
+                    roles[role] = [pid]
+            sampler = ResourceSampler(roles).start()
             lat_ms: list = []
             admitted = {t: 0 for t in tenants + [HOT_TENANT]}
             throttled = {t: 0 for t in tenants + [HOT_TENANT]}
@@ -883,6 +914,9 @@ async def _drive_multiproc(results: dict, load_seed: int,
             t2 = time.monotonic()
             await asyncio.gather(*storm)
             storm_s = time.monotonic() - t2
+            # per-role host CPU + broker bus bytes over the storm window
+            # (load_mp_storm_cpu_s_<role>, load_mp_storm_bus_mb_per_s)
+            archive_decomposition(results, "load_mp_storm", sampler.stop())
             lat_ms.sort()
             n_429 = sum(throttled.values())
             fairness = jain_index(admitted.values())

@@ -21,6 +21,10 @@ seeded synthetic load:
   metric-delta and span-batch messages, obs/fleet.py) — the aggregation
   hot path every federated scrape and stitched trace rides in a
   multi-process deployment.
+- `obs_timeline_record_per_s` (primary, higher is better): engine-
+  timeline decode-step records per second (obs/engine_timeline.py) — the
+  cost EVERY decode chunk boundary now pays; a regression here is decode
+  TPOT inflation wearing an observability costume.
 
 All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
@@ -124,11 +128,16 @@ def build_fleet_stream() -> list:
     return msgs
 
 
+TIMELINE_EVENTS = 4000   # timeline records per throughput sample
+
+
 @register("obs", primary_metrics=("obs_span_record_per_s",
                                   "obs_critical_path_512_ms",
-                                  "obs_fleet_merge_per_s"), quick=True)
+                                  "obs_fleet_merge_per_s",
+                                  "obs_timeline_record_per_s"), quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
+    from symbiont_tpu.obs.engine_timeline import EngineTimeline
     from symbiont_tpu.obs.fleet import FleetAggregator
     from symbiont_tpu.obs.trace_store import TraceStore
     from symbiont_tpu.utils.telemetry import Metrics, span
@@ -192,6 +201,29 @@ def tier_obs(results: dict, ctx) -> None:
     stats.record(results, "obs_fleet_merge_per_s",
                  [one_merge_sample() for _ in range(REPEATS)], digits=0)
 
+    # ---- engine-timeline record throughput (the decode-chunk-boundary
+    # hot path, obs/engine_timeline.py): private instance + registry so
+    # the sample neither reads nor pollutes the process-global plane
+    def one_timeline_sample() -> float:
+        tl = EngineTimeline(capacity=4096, registry=Metrics())
+        t0 = time.perf_counter()
+        for i in range(TIMELINE_EVENTS):
+            tl.note_decode_step(wall_ms=2.0, rows_live=(i % 8) + 1,
+                                rows_capacity=8, kv_rows_live=(i % 8) + 1,
+                                kv_rows_allocated=16, steps=16)
+        return TIMELINE_EVENTS / (time.perf_counter() - t0)
+
+    one_timeline_sample()  # warm
+    stats.record(results, "obs_timeline_record_per_s",
+                 [one_timeline_sample() for _ in range(REPEATS)], digits=0)
+    # the summary over a full ring is the endpoint's cost — assert it
+    # computes (its latency rides the API, not the decode hot path)
+    tl = EngineTimeline(capacity=4096, registry=Metrics())
+    for i in range(4096):
+        tl.note_decode_step(wall_ms=2.0, rows_live=4, rows_capacity=8,
+                            kv_rows_live=4, kv_rows_allocated=8, steps=16)
+    assert tl.summary()["decode_steps"] == 4096
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -203,4 +235,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_critical_path_512_ms_max']:.2f}]; fleet merge "
         f"{results['obs_fleet_merge_per_s']:.0f} msg/s "
         f"[{results['obs_fleet_merge_per_s_min']:.0f}–"
-        f"{results['obs_fleet_merge_per_s_max']:.0f}]")
+        f"{results['obs_fleet_merge_per_s_max']:.0f}]; timeline record "
+        f"{results['obs_timeline_record_per_s']:.0f}/s "
+        f"[{results['obs_timeline_record_per_s_min']:.0f}–"
+        f"{results['obs_timeline_record_per_s_max']:.0f}]")

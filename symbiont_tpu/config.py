@@ -353,8 +353,31 @@ class ObsConfig:
 
     # span records kept in the in-process flight recorder ring
     trace_capacity: int = 4096
+    # Tail-based trace retention (obs/trace_store.py): errored /
+    # SLO-breach-exemplar / slowest-decile traces PIN into a bounded
+    # keep-set the ring's FIFO churn cannot evict (up to trace_keep_traces
+    # of them), while healthy traces sample at trace_sample_rate (1.0 =
+    # record every trace, the historical behavior; 0.1 = every 10th new
+    # trace — pinned traces always record in full).
+    trace_sample_rate: float = 1.0
+    trace_keep_traces: int = 64
+    # Decode-plane flight recorder (obs/engine_timeline.py): per-step
+    # engine events kept in the bounded timeline ring (0 disables
+    # recording), and how many recent prompt prefixes the admission-time
+    # prefix-share probe compares against (lm.prefix_share_ratio).
+    timeline_capacity: int = 2048
+    timeline_prompt_window: int = 64
+    # Per-tenant usage metering (obs/usage.py): distinct tenant identities
+    # the ledger tracks — past the bound, new identities share the
+    # "(overflow)" ledger (the admission plane's resolve_tenant stance).
+    usage_max_tenants: int = 1024
     # seconds between SLO evaluations
     slo_interval_s: float = 10.0
+    # two-window burn rates on SLO breach events (obs/watchdog.py): the
+    # fast window catches a blip, the slow window proves a sustained burn
+    # — the discriminator the elastic autoscaler's SLO signal reads
+    slo_burn_fast_s: float = 60.0
+    slo_burn_slow_s: float = 600.0
     # "span_name=p99_ms" entries evaluated against span.<name>.ms histograms
     slo_p99_ms: List[str] = field(default_factory=list)
     # cumulative-bucket upper bounds (`le`, in ms) for the span-duration
@@ -393,8 +416,23 @@ class ObsConfig:
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
             raise ValueError("obs.trace_capacity must be >= 1")
+        if not 0.0 < self.trace_sample_rate <= 1.0:
+            raise ValueError("obs.trace_sample_rate must be in (0, 1]")
+        if self.trace_keep_traces < 1:
+            raise ValueError("obs.trace_keep_traces must be >= 1")
+        if self.timeline_capacity < 0:
+            raise ValueError("obs.timeline_capacity must be >= 0")
+        if self.timeline_prompt_window < 1:
+            raise ValueError("obs.timeline_prompt_window must be >= 1")
+        if self.usage_max_tenants < 1:
+            raise ValueError("obs.usage_max_tenants must be >= 1")
         if self.slo_interval_s <= 0:
             raise ValueError("obs.slo_interval_s must be positive")
+        if self.slo_burn_fast_s <= 0 \
+                or self.slo_burn_slow_s < self.slo_burn_fast_s:
+            raise ValueError(
+                "obs.slo_burn_fast_s must be positive and <= "
+                "obs.slo_burn_slow_s")
         if self.fleet_publish_s <= 0:
             raise ValueError("obs.fleet_publish_s must be positive")
         for name in ("fleet_spans_max", "fleet_pending_max",

@@ -34,6 +34,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from symbiont_tpu.engine.engine import TpuEngine
+from symbiont_tpu.obs.engine_timeline import engine_timeline
+from symbiont_tpu.obs.usage import usage
 from symbiont_tpu.resilience.admission import (
     DEFAULT_TENANT,
     OVERFLOW_TENANT,
@@ -384,6 +386,10 @@ class _BatcherBase:
             metrics.observe("batcher.flush_fill_ratio", fill, labels=labels)
             metrics.gauge_set("batcher.last_flush_fill_ratio", round(fill, 4),
                               labels=labels)
+            # decode-plane flight recorder: queue depth AFTER the take —
+            # the backlog a flush boundary leaves behind, on the same time
+            # axis as the step/flush counters (obs/engine_timeline.py)
+            engine_timeline.note_queue_depth(self.kind, self._queued)
         return taken
 
     async def _run(self) -> None:
@@ -500,10 +506,22 @@ class MicroBatcher(_BatcherBase):
     def _size(self, item: _Pending) -> int:
         return len(item.texts)
 
+    @staticmethod
+    def _usage_tenant(lane: str) -> str:
+        """The BILLING identity behind a fairness lane: interactive lanes
+        ('<tenant>#q') charge the tenant itself — the lane split is a
+        scheduling detail, not a second customer."""
+        if lane.endswith(INTERACTIVE_LANE_SUFFIX):
+            return lane[: -len(INTERACTIVE_LANE_SUFFIX)] or DEFAULT_TENANT
+        return lane
+
     async def _flush(self, batch: List) -> None:
         texts: List[str] = []
         for p in batch:
             texts.extend(p.texts)
+            # usage ledger (obs/usage.py): embed rows billed per tenant at
+            # the flush that carries them
+            usage.note(self._usage_tenant(p.tenant), embed_rows=len(p.texts))
         try:
             # off the event loop: the forward is CPU/TPU-bound
             vecs = await asyncio.get_running_loop().run_in_executor(
@@ -632,7 +650,8 @@ class GenBatcher(_BatcherBase):
                     None, lambda g=group: self.lm.start_session(
                         [p.prompt for p in g], [p.max_new for p in g],
                         temperature=[p.temperature for p in g],
-                        top_k=[p.top_k for p in g]))
+                        top_k=[p.top_k for p in g],
+                        tenants=[p.tenant for p in g]))
                 self.stats["sessions"] += 1
                 for tag, p in zip((r.tag for r in sess.rows if r is not None),
                                   group):
@@ -801,4 +820,5 @@ class GenBatcher(_BatcherBase):
         return sess.prepare_admit([p.prompt for p in take],
                                   [p.max_new for p in take],
                                   temperature=[p.temperature for p in take],
-                                  top_k=[p.top_k for p in take])
+                                  top_k=[p.top_k for p in take],
+                                  tenants=[p.tenant for p in take])

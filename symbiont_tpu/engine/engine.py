@@ -398,6 +398,14 @@ class TpuEngine:
         """Bucket padding-waste + batch fill-ratio gauges for one dispatched
         batch (engine/bucketing.py quantified live)."""
         real, total = padding_stats(true_lengths, bucket, batch_rows)
+        # decode-plane flight recorder, embed side (obs/engine_timeline.py):
+        # the per-flush bucket-occupancy/padding timeline behind the
+        # packing-opportunity estimate — host ints already in hand
+        from symbiont_tpu.obs.engine_timeline import engine_timeline
+
+        engine_timeline.note_embed_flush(bucket, batch_rows, n_real,
+                                         real_tokens=real,
+                                         total_tokens=total)
         labels = {"service": "engine"}
         metrics.inc("engine.tokens_real", real, labels=labels)
         metrics.inc("engine.tokens_padding", total - real, labels=labels)

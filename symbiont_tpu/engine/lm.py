@@ -31,6 +31,9 @@ import numpy as np
 from symbiont_tpu.config import LmConfig
 from symbiont_tpu.models import gpt as gpt_mod
 from symbiont_tpu.models.gpt import GPTConfig
+from symbiont_tpu.obs.engine_timeline import engine_timeline
+from symbiont_tpu.obs.usage import usage
+from symbiont_tpu.resilience.admission import DEFAULT_TENANT
 from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
 log = logging.getLogger(__name__)
@@ -302,9 +305,22 @@ class LmEngine:
             rows = sum(s.bb for s in sessions)
             return round(rows * (1 << 30) / total, 1) if total else 0.0
 
+        def kv_stranded(lm):
+            # rows allocated in dense max-length slabs but NOT live (the
+            # batch-bucket padding + finished/cancelled rows a paged KV
+            # layout would reclaim — ROADMAP item 2's target number)
+            with lm._sessions_lock:
+                sessions = [s for s in lm._sessions if not s.done()]
+            alloc = sum(s.bb for s in sessions)
+            live = sum(sum(1 for r in s.rows if r is not None)
+                       for s in sessions)
+            return alloc - live
+
         labels = {"service": "lm",
                   "kv_dtype": ("int8" if self.model_cfg.kv_quant == "int8"
                                else self.model_cfg.dtype)}
+        metrics.register_weakref_gauge("lm.kv_stranded_rows", self,
+                                       kv_stranded, labels=labels)
         metrics.register_weakref_gauge("lm.kv_rows_active", self,
                                        kv_rows(True), labels=labels)
         metrics.register_weakref_gauge("lm.kv_rows_allocated", self,
@@ -496,7 +512,8 @@ class LmEngine:
 
     def generate_stream(self, prompt: str, max_new_tokens: int,
                         temperature: Optional[float] = None,
-                        top_k: Optional[int] = None):
+                        top_k: Optional[int] = None,
+                        tenant: Optional[str] = None):
         """Streaming decode: yields text deltas as chunks of tokens finish
         (SURVEY.md §7 hard part #5: "streaming tokens back out through
         NATS→SSE"). Prefill + one compiled chunk-scan executable per
@@ -519,6 +536,10 @@ class LmEngine:
         # largest bucket caps the request (same clamp generate() applies via
         # its scan length) — the cache has exactly new_bucket decode slots
         max_new_tokens = min(max_new_tokens, new_bucket)
+        # usage ledger (obs/usage.py): prompt tokens are known exactly here,
+        # host-side, before any device work
+        tenant = tenant or DEFAULT_TENANT
+        usage.note(tenant, tokens_in=int(prompt_mask[0].sum()))
         eos_id = getattr(self.tokenizer, "eos_id", -1)
         chunk = min(cfg.stream_chunk, new_bucket)
 
@@ -576,6 +597,8 @@ class LmEngine:
                 yield final_delta
         finally:
             # runs on normal exit AND on generator close (client disconnect)
+            usage.note(tenant, tokens_out=len(all_tokens),
+                       kv_row_seconds=decode_s * prompt_ids.shape[0])
             with self._lock:
                 self.stats["generate_calls"] += 1
                 self.stats["tokens_generated"] += len(all_tokens)
@@ -585,12 +608,16 @@ class LmEngine:
 
     def start_session(self, prompts: Sequence[str],
                       max_new_tokens: Sequence[int],
-                      temperature=None, top_k=None) -> "BatchSession":
+                      temperature=None, top_k=None,
+                      tenants=None) -> "BatchSession":
         """Open a chunked batch decode that new requests can JOIN at chunk
         boundaries (continuous batching — the GenBatcher upgrade over
         flush-window-only batching; VERDICT r3 item 3). Drive it with
-        session.step(); admit newcomers with session.admit()."""
-        return BatchSession(self, prompts, max_new_tokens, temperature, top_k)
+        session.step(); admit newcomers with session.admit(). `tenants`
+        (one per prompt; default lane otherwise) routes the usage ledger
+        — obs/usage.py."""
+        return BatchSession(self, prompts, max_new_tokens, temperature,
+                            top_k, tenants=tenants)
 
     def kv_rows_allocated(self) -> int:
         """Batch rows allocated across live decode sessions — the number
@@ -598,6 +625,17 @@ class LmEngine:
         for admission decisions."""
         with self._sessions_lock:
             return sum(s.bb for s in self._sessions if not s.done())
+
+    def kv_row_counts(self) -> tuple:
+        """(live, allocated) decode rows across live sessions in ONE
+        sessions-lock pass — the engine-timeline step events read both at
+        every chunk boundary."""
+        with self._sessions_lock:
+            sessions = [s for s in self._sessions if not s.done()]
+        alloc = sum(s.bb for s in sessions)
+        live = sum(sum(1 for r in s.rows if r is not None)
+                   for s in sessions)
+        return live, alloc
 
     def can_admit(self, n_rows: int = 1, max_kv_rows: int = 0) -> bool:
         """Capacity-aware generation admission (resilience/admission.py):
@@ -626,13 +664,43 @@ class LmEngine:
         self.generate("warmup", new_bucket or self.config.new_token_buckets[0])
 
 
-class _SessionRow:
-    __slots__ = ("tag", "want", "tokens")
+def _norm_tenants(tenants, n: int) -> list:
+    """Per-row tenant list of length n (default lane where unspecified) —
+    the usage ledger's routing (obs/usage.py)."""
+    if tenants is None:
+        return [DEFAULT_TENANT] * n
+    if len(tenants) != n:
+        raise ValueError(f"tenants list length {len(tenants)} != {n}")
+    return [t or DEFAULT_TENANT for t in tenants]
 
-    def __init__(self, tag: int, want: int):
+
+def _real_token_rows(prompt_ids, prompt_mask, n: int) -> list:
+    """The first `n` rows' REAL token ids (padding stripped) as plain int
+    lists — host numpy in, host lists out; the prefix-share probe's input."""
+    out = []
+    for i in range(n):
+        length = int(prompt_mask[i].sum())
+        out.append(prompt_ids[i, :length].tolist())
+    return out
+
+
+class _SessionRow:
+    __slots__ = ("tag", "want", "tokens", "tenant", "created", "first_tok")
+
+    def __init__(self, tag: int, want: int, tenant: str = DEFAULT_TENANT,
+                 created: Optional[float] = None):
         self.tag = tag
         self.want = want
         self.tokens: list = []
+        # usage ledger + engine-side TTFT (obs/engine_timeline.py): the
+        # fairness-lane tenant this row bills to, when the row's PREFILL
+        # started (splice passes prepare_admit's entry time — a spliced
+        # row's TTFT must include its tokenize/prefill/chunk-boundary
+        # wait, not start at the splice), and when its first token
+        # materialized on host
+        self.tenant = tenant
+        self.created = time.perf_counter() if created is None else created
+        self.first_tok: Optional[float] = None
 
 
 class BatchSession:
@@ -654,7 +722,7 @@ class BatchSession:
 
     def __init__(self, lm: LmEngine, prompts: Sequence[str],
                  max_new_tokens: Sequence[int], temperature=None,
-                 top_k=None):
+                 top_k=None, tenants=None):
         import jax
         import jax.numpy as jnp
 
@@ -672,14 +740,24 @@ class BatchSession:
         self._ks = lm._norm_sampling_rows(top_k, cfg.top_k, self.bb, n, int)
         self._eos = int(getattr(lm.tokenizer, "eos_id", -1))
         self._next_tag = 0
+        row_tenants = _norm_tenants(tenants, n)
         self.rows: list = []
-        for w in max_new_tokens:
+        for i, w in enumerate(max_new_tokens):
             self.rows.append(_SessionRow(self._next_tag,
-                                         min(int(w), self.new_bucket)))
+                                         min(int(w), self.new_bucket),
+                                         tenant=row_tenants[i]))
             self._next_tag += 1
         self.rows += [None] * (self.bb - n)  # free slots from the row bucket
         self.steps_done = 0
         self.decode_s = 0.0
+        # decode-plane probes, all on host data already in hand
+        # (obs/engine_timeline.py): token-id prefix overlap vs recently
+        # admitted prompts, and exact prompt-token billing per tenant
+        share = engine_timeline.prompt_prefix_share(
+            _real_token_rows(prompt_ids, prompt_mask, n))
+        for i in range(n):
+            usage.note(row_tenants[i],
+                       tokens_in=int(prompt_mask[i].sum()))
         with lm._lock:
             lm._key, self._sub = jax.random.split(lm._key)
             t0 = time.perf_counter()
@@ -687,8 +765,11 @@ class BatchSession:
              prompt_len) = gpt_mod.prefill(
                 lm.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
                 lm.model_cfg, self.new_bucket)
-            self.decode_s += time.perf_counter() - t0
+            prefill_s = time.perf_counter() - t0
+            self.decode_s += prefill_s
             lm.stats["sessions"] = lm.stats.get("sessions", 0) + 1
+        engine_timeline.note_admit(rows=n, prefill_ms=prefill_s * 1000.0,
+                                  prefix_share=share, kind="start")
         lm._prefill_shapes.add((self.bb, self.P, self.new_bucket))
         with lm._sessions_lock:  # weak: KV-occupancy gauges see live sessions
             lm._sessions.add(self)
@@ -737,7 +818,7 @@ class BatchSession:
 
     def prepare_admit(self, prompts: Sequence[str],
                       max_new_tokens: Sequence[int],
-                      temperature=None, top_k=None) -> dict:
+                      temperature=None, top_k=None, tenants=None) -> dict:
         """Phase 1 of admission: tokenize + device prefill, WITHOUT the
         engine lock — so a newcomer's prefill (which may compile a fresh
         (batch, P) shape, seconds of host time) cannot stall the in-flight
@@ -749,6 +830,7 @@ class BatchSession:
         import jax.numpy as jnp
 
         cfg = self.lm.config
+        t_enter = time.perf_counter()  # TTFT origin for the spliced rows
         k = len(prompts)
         bb2 = self._admission_rows(k)
         pad = getattr(self.lm.tokenizer, "pad_id", 0)
@@ -764,6 +846,11 @@ class BatchSession:
         for j in range(k, bb2):
             ids[j, 0] = bos
             mask[j, 0] = 1
+        # prefix-share probe + exact prompt-token counts BEFORE device
+        # work: both read only the host arrays built above
+        share = engine_timeline.prompt_prefix_share(
+            _real_token_rows(ids, mask, k))
+        n_tokens = [int(mask[j].sum()) for j in range(k)]
         params = self.lm.params  # snapshot; immutable buffers
         t0 = time.perf_counter()
         (cache_b, logits_b, kv_valid_b, pos_b) = gpt_mod.prefill(
@@ -777,6 +864,10 @@ class BatchSession:
                     temperature, cfg.temperature, bb2, k, float),
                 "ks": self.lm._norm_sampling_rows(
                     top_k, cfg.top_k, bb2, k, int),
+                "tenants": _norm_tenants(tenants, k),
+                "n_tokens": n_tokens,
+                "prefix_share": share,
+                "t_enter": t_enter,
                 "prefill_s": time.perf_counter() - t0}
 
     def splice(self, prep: dict) -> list:
@@ -800,7 +891,13 @@ class BatchSession:
             i = free[taken]
             taken += 1
             row_map[i] = j
-            self.rows[i] = _SessionRow(self._next_tag, prep["max_new"][j])
+            self.rows[i] = _SessionRow(self._next_tag, prep["max_new"][j],
+                                       tenant=prep.get("tenants",
+                                                       [DEFAULT_TENANT]
+                                                       * prep["k"])[j],
+                                       created=prep.get("t_enter"))
+            usage.note(self.rows[i].tenant,
+                       tokens_in=prep.get("n_tokens", [0] * prep["k"])[j])
             tags.append(self._next_tag)
             self._next_tag += 1
             self._temps[i] = prep["temps"][j]
@@ -823,16 +920,20 @@ class BatchSession:
             self.decode_s += time.perf_counter() - t0 + prep["prefill_s"]
             self.lm.stats["admitted"] = (self.lm.stats.get("admitted", 0)
                                          + taken)
+        engine_timeline.note_admit(
+            rows=taken, prefill_ms=prep["prefill_s"] * 1000.0,
+            prefix_share=prep.get("prefix_share"), kind="splice")
         return tags
 
     def admit(self, prompts: Sequence[str], max_new_tokens: Sequence[int],
-              temperature=None, top_k=None) -> list:
+              temperature=None, top_k=None, tenants=None) -> list:
         """One-shot admission (prepare + splice back-to-back, no chunks in
         between so nothing can be rejected). Caller pre-filters with
         can_admit. Returns the tags identifying each admitted request in
         step() results."""
         tags = self.splice(self.prepare_admit(
-            prompts, max_new_tokens, temperature=temperature, top_k=top_k))
+            prompts, max_new_tokens, temperature=temperature, top_k=top_k,
+            tenants=tenants))
         assert None not in tags, "admit() beyond capacity()"
         return tags
 
@@ -847,6 +948,8 @@ class BatchSession:
         for i, row in enumerate(self.rows):
             if row is not None and row.tag == tag:
                 self.rows[i] = None
+                usage.note(row.tenant, tokens_out=len(row.tokens))
+                engine_timeline.note_cancel()
                 with self.lm._lock:
                     self.lm.stats["cancelled"] = (
                         self.lm.stats.get("cancelled", 0) + 1)
@@ -882,13 +985,35 @@ class BatchSession:
                 temperature=self._temps, top_k=self._ks, eos_id=self._eos)
             toks = np.asarray(toks)
             counted = np.asarray(counted)
-            self.decode_s += time.perf_counter() - t0
+            step_s = time.perf_counter() - t0
+            self.decode_s += step_s
         self.steps_done += chunk
+        # decode-plane flight recorder (obs/engine_timeline.py), recorded
+        # at this EXISTING chunk-boundary host sync — everything below is
+        # host bookkeeping on already-materialized values. Occupancy /
+        # per-tenant KV-row-seconds are measured over the rows that were
+        # live DURING the chunk (before this chunk's finishes free them).
+        live_rows = [r for r in self.rows if r is not None]
+        kv_live, kv_alloc = self.lm.kv_row_counts()
+        engine_timeline.note_decode_step(
+            wall_ms=step_s * 1000.0, rows_live=len(live_rows),
+            rows_capacity=self.bb, kv_rows_live=kv_live,
+            kv_rows_allocated=kv_alloc, steps=chunk)
+        if chunk:
+            metrics.observe("lm.tpot_ms", step_s * 1000.0 / chunk,
+                            labels={"service": "lm"})
+        by_tenant: dict = {}
+        for row in live_rows:
+            by_tenant[row.tenant] = by_tenant.get(row.tenant, 0) + 1
+        for tenant, n_rows in by_tenant.items():
+            usage.note(tenant, kv_row_seconds=step_s * n_rows)
+        now = time.perf_counter()
         finished = []
         for i, row in enumerate(self.rows):
             if row is None:
                 continue
             hit_eos = False
+            had_tokens = bool(row.tokens)
             for t, c in zip(toks[i], counted[i]):
                 if not c:  # EOS (or a post-EOS slot)
                     hit_eos = True
@@ -896,6 +1021,13 @@ class BatchSession:
                 row.tokens.append(int(t))
                 if len(row.tokens) >= row.want:
                     break
+            if not had_tokens and row.tokens and row.first_tok is None:
+                # engine-side TTFT: row creation (its prefill started) →
+                # its first token materialized on host
+                row.first_tok = now
+                metrics.observe("lm.ttft_ms",
+                                (now - row.created) * 1000.0,
+                                labels={"service": "lm"})
             if hit_eos or len(row.tokens) >= row.want:
                 finished.append(self._finish(i))
         if self.remaining_steps() <= 0:
@@ -905,6 +1037,11 @@ class BatchSession:
     def _finish(self, i: int):
         row = self.rows[i]
         self.rows[i] = None
+        usage.note(row.tenant, tokens_out=len(row.tokens))
+        engine_timeline.note_finish(
+            tokens=len(row.tokens),
+            ttft_ms=((row.first_tok - row.created) * 1000.0
+                     if row.first_tok is not None else None))
         with self.lm._lock:
             self.lm.stats["generate_calls"] += 1
             self.lm.stats["tokens_generated"] += len(row.tokens)

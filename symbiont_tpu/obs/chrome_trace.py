@@ -77,6 +77,74 @@ def _lane_of(r: SpanRecord, synthetic: Dict[str, int],
     return synth(), role
 
 
+def export_timeline(trace_id: str, spans: Sequence[SpanRecord],
+                    events: Sequence[dict]) -> dict:
+    """One Perfetto document carrying BOTH the flight recorder's span
+    lanes AND the engine timeline's counter tracks (``ph: "C"``) — the
+    decode plane's per-step occupancy / KV-rows / queue-depth / padding
+    series interleaved with the spans that caused them, on one time axis.
+    Served at ``GET /api/engine/timeline?fmt=chrome``; golden-pinned.
+
+    Counter tracks (values per ``ts``; Perfetto renders stacked areas):
+
+    - ``decode.rows``       — live vs free batch-slab rows (occupancy);
+    - ``decode.kv_rows``    — live vs STRANDED KV rows (the HBM paged-KV
+      will reclaim — ``lm.kv_stranded_rows`` over time);
+    - ``engine.queue.<kind>`` — batcher queue depth samples;
+    - ``embed.flush_tokens`` — real vs padding token slots per dispatched
+      embed batch (the packing-opportunity series).
+
+    Admit / finish / cancel land as instant events (``ph: "i"``) on the
+    counters' process lane. Determinism: the span half is exactly
+    ``export_spans`` (metadata first, spans by (ts, span_id)); counter and
+    instant events append after it sorted by (ts, name). No clocks, no
+    randomness — a pure function of the recorded data."""
+    doc = export_spans(trace_id, list(spans))
+    tev = doc["traceEvents"]
+    if not any(e.get("ph") == "M" and e.get("pid") == _PID
+               and e.get("name") == "process_name" for e in tev):
+        # counters need a home lane even when no local span rendered one
+        tev.insert(0, {"ph": "M", "name": "process_name", "pid": _PID,
+                       "args": {"name": _LOCAL_PROCESS_NAME}})
+    extra: List[dict] = []
+
+    def counter(name: str, t: float, series: dict) -> None:
+        extra.append({"ph": "C", "name": name, "pid": _PID,
+                      "ts": round(t * 1e6, 1), "args": series})
+
+    def instant(name: str, t: float, args: dict) -> None:
+        extra.append({"ph": "i", "s": "p", "name": name, "pid": _PID,
+                      "tid": 0, "ts": round(t * 1e6, 1), "args": args})
+
+    for ev in events:
+        kind, t = ev.get("kind"), ev.get("t", 0.0)
+        if kind == "step":
+            counter("decode.rows", t, {
+                "live": ev["rows_live"],
+                "free": ev["rows_capacity"] - ev["rows_live"]})
+            counter("decode.kv_rows", t, {
+                "live": ev["kv_rows_live"],
+                "stranded": (ev["kv_rows_allocated"]
+                             - ev["kv_rows_live"])})
+        elif kind == "queue":
+            counter(f"engine.queue.{ev['queue']}", t,
+                    {"depth": ev["depth"]})
+        elif kind == "flush":
+            counter("embed.flush_tokens", t, {
+                "real": ev["real_tokens"],
+                "padding": ev["total_tokens"] - ev["real_tokens"]})
+        elif kind in ("admit", "finish", "cancel"):
+            args = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+            instant(f"decode.{kind}", t, args)
+    extra.sort(key=lambda e: (e["ts"], e["name"]))
+    tev.extend(extra)
+    doc["otherData"]["counter_events"] = sum(
+        1 for e in extra if e["ph"] == "C")
+    doc["otherData"]["instant_events"] = sum(
+        1 for e in extra if e["ph"] == "i")
+    return doc
+
+
 def export_spans(trace_id: str, spans: Sequence[SpanRecord]) -> dict:
     """Render one trace's SpanRecords as a Chrome Trace Format object."""
     ordered = sorted(spans, key=lambda r: (r.start_s, r.span_id))

@@ -1,0 +1,340 @@
+"""Decode-plane flight recorder: a bounded per-step engine timeline.
+
+ROADMAP items 2-3 (paged KV, shared-prefix radix cache, speculative
+decoding, sequence packing) are about to optimize the decode/prefill path,
+but the engine was observed only through coarse gauges — nothing recorded
+*per-step* batch occupancy, KV rows stranded by dense max-length slabs, or
+how much prefix live sessions actually share. This module is the
+instrument: a process-global bounded event ring recorded by
+``LmEngine.BatchSession`` / ``GenBatcher`` / ``TpuEngine._note_padding`` at
+their EXISTING chunk-boundary host syncs (recording consumes only values
+already materialized on host — no new device syncs, the
+``jax-host-sync-in-loop`` lint inventory is unchanged), plus two
+forward-looking probes:
+
+- a host-side token-id **prefix-overlap probe** at session admit
+  (``lm.prefix_share_ratio``): how much of each new prompt is a prefix of
+  a recently admitted prompt — the radix-cache win of ROADMAP item 2,
+  quantified before it is built;
+- a **packing-opportunity estimate** from the embed flush timeline
+  (``engine.packing_opportunity_pct``): the fraction of dispatched token
+  slots that perfect sequence packing would reclaim — ROADMAP item 3's
+  bar, read off the live padding stream.
+
+Surfaces: ``GET /api/engine/timeline`` (JSON summary, or ``?fmt=chrome``
+for Perfetto counter tracks interleaved with the flight recorder's span
+lanes — ``obs/chrome_trace.export_timeline``), the ``lm.ttft_ms`` /
+``lm.tpot_ms`` Prometheus histograms fed at step boundaries, and the
+``decode_*`` archive fields the bench ``decode_timeline`` tier renders
+into docs/PERF.md.
+
+Layering: imports only ``utils/telemetry`` (the registry); the engine and
+batcher record into the global ``engine_timeline`` the way every handler
+records into the global ``trace_store``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+# event kinds recorded into the ring (dicts keep the export path trivial):
+#   step   — one decode chunk: wall ms, live rows vs slab capacity,
+#            engine-wide KV rows live vs allocated, chunk length
+#   admit  — a prefill joined the decode plane (session start or mid-flight
+#            splice): row count, prefill ms, prefix-share of the new rows
+#   finish — one request completed (token count, engine-side TTFT)
+#   cancel — one in-flight request aborted (client vanished)
+#   queue  — a batcher queue-depth sample at a flush boundary
+#   flush  — one dispatched embed/rerank batch: bucket, rows, real vs
+#            padded token slots (fed from TpuEngine._note_padding)
+STEP, ADMIT, FINISH, CANCEL, QUEUE, FLUSH = (
+    "step", "admit", "finish", "cancel", "queue", "flush")
+
+# prompt tokens kept per registry entry for the prefix probe: overlap past
+# this depth is counted as full-depth (the radix cache would share at least
+# this much) — bounds the per-admit comparison cost
+_PREFIX_DEPTH = 128
+
+
+class EngineTimeline:
+    """Thread-safe bounded ring of decode-plane events + windowed probes.
+
+    ``note_*`` calls are the hot path (one per decode chunk / dispatched
+    batch): they take the lock, append one dict, update O(1) running
+    aggregates, and return — summary statistics are computed at read time
+    over the bounded ring, never per record. ``capacity`` <= 0 disables
+    recording entirely (every note becomes a cheap early return)."""
+
+    def __init__(self, capacity: int = 2048, prompt_window: int = 64,
+                 registry: Optional[Metrics] = None):
+        self.registry = registry if registry is not None else _global_metrics
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._enabled = int(capacity) > 0
+        # prefix probe: recent prompt token prefixes (tuples, bounded depth)
+        self._prompts: deque = deque(maxlen=max(1, int(prompt_window)))
+        # windowed mean for the lm.prefix_share_ratio gauge
+        self._shares: deque = deque(maxlen=256)
+        # packing-opportunity window over recent embed flushes
+        self._flushes: deque = deque(maxlen=128)
+        self._flush_real = 0
+        self._flush_total = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def configure(self, capacity: int, prompt_window: int) -> None:
+        """Apply ObsConfig sizing (runner, at boot). Keeps the newest
+        events, like TraceStore.set_capacity."""
+        with self._lock:
+            self._enabled = int(capacity) > 0
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+            self._prompts = deque(self._prompts,
+                                  maxlen=max(1, int(prompt_window)))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._prompts.clear()
+            self._shares.clear()
+            self._flushes.clear()
+            self._flush_real = 0
+            self._flush_total = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            self._ring.append(ev)
+
+    # ------------------------------------------------------------ recording
+
+    def note_decode_step(self, wall_ms: float, rows_live: int,
+                         rows_capacity: int, kv_rows_live: int,
+                         kv_rows_allocated: int, steps: int,
+                         sessions: int = 1) -> None:
+        """One decode chunk at its existing chunk-boundary host sync."""
+        if not self._enabled:
+            return
+        self._append({"kind": STEP, "t": time.time(), "wall_ms": wall_ms,
+                      "rows_live": int(rows_live),
+                      "rows_capacity": int(rows_capacity),
+                      "kv_rows_live": int(kv_rows_live),
+                      "kv_rows_allocated": int(kv_rows_allocated),
+                      "steps": int(steps), "sessions": int(sessions)})
+
+    def note_admit(self, rows: int, prefill_ms: float,
+                   prefix_share: Optional[float] = None,
+                   kind: str = "start") -> None:
+        if not self._enabled:
+            return
+        ev = {"kind": ADMIT, "t": time.time(), "rows": int(rows),
+              "prefill_ms": prefill_ms, "admit_kind": kind}
+        if prefix_share is not None:
+            ev["prefix_share"] = prefix_share
+        self._append(ev)
+
+    def note_finish(self, tokens: int,
+                    ttft_ms: Optional[float] = None) -> None:
+        if not self._enabled:
+            return
+        ev = {"kind": FINISH, "t": time.time(), "tokens": int(tokens)}
+        if ttft_ms is not None:
+            ev["ttft_ms"] = ttft_ms
+        self._append(ev)
+
+    def note_cancel(self) -> None:
+        if not self._enabled:
+            return
+        self._append({"kind": CANCEL, "t": time.time()})
+
+    def note_queue_depth(self, queue: str, depth: int) -> None:
+        if not self._enabled:
+            return
+        self._append({"kind": QUEUE, "t": time.time(), "queue": str(queue),
+                      "depth": int(depth)})
+
+    def note_embed_flush(self, bucket: int, batch_rows: int, n_real: int,
+                         real_tokens: int, total_tokens: int) -> None:
+        """One dispatched embed/rerank batch (TpuEngine._note_padding).
+        Also maintains the windowed packing-opportunity estimate: the
+        fraction of dispatched token slots that carried padding — exactly
+        the work perfect sequence packing (ROADMAP item 3) reclaims."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._ring.append({"kind": FLUSH, "t": time.time(),
+                               "bucket": int(bucket),
+                               "batch_rows": int(batch_rows),
+                               "n_real": int(n_real),
+                               "real_tokens": int(real_tokens),
+                               "total_tokens": int(total_tokens)})
+            if len(self._flushes) == self._flushes.maxlen:
+                old_real, old_total = self._flushes[0]
+                self._flush_real -= old_real
+                self._flush_total -= old_total
+            self._flushes.append((int(real_tokens), int(total_tokens)))
+            self._flush_real += int(real_tokens)
+            self._flush_total += int(total_tokens)
+            total, real = self._flush_total, self._flush_real
+        # gauge write OUTSIDE the timeline lock (the registry has its own)
+        if total > 0:
+            self.registry.gauge_set(
+                "engine.packing_opportunity_pct",
+                round(100.0 * (1.0 - real / total), 2),
+                labels={"service": "engine"})
+
+    # --------------------------------------------------------- prefix probe
+
+    def prompt_prefix_share(self, token_rows: Sequence[Sequence[int]]
+                            ) -> float:
+        """Host-side prefix-overlap probe at session admit: for each new
+        prompt, the longest common token-id prefix with any RECENTLY
+        admitted prompt, as a fraction of the (depth-bounded) prompt
+        length. Returns the mean share across the admitted rows and
+        updates the windowed ``lm.prefix_share_ratio`` gauge — the
+        shared-RAG-template number the radix cache of ROADMAP item 2 will
+        convert into prefill savings. Pure host arithmetic on already-
+        encoded token ids; never touches the device."""
+        if not self._enabled or not token_rows:
+            return 0.0
+        shares = []
+        with self._lock:
+            registry = list(self._prompts)
+            for row in token_rows:
+                head = tuple(row[:_PREFIX_DEPTH])
+                if not head:
+                    continue
+                best = 0
+                for prev in registry:
+                    if best >= len(head):
+                        break
+                    n = 0
+                    for a, b in zip(head, prev):
+                        if a != b:
+                            break
+                        n += 1
+                    if n > best:
+                        best = n
+                shares.append(best / len(head))
+                self._prompts.append(head)
+                registry.append(head)
+            if not shares:
+                return 0.0
+            for s in shares:
+                self._shares.append(s)
+            window = list(self._shares)
+        mean_share = sum(shares) / len(shares)
+        self.registry.gauge_set(
+            "lm.prefix_share_ratio",
+            round(sum(window) / len(window), 4),
+            labels={"service": "lm"})
+        return mean_share
+
+    # -------------------------------------------------------------- summary
+
+    def summary(self) -> dict:
+        """Aggregate view over the ring: the numbers the
+        ``GET /api/engine/timeline`` endpoint, ``scripts/profile_ingest.sh
+        --decode`` and the bench ``decode_timeline`` tier all read. Every
+        percentage is computed over the bounded window, so it is a recent
+        picture, not a process-lifetime average."""
+        events = self.events()
+        steps = [e for e in events if e["kind"] == STEP]
+        admits = [e for e in events if e["kind"] == ADMIT]
+        finishes = [e for e in events if e["kind"] == FINISH]
+        cancels = [e for e in events if e["kind"] == CANCEL]
+        flushes = [e for e in events if e["kind"] == FLUSH]
+
+        def pct(num: float, den: float) -> float:
+            return round(100.0 * num / den, 2) if den else 0.0
+
+        def quantile(vals: List[float], q: float) -> float:
+            if not vals:
+                return 0.0
+            vals = sorted(vals)
+            return round(vals[min(len(vals) - 1, int(q * len(vals)))], 2)
+
+        rows_live = sum(e["rows_live"] for e in steps)
+        rows_cap = sum(e["rows_capacity"] for e in steps)
+        kv_alloc = sum(e["kv_rows_allocated"] for e in steps)
+        kv_stranded = sum(e["kv_rows_allocated"] - e["kv_rows_live"]
+                          for e in steps)
+        step_ms = [e["wall_ms"] for e in steps]
+        tpot_ms = [e["wall_ms"] / e["steps"] for e in steps if e["steps"]]
+        ttfts = [e["ttft_ms"] for e in finishes if "ttft_ms" in e]
+        shares = [e["prefix_share"] for e in admits if "prefix_share" in e]
+        prefill_ms = sum(e["prefill_ms"] for e in admits)
+        decode_ms = sum(step_ms)
+        real_tok = sum(e["real_tokens"] for e in flushes)
+        total_tok = sum(e["total_tokens"] for e in flushes)
+
+        out = {
+            "decode_steps": len(steps),
+            "decode_occupancy_pct": pct(rows_live, rows_cap),
+            "decode_kv_stranded_pct": pct(kv_stranded, kv_alloc),
+            "decode_prefix_share_pct": (
+                round(100.0 * sum(shares) / len(shares), 2)
+                if shares else 0.0),
+            "decode_admits": len(admits),
+            "decode_finishes": len(finishes),
+            "decode_cancels": len(cancels),
+            "decode_prefill_ms_total": round(prefill_ms, 2),
+            "decode_step_ms_total": round(decode_ms, 2),
+            "decode_step_ms_p50": quantile(step_ms, 0.50),
+            "decode_tpot_ms_p50": quantile(tpot_ms, 0.50),
+            "decode_ttft_ms_p50": quantile(ttfts, 0.50),
+            "decode_ttft_ms_p99": quantile(ttfts, 0.99),
+            "embed_flushes": len(flushes),
+            "embed_padding_pct": pct(total_tok - real_tok, total_tok),
+            "packing_opportunity_pct": pct(total_tok - real_tok, total_tok),
+        }
+        out["dominant_stall"] = self._dominant_stall(out)
+        return out
+
+    @staticmethod
+    def _dominant_stall(s: dict) -> str:
+        """One-line verdict: which measured inefficiency dominates the
+        recent window — the thing the next decode-plane PR should move
+        first. Heuristic over the summary's own percentages (each is the
+        fraction of provisioned work NOT doing useful decode/prefill)."""
+        if not s["decode_steps"] and not s["embed_flushes"]:
+            return "no engine traffic recorded"
+        candidates = []
+        if s["decode_steps"]:
+            candidates.append(("row underfill (batch occupancy "
+                               f"{s['decode_occupancy_pct']}%)",
+                               100.0 - s["decode_occupancy_pct"]))
+            candidates.append(("stranded KV rows "
+                               f"({s['decode_kv_stranded_pct']}% of "
+                               "allocated slabs)",
+                               s["decode_kv_stranded_pct"]))
+            total = s["decode_prefill_ms_total"] + s["decode_step_ms_total"]
+            if total > 0:
+                prefill_pct = round(
+                    100.0 * s["decode_prefill_ms_total"] / total, 2)
+                candidates.append(
+                    (f"admission prefills ({prefill_pct}% of engine wall)",
+                     prefill_pct))
+        if s["embed_flushes"]:
+            candidates.append(("embed padding (packing opportunity "
+                               f"{s['packing_opportunity_pct']}%)",
+                               s["packing_opportunity_pct"]))
+        label, worst = max(candidates, key=lambda c: c[1])
+        if worst < 10.0:
+            return "none dominant (all measured waste < 10%)"
+        return label
+
+
+# process-global decode-plane recorder (one per process, like trace_store)
+engine_timeline = EngineTimeline()

@@ -109,6 +109,14 @@ class TelemetryExporter:
         # (__len__ == 0) and would silently fall back to the global ring
         self.store = store if store is not None else _global_store
         self._pending: deque = deque()
+        # tail-based retention, exporter half (docs/OBSERVABILITY.md "Tail
+        # retention"): errored spans keep their OWN bounded pending ring so
+        # a burst of healthy spans can never sample away the one span the
+        # aggregator (and whoever reads the stitched trace) actually needs.
+        # Bounded like everything here — errored overflow drops oldest
+        # errored, counted in the same fleet.spans_dropped.
+        self._pending_err: deque = deque(
+            maxlen=max(1, min(256, self.pending_max)))
         self._pending_lock = threading.Lock()
         self._last_flat: Dict[str, float] = {}
         self._seq = 0
@@ -151,19 +159,31 @@ class TelemetryExporter:
         if rec.fields and ROLE_FIELD in rec.fields:
             return
         with self._pending_lock:
-            if len(self._pending) >= self.pending_max:
+            if rec.status != "ok":
+                # errored spans ride the retention ring: healthy churn
+                # cannot displace them; only errored overflow evicts
+                dropped = len(self._pending_err) == self._pending_err.maxlen
+                self._pending_err.append(rec)
+            elif len(self._pending) >= self.pending_max:
                 self._pending.popleft()
+                self._pending.append(rec)
                 dropped = True
             else:
+                self._pending.append(rec)
                 dropped = False
-            self._pending.append(rec)
         if dropped:
             self.registry.inc("fleet.spans_dropped")
 
     def _drain_spans(self) -> List[SpanRecord]:
         with self._pending_lock:
-            batch = [self._pending.popleft()
-                     for _ in range(min(self.spans_max, len(self._pending)))]
+            # errored spans publish FIRST (they are the ones a breach
+            # investigation needs stitched), healthy fill the remainder
+            batch = [self._pending_err.popleft()
+                     for _ in range(min(self.spans_max,
+                                        len(self._pending_err)))]
+            room = self.spans_max - len(batch)
+            batch += [self._pending.popleft()
+                      for _ in range(min(room, len(self._pending)))]
         return batch
 
     # -------------------------------------------------------------- publish
@@ -248,15 +268,23 @@ class TelemetryExporter:
                 # the bus died between the two publishes: re-pend the
                 # drained batch at the FRONT (bounded — overflow is a
                 # counted drop, per the module contract) instead of
-                # silently losing up to spans_max stitched hops
+                # silently losing up to spans_max stitched hops. Errored
+                # spans go back to their retention ring, healthy to the
+                # sampled ring.
+                errored = [r for r in batch if r.status != "ok"]
+                healthy = [r for r in batch if r.status == "ok"]
                 with self._pending_lock:
                     space = max(0, self.pending_max - len(self._pending))
-                    # NB: batch[-0:] is the WHOLE list — zero space must
+                    # NB: healthy[-0:] is the WHOLE list — zero space must
                     # requeue nothing, not everything
-                    requeue = (batch if space >= len(batch)
-                               else batch[-space:] if space else [])
-                    lost = len(batch) - len(requeue)
+                    requeue = (healthy if space >= len(healthy)
+                               else healthy[-space:] if space else [])
+                    lost = len(healthy) - len(requeue)
                     self._pending.extendleft(reversed(requeue))
+                    err_space = (self._pending_err.maxlen
+                                 - len(self._pending_err))
+                    lost += max(0, len(errored) - err_space)
+                    self._pending_err.extendleft(reversed(errored))
                 if lost:
                     self.registry.inc("fleet.spans_dropped", lost)
                 raise

@@ -1,0 +1,113 @@
+"""Per-tenant usage metering — the accounting seam ROADMAP item 5 needs.
+
+The admission plane decides what each tenant MAY do; nothing recorded what
+each tenant actually DID. This module is the ledger: bounded per-tenant
+counters for the five cost drivers of the serving stack —
+
+- ``tokens_in`` / ``tokens_out`` — prompt tokens prefilled and tokens
+  decoded for the tenant (engine-side exact counts, charged at the same
+  chunk-boundary bookkeeping the decode sessions already do);
+- ``embed_rows`` — sentences embedded through the micro-batcher;
+- ``search_queries`` — admitted search requests at the API edge;
+- ``kv_row_seconds`` — KV-cache row-seconds held by the tenant's live
+  decode rows (the HBM-residency cost a per-tenant bill must carry — two
+  tenants with equal token counts can differ 10x here).
+
+Every ``note()`` lands twice: in this module's own per-tenant totals
+(``GET /api/tenants`` roll-up) and as ``tenant.usage.<kind>`` counters in
+the metrics registry — which means the fleet telemetry plane federates
+them per role for free, and the Prometheus exposition carries them with a
+``tenant`` label.
+
+Tenant universe is BOUNDED with the admission plane's ``resolve_tenant``
+stance: the tenant header is client-supplied, so past ``max_tenants``
+distinct identities every NEW name shares the ``(overflow)`` ledger —
+minting fresh tenants grows no state and no metric-label cardinality.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from symbiont_tpu.resilience.admission import DEFAULT_TENANT, OVERFLOW_TENANT
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+# the five metered kinds; note() rejects anything else so a typo'd kind
+# fails loudly at the call site instead of minting a new counter family
+KINDS = ("tokens_in", "tokens_out", "embed_rows", "search_queries",
+         "kv_row_seconds")
+
+
+class UsageMeter:
+    """Thread-safe bounded per-tenant usage ledger (see module docstring)."""
+
+    def __init__(self, max_tenants: int = 1024,
+                 registry: Optional[Metrics] = None):
+        self.registry = registry if registry is not None else _global_metrics
+        self.max_tenants = max(1, int(max_tenants))
+        self._lock = threading.Lock()
+        self._totals: Dict[str, Dict[str, float]] = {}
+        # cumulative identity bound (resolve_tenant stance): overflow is
+        # keyed on identities ever SEEN, not currently tracked
+        self._seen: set = {DEFAULT_TENANT}
+
+    def set_max_tenants(self, n: int) -> None:
+        self.max_tenants = max(1, int(n))
+
+    def register_zero(self) -> None:
+        """Zero-register every counter family up front (the fleet-exporter
+        convention) so the doc-drift contract sees all five kinds on every
+        boot, not only after the first matching traffic."""
+        for kind in KINDS:
+            self.registry.inc(f"tenant.usage.{kind}", 0,
+                              labels={"tenant": DEFAULT_TENANT})
+
+    def _resolve(self, tenant: Optional[str]) -> str:
+        t = (tenant or "").strip() or DEFAULT_TENANT
+        with self._lock:
+            if t in self._seen:
+                return t
+            if len(self._seen) >= self.max_tenants:
+                return OVERFLOW_TENANT
+            self._seen.add(t)
+            return t
+
+    def note(self, tenant: Optional[str], **counts) -> None:
+        """Charge one tenant: ``note(t, tokens_out=12, kv_row_seconds=0.4)``.
+        Unknown kinds raise (bounded counter-family universe); zero counts
+        are skipped (no empty series minted)."""
+        bad = [k for k in counts if k not in KINDS]
+        if bad:
+            raise ValueError(f"unknown usage kind(s) {bad}; known: {KINDS}")
+        live = {k: v for k, v in counts.items() if v}
+        if not live:
+            return
+        t = self._resolve(tenant)
+        with self._lock:
+            ledger = self._totals.setdefault(t, {})
+            for k, v in live.items():
+                ledger[k] = ledger.get(k, 0.0) + float(v)
+        # registry writes OUTSIDE our lock (it has its own)
+        for k, v in live.items():
+            self.registry.inc(f"tenant.usage.{k}", v, labels={"tenant": t})
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant totals since process start, rounded for the JSON
+        surface (kv_row_seconds is the one float-valued kind)."""
+        with self._lock:
+            return {t: {k: round(v, 3) for k, v in ledger.items()}
+                    for t, ledger in self._totals.items()}
+
+    def tenants(self) -> int:
+        with self._lock:
+            return len(self._totals)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._totals.clear()
+            self._seen = {DEFAULT_TENANT}
+
+
+# process-global meter (one per process, like the metrics registry)
+usage = UsageMeter()

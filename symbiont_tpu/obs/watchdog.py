@@ -53,7 +53,9 @@ class SloWatchdog:
     def __init__(self, thresholds: Dict[str, float],
                  interval_s: float = 10.0,
                  registry: Optional[Metrics] = None,
-                 max_events: int = 256):
+                 max_events: int = 256,
+                 burn_fast_s: float = 60.0, burn_slow_s: float = 600.0,
+                 store=None):
         self.thresholds = dict(thresholds)
         self.interval_s = max(0.1, float(interval_s))
         self.registry = registry or _global_metrics
@@ -63,6 +65,20 @@ class SloWatchdog:
         # variant): an idle span must not re-alert every interval off the
         # same old samples
         self._seen_counts: Dict[tuple, int] = {}
+        # two-window burn rates (multiwindow SRE shape): every judged pass
+        # outcome lands in a per-variant (ts, breached) history; breach
+        # events carry the breach FRACTION over the fast and slow windows
+        # so a consumer (the elastic autoscaler's SLO signal) can tell a
+        # blip (fast high, slow low) from a sustained burn (both high)
+        self.burn_fast_s = float(burn_fast_s)
+        self.burn_slow_s = float(burn_slow_s)
+        self._outcomes: Dict[tuple, deque] = {}
+        # tail-based retention hook: breached buckets' exemplar traces pin
+        # into the flight recorder's keep-set (obs/trace_store.py) so the
+        # evidence behind an SLO breach survives ring churn
+        if store is None:
+            from symbiont_tpu.obs.trace_store import trace_store as store
+        self.store = store
         # pass listeners: fn(breaches) called at the END of every
         # evaluation — with the empty list too, which is what lets the
         # admission shed ladder (resilience/admission.DegradationLadder)
@@ -108,7 +124,15 @@ class SloWatchdog:
                 p99 = summary["p99"]
                 self.registry.gauge_set("slo.p99_ms", p99,
                                         labels={"span": span_name, **labels})
-                if p99 <= limit_ms:
+                breached = p99 > limit_ms
+                fast, slow = self._note_outcome(seen_key, breached)
+                self.registry.gauge_set(
+                    "slo.burn_rate_fast", fast,
+                    labels={"span": span_name, **labels})
+                self.registry.gauge_set(
+                    "slo.burn_rate_slow", slow,
+                    labels={"span": span_name, **labels})
+                if not breached:
                     continue
                 event = {
                     "event": "slo_breach",
@@ -116,12 +140,18 @@ class SloWatchdog:
                     "p99_ms": round(p99, 3),
                     "threshold_ms": limit_ms,
                     "count": summary["count"],
+                    # two-window burn rates: the autoscaler's blip-vs-burn
+                    # discriminator (fast high + slow low = transient;
+                    # both high = sustained — scale, don't flap)
+                    "burn_rate_fast": fast,
+                    "burn_rate_slow": slow,
                     "ts": time.time(),
                 }
                 if labels:
                     event["labels"] = dict(labels)
                 self.registry.inc("slo.breaches",
                                   labels={"span": span_name, **labels})
+                self._pin_exemplars(summary, limit_ms)
                 self.events.append(event)
                 breaches.append(event)
                 log.warning(json.dumps(event, ensure_ascii=False))
@@ -133,6 +163,48 @@ class SloWatchdog:
                 # not take the watchdog down with it
                 log.exception("SLO pass listener failed")
         return breaches
+
+    def _note_outcome(self, key: tuple, breached: bool) -> tuple:
+        """Record one judged pass outcome and return the (fast, slow)
+        breach fractions over the two windows. Bounded history: entries
+        past the slow window are dropped eagerly."""
+        now = time.time()
+        hist = self._outcomes.setdefault(key, deque())
+        hist.append((now, breached))
+        horizon = now - self.burn_slow_s
+        while hist and hist[0][0] < horizon:
+            hist.popleft()
+
+        def rate(window_s: float) -> float:
+            cut = now - window_s
+            judged = [b for ts, b in hist if ts >= cut]
+            if not judged:
+                return 0.0
+            return round(sum(judged) / len(judged), 4)
+
+        return rate(self.burn_fast_s), rate(self.burn_slow_s)
+
+    def _pin_exemplars(self, summary: dict, limit_ms: float) -> None:
+        """Pin the BREACHING buckets' exemplar traces into the flight
+        recorder's keep-set: the concrete slow requests behind a breach
+        must survive the ring churn the breach itself causes. Only
+        exemplars whose observed value exceeds the threshold pin — the
+        histogram keeps one exemplar per bucket including the fast ones,
+        and pinning those would churn healthy traces through the bounded
+        keep-set, evicting exactly the evidence it protects."""
+        for ex in summary.get("exemplars") or ():
+            if not ex:
+                continue
+            try:
+                value, labels = float(ex[0]), ex[1]
+                trace_id = labels.get("trace_id")
+            except (AttributeError, IndexError, TypeError, ValueError):
+                continue
+            if trace_id and value > limit_ms:
+                try:
+                    self.store.pin(trace_id)
+                except Exception:
+                    log.debug("exemplar pin failed", exc_info=True)
 
     async def _run(self) -> None:
         while True:

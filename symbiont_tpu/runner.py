@@ -86,11 +86,35 @@ class SymbiontStack:
         # thresholds are configured, run the SLO watchdog over the span
         # histograms every service handler feeds
         from symbiont_tpu.obs.device import register_process_gauges
+        from symbiont_tpu.obs.engine_timeline import engine_timeline
         from symbiont_tpu.obs.trace_store import trace_store
+        from symbiont_tpu.obs.usage import usage
         from symbiont_tpu.utils.telemetry import metrics
 
         if trace_store.capacity != cfg.obs.trace_capacity:
             trace_store.set_capacity(cfg.obs.trace_capacity)
+        # tail-based retention (obs/trace_store.py): errored / SLO-breach /
+        # slowest-decile traces pin into a bounded keep-set; healthy
+        # traces sample at the configured rate. Gauges read the store's
+        # own counters at scrape time (the store cannot import telemetry).
+        trace_store.configure_retention(
+            sample_rate=cfg.obs.trace_sample_rate,
+            keep_traces=cfg.obs.trace_keep_traces)
+        metrics.register_gauge("obs.trace_pinned_traces",
+                               trace_store.pinned_traces)
+        metrics.register_gauge("obs.trace_sampled_out",
+                               lambda: trace_store.sampled_out)
+        metrics.register_gauge("obs.trace_pin_evicted",
+                               lambda: trace_store.pin_evictions)
+        # decode-plane flight recorder (obs/engine_timeline.py) + the
+        # per-tenant usage ledger (obs/usage.py): sized here, zero-
+        # registered so the doc-drift contract covers every family at boot
+        engine_timeline.configure(cfg.obs.timeline_capacity,
+                                  cfg.obs.timeline_prompt_window)
+        metrics.register_gauge("obs.timeline_events",
+                               engine_timeline.__len__)
+        usage.set_max_tenants(cfg.obs.usage_max_tenants)
+        usage.register_zero()
         if cfg.obs.histogram_buckets_ms:
             metrics.set_bucket_bounds(cfg.obs.histogram_buckets_ms)
         register_process_gauges()  # platform-guarded no-op off Linux
@@ -98,7 +122,9 @@ class SymbiontStack:
             from symbiont_tpu.obs.watchdog import SloWatchdog, parse_thresholds
 
             self.watchdog = SloWatchdog(parse_thresholds(cfg.obs.slo_p99_ms),
-                                        interval_s=cfg.obs.slo_interval_s)
+                                        interval_s=cfg.obs.slo_interval_s,
+                                        burn_fast_s=cfg.obs.slo_burn_fast_s,
+                                        burn_slow_s=cfg.obs.slo_burn_slow_s)
             self.watchdog.start()
 
         self.services = []

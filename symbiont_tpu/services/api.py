@@ -482,6 +482,17 @@ class ApiService:
                 return 200, json.dumps({"traces": trace_store.recent()})
             if path.startswith("/api/traces/") and method == "GET":
                 return self._trace_route(path[len("/api/traces/"):], query)
+            if path == "/api/engine/timeline" and method == "GET":
+                # decode-plane flight recorder (obs/engine_timeline.py):
+                # JSON summary by default; ?fmt=chrome renders Perfetto
+                # counter tracks interleaved with the flight recorder's
+                # engine span lanes on one time axis
+                return self._engine_timeline(query)
+            if path == "/api/tenants" and method == "GET":
+                # per-tenant usage roll-up (obs/usage.py): this process's
+                # ledger, plus every federated role's tenant.usage.*
+                # counters when the fleet aggregator is attached
+                return self._tenants_rollup()
             if path == "/api/fleet" and method == "GET":
                 # per-role deployment roll-up (obs/fleet.py): telemetry
                 # freshness, supervisor liveness verdicts (up / restarts /
@@ -576,6 +587,77 @@ class ApiService:
                                                              spans))
         return 404, json.dumps({"message": "not found", "task_id": None})
 
+    # engine-shaped span lanes the timeline export interleaves with its
+    # counter tracks (first dot-segment of the span name)
+    _TIMELINE_SERVICES = ("engine", "lm", "text_generator")
+
+    def _engine_timeline(self, query: str) -> Tuple[int, str]:
+        """``GET /api/engine/timeline``: the decode-plane flight recorder's
+        summary (occupancy, stranded KV, prefix share, TTFT/TPOT, dominant
+        stall) or, with ``?fmt=chrome``, a Perfetto-loadable document whose
+        counter tracks ride the same time axis as the engine span lanes."""
+        from urllib.parse import parse_qs
+
+        from symbiont_tpu.obs import chrome_trace
+        from symbiont_tpu.obs.engine_timeline import engine_timeline
+        from symbiont_tpu.obs.trace_store import trace_store
+
+        fmt = (parse_qs(query).get("fmt") or ["json"])[0]
+        events = engine_timeline.events()
+        if fmt == "json":
+            return 200, json.dumps({
+                "summary": engine_timeline.summary(),
+                "events": events[-256:],
+            })
+        if fmt != "chrome":
+            return 400, json.dumps(
+                {"message": f"unknown timeline format {fmt!r} "
+                            "(supported: json, chrome)", "task_id": None})
+        if not events:
+            return 404, json.dumps(
+                {"message": "no engine timeline recorded yet — drive some "
+                            "embed/decode traffic first", "task_id": None})
+        t0 = min(e["t"] for e in events) - 1.0
+        t1 = max(e["t"] for e in events) + 1.0
+        spans = []
+        for trace_spans in trace_store.spans_by_trace().values():
+            for r in trace_spans:
+                if (chrome_trace.service_of(r.name) in self._TIMELINE_SERVICES
+                        and t0 <= r.start_s <= t1):
+                    spans.append(r)
+        return 200, json.dumps(chrome_trace.export_timeline(
+            "engine-timeline", spans, events))
+
+    def _tenants_rollup(self) -> Tuple[int, str]:
+        """``GET /api/tenants``: local per-tenant usage totals, plus the
+        federated per-role view folded from each role's
+        ``tenant.usage.*`` counters (obs/fleet.py snapshots) when this
+        process hosts the fleet aggregator."""
+        import time as _time
+
+        from symbiont_tpu.obs.prometheus import parse_flat_key
+        from symbiont_tpu.obs.usage import usage
+
+        roles: Dict[str, dict] = {}
+        if self.fleet is not None:
+            for role, flat in self.fleet.role_snapshots().items():
+                for key, v in flat.items():
+                    parsed = parse_flat_key(key)
+                    if parsed is None:
+                        continue
+                    kind, name, labels, stat = parsed
+                    if (kind != "counter" or stat is not None
+                            or not name.startswith("tenant.usage.")):
+                        continue
+                    tenant = labels.get("tenant") or "default"
+                    roles.setdefault(role, {}).setdefault(tenant, {})[
+                        name[len("tenant.usage."):]] = v
+        return 200, json.dumps({
+            "generated_at": round(_time.time(), 3),
+            "tenants": usage.snapshot(),
+            "roles": roles,
+        })
+
     # ------------------------------------------------------- admission edge
 
     @staticmethod
@@ -657,6 +739,14 @@ class ApiService:
         if deadline is not None:
             extra[DEADLINE_HEADER] = deadline
         return tenant, extra
+
+    @staticmethod
+    def _meter_search(tenant: str) -> None:
+        """Usage ledger (obs/usage.py): one ADMITTED search query billed to
+        its tenant — 429s never bill (refused work is not usage)."""
+        from symbiont_tpu.obs.usage import usage
+
+        usage.note(tenant, search_queries=1)
 
     def _shed_retry_after_s(self) -> float:
         """Sheds hint a longer back-off than quota refills: the ladder only
@@ -742,6 +832,9 @@ class ApiService:
         tenant, extra = self._edge_admit("search", headers)
         top_k, _ = self._degraded_top_k(tenant, top_k)
         async with self._search_slot(tenant):
+            # billed only once the fair-queue slot is HELD: a queue_full
+            # 429 is refused work and must not bill (same stance as quota)
+            self._meter_search(tenant)
             with span("api.graph_search", self._trace_ctx(headers),
                       top_k=top_k) as sp:
                 try:
@@ -788,6 +881,9 @@ class ApiService:
             # cheaper beats failing while the SLO recovers
             req.rerank = False
         async with self._search_slot(tenant):
+            # billed only once the fair-queue slot is HELD: a queue_full
+            # 429 is refused work and must not bill (same stance as quota)
+            self._meter_search(tenant)
             return await self._semantic_search_inner(req, request_id,
                                                      headers, extra)
 

@@ -69,6 +69,18 @@ class TextGeneratorService(Service):
         self.lm_stream = lm_stream  # Callable[..., Iterator[str]] | None —
         # when set, deltas stream out on events.text.generated.partial while
         # decoding; the final full message still rides events.text.generated
+        # usage metering (obs/usage.py): pass the tenant through to the
+        # engine when the stream callable takes it (LmEngine.generate_stream
+        # does; duck-typed test stubs may not — probed once here)
+        self._stream_takes_tenant = False
+        if lm_stream is not None:
+            import inspect
+
+            try:
+                self._stream_takes_tenant = (
+                    "tenant" in inspect.signature(lm_stream).parameters)
+            except (TypeError, ValueError):
+                self._stream_takes_tenant = False
         self.train_on_ingest = train_on_ingest
         # online LM fine-tune (train/online.OnlineLmTrainer | None): the LM
         # analog of Markov's continuous learning — ingested text buffers
@@ -350,10 +362,14 @@ class TextGeneratorService(Service):
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
+        kw = {}
+        if self._stream_takes_tenant:
+            kw["tenant"] = admission.tenant_of(headers)
+
         def produce() -> None:
             gen = self.lm_stream(task.prompt or "", task.max_length,
                                  temperature=task.temperature,
-                                 top_k=task.top_k)
+                                 top_k=task.top_k, **kw)
             try:
                 for delta in gen:
                     if cancel is not None and cancel.is_set():

@@ -717,7 +717,8 @@ def test_gen_batcher_requeue_wakes_run_loop():
                                  new_token_buckets=[16], temperature=1.0,
                                  top_k=0)
 
-        def start_session(self, prompts, max_new, temperature, top_k):
+        def start_session(self, prompts, max_new, temperature, top_k,
+                          tenants=None):
             return FakeSess()
 
     async def scenario():

@@ -132,6 +132,18 @@ def _boot_and_collect(tmp_path) -> set:
 def test_every_registered_family_is_documented(tmp_path):
     registered = _boot_and_collect(tmp_path)
     assert len(registered) >= 15, registered  # the boot really ran
+    # PR 15 families must be IN the sweep (registered at boot / by the one
+    # ingest), or the doc-drift contract silently stops covering them:
+    # usage metering counters, the tail-retention gauges, and the
+    # engine-timeline gauge all register on this stub boot
+    for family in ("tenant.usage.tokens_in", "tenant.usage.tokens_out",
+                   "tenant.usage.embed_rows", "tenant.usage.search_queries",
+                   "tenant.usage.kv_row_seconds", "obs.trace_pinned_traces",
+                   "obs.trace_sampled_out", "obs.trace_pin_evicted",
+                   "obs.timeline_events"):
+        assert family in registered, (
+            f"{family} no longer registers on the stub boot — the "
+            "doc-drift sweep has a blind spot")
     doc = (REPO / "docs" / "OBSERVABILITY.md").read_text()
     documented = _documented_families(doc)
     def covered(name: str) -> bool:
