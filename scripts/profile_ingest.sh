@@ -52,6 +52,38 @@ rows = [
 ]
 for name, val in rows:
     print("  " + name.ljust(28) + val)
+# paged-KV + radix rows appear only when the engine runs kv_layout=paged
+# (summary fields) and the kv.* gauges are registered — guard every key
+if s.get("decode_radix_hit_pct") is not None:
+    paged = [
+        ("radix prompt-token hits", f"{s['decode_radix_hit_pct']}%"),
+        ("TTFT radix-hit / cold", f"{s.get('decode_ttft_hit_ms_p50', '-')} "
+                                  f"/ {s.get('decode_ttft_cold_ms_p50', '-')}"
+                                  " ms"),
+        ("KV pages live", f"{s.get('decode_pages_live_pct', '-')}% of pool"),
+    ]
+    try:
+        with urllib.request.urlopen(f"http://{api}/api/metrics",
+                                    timeout=10) as r:
+            g = json.load(r).get("gauges", {})
+        def kv(name):
+            for k, v in g.items():
+                if k == name or k.startswith(name + "{"):
+                    return v
+            return None
+    except Exception:
+        def kv(name):
+            return None
+    free, live, frag = (kv("kv.pages_free"), kv("kv.pages_live"),
+                        kv("kv.page_fragmentation_pct"))
+    if free is not None or live is not None:
+        paged.append(("page pool free / live",
+                      f"{'-' if free is None else int(free)} / "
+                      f"{'-' if live is None else int(live)} pages"))
+    if frag is not None:
+        paged.append(("page fragmentation", f"{frag}%"))
+    for name, val in paged:
+        print("  " + name.ljust(28) + val)
 print("dominant stall:", s["dominant_stall"])
 print(f"(Perfetto view: curl http://{api}"
       "'/api/engine/timeline?fmt=chrome' > tl.json, open in "

@@ -178,16 +178,22 @@ def tier_streaming(results: dict, ctx) -> None:
         f"delta {best_first * 1000:.0f}ms, full stream {best_total:.2f}s")
 
 
-@register("decode_timeline")
+@register("decode_timeline",
+          primary_metrics=("decode_sessions_per_gib",
+                           "decode_radix_hit_pct"))
 def tier_decode_timeline(results: dict, ctx) -> None:
     """Decode-plane flight recorder under a REAL continuous-batching
-    session mix (obs/engine_timeline.py): a GenBatcher over a small
-    synthetic LM serves a first wave of shared-prefix requests plus a
-    second wave that ADMITS mid-flight, then the tier archives the
-    timeline's summary — per-step batch occupancy, KV rows stranded by
-    the dense slabs, the prefix-share the radix cache of ROADMAP item 2
-    would exploit, and engine-side TTFT/TPOT. These are the measured
-    'before' numbers every paged-KV / shared-prefix / packing PR moves."""
+    session mix (obs/engine_timeline.py), run TWICE: once on the dense
+    max-length-slab layout (the pre-paged 'before' — its fields archive
+    with a `_dense` suffix) and once on `kv_layout=paged` with the radix
+    prefix cache (symbiont_tpu/kv/), whose summary provides the headline
+    `decode_*` fields. The mix is mixed-length (long shared-prefix wave,
+    short mid-flight admits) plus a REPEAT wave of already-committed
+    prompts, so the paged run exercises lazy page growth, COW prefix
+    sharing, and the full-hit skip-prefill path. Primaries:
+    `decode_sessions_per_gib` (live sessions one GiB of KV holds at the
+    measured occupancy — the paged capacity win) and
+    `decode_radix_hit_pct` (prompt tokens served from shared pages)."""
     import asyncio
 
     from symbiont_tpu.config import LmConfig
@@ -195,35 +201,97 @@ def tier_decode_timeline(results: dict, ctx) -> None:
     from symbiont_tpu.engine.lm import LmEngine
     from symbiont_tpu.obs.engine_timeline import engine_timeline
 
-    engine_timeline.clear()  # the window must be THIS tier's traffic
-    eng = LmEngine(LmConfig(
-        enabled=True, arch="gpt2", hidden_size=128, num_layers=2,
-        num_heads=2, intermediate_size=256, max_positions=256,
-        dtype="float32", prompt_buckets=[32], new_token_buckets=[32],
-        stream_chunk=8, gen_max_batch=8, gen_flush_deadline_ms=5.0,
-        session_min_rows=4, temperature=0.0))
     shared = "symbiont rag template: answer from the retrieved context. "
+    GIB = float(1 << 30)
 
-    async def drive() -> None:
-        batcher = GenBatcher(eng)
-        await batcher.start()
-        try:
-            wave1 = [asyncio.ensure_future(batcher.generate(
-                shared + f"query {i}", 24, tenant=f"t{i % 2}"))
-                for i in range(4)]
-            await asyncio.sleep(0.05)  # wave 2 lands mid-decode: admission
-            wave2 = [asyncio.ensure_future(batcher.generate(
-                shared + f"late {i}", 8, tenant="t2"))
-                for i in range(3)]
-            done = await asyncio.gather(*wave1, *wave2)
-            assert all(isinstance(t, str) for t in done), done
-        finally:
-            await batcher.close()
+    def mk(layout: str) -> "LmEngine":
+        return LmEngine(LmConfig(
+            enabled=True, arch="gpt2", hidden_size=128, num_layers=2,
+            num_heads=2, intermediate_size=256, max_positions=256,
+            dtype="float32", prompt_buckets=[32], new_token_buckets=[64],
+            stream_chunk=8, gen_max_batch=8, gen_flush_deadline_ms=5.0,
+            # min_rows 8: the serving-shaped config — sessions keep free
+            # row slots so mid-flight admits join instead of fragmenting.
+            # Dense pays for that headroom in full-slab HBM (every bucket
+            # row gets a (32+64)-slot slab up front); paged pays nothing
+            # until a real row touches a page
+            session_min_rows=8, temperature=0.0, kv_layout=layout,
+            kv_page_tokens=16))
 
-    asyncio.run(drive())
+    def drive(eng, repeat: bool) -> None:
+        async def scenario() -> None:
+            batcher = GenBatcher(eng)
+            await batcher.start()
+            try:
+                # mixed LENGTHS on purpose: long rows decode most of the
+                # 64-token bucket while short rows finish after 8 — dense
+                # keeps every row's full (32+64)-slot slab allocated until
+                # the session ends, paged returns a finished row's pages
+                # at the next chunk boundary and long rows grow page by
+                # page instead of starting slab-sized
+                wave1 = [asyncio.ensure_future(batcher.generate(
+                    shared + f"query {i}", 48, tenant=f"t{i % 2}"))
+                    for i in range(4)]
+                await asyncio.sleep(0.05)  # wave 2 lands mid-decode
+                wave2 = [asyncio.ensure_future(batcher.generate(
+                    shared + f"late {i}", 8, tenant="t2"))
+                    for i in range(3)]
+                done = await asyncio.gather(*wave1, *wave2)
+                assert all(isinstance(t, str) for t in done), done
+                if repeat:
+                    # the RAG-template case: identical prompts re-admitted
+                    # after their prefix pages are committed — full radix
+                    # hits, prefill skipped, TTFT ~one decode chunk
+                    done = await asyncio.gather(*[
+                        batcher.generate(shared + f"query {i}", 48,
+                                         tenant="t3") for i in range(4)])
+                    assert all(isinstance(t, str) for t in done), done
+            finally:
+                await batcher.close()
+
+        asyncio.run(scenario())
+
+    def sessions_per_gib(eng, events) -> float:
+        """Mean live rows per KV byte actually HELD, scaled to one GiB —
+        dense holds full slabs for every allocated row, paged holds only
+        the pages live rows have touched."""
+        steps = [e for e in events if e["kind"] == "step" and e["rows_live"]]
+        if not steps:
+            return 0.0
+        if eng.pool is not None:
+            page_bytes = eng.pool.device_bytes / eng.pool.n_pages
+            per_gib = [e["rows_live"] * GIB / (e["pages_live"] * page_bytes)
+                       for e in steps if e.get("pages_live")]
+        else:
+            mc = eng.model_cfg
+            T = 32 + 64  # the tier's single (prompt, new) bucket pair
+            itemsize = 1 if eng.config.kv_quant == "int8" else (
+                2 if mc.dtype == "bfloat16" else 4)
+            row_bytes = 2 * mc.num_layers * T * mc.kv_heads * mc.head_dim \
+                * itemsize
+            per_gib = [e["rows_live"] * GIB
+                       / (e["kv_rows_allocated"] * row_bytes)
+                       for e in steps if e["kv_rows_allocated"]]
+        return round(sum(per_gib) / len(per_gib), 1) if per_gib else 0.0
+
+    # ---- dense 'before' pass -------------------------------------------
+    engine_timeline.clear()  # the window must be THIS phase's traffic
+    dense = mk("dense")
+    drive(dense, repeat=True)
+    sd = engine_timeline.summary()
+    if not sd["decode_steps"]:
+        raise RuntimeError("dense decode session recorded no timeline steps")
+    results["decode_kv_stranded_pct_dense"] = sd["decode_kv_stranded_pct"]
+    results["decode_sessions_per_gib_dense"] = sessions_per_gib(
+        dense, engine_timeline.events())
+
+    # ---- paged + radix pass --------------------------------------------
+    engine_timeline.clear()
+    paged = mk("paged")
+    drive(paged, repeat=True)
     s = engine_timeline.summary()
     if not s["decode_steps"]:
-        raise RuntimeError("decode session recorded no timeline steps")
+        raise RuntimeError("paged decode session recorded no timeline steps")
     results["decode_occupancy_pct"] = s["decode_occupancy_pct"]
     results["decode_kv_stranded_pct"] = s["decode_kv_stranded_pct"]
     results["decode_prefix_share_pct"] = s["decode_prefix_share_pct"]
@@ -231,10 +299,22 @@ def tier_decode_timeline(results: dict, ctx) -> None:
     results["decode_tpot_ms_p50"] = s["decode_tpot_ms_p50"]
     results["decode_timeline_steps"] = s["decode_steps"]
     results["decode_timeline_admits"] = s["decode_admits"]
-    log(f"decode timeline: {s['decode_steps']} steps, occupancy "
-        f"{s['decode_occupancy_pct']}%, stranded KV "
-        f"{s['decode_kv_stranded_pct']}%, prefix share "
-        f"{s['decode_prefix_share_pct']}%, TTFT p50 "
-        f"{s['decode_ttft_ms_p50']}ms, TPOT p50 "
+    results["decode_radix_hit_pct"] = s.get("decode_radix_hit_pct", 0.0)
+    results["decode_ttft_hit_ms_p50"] = s.get("decode_ttft_hit_ms_p50", 0.0)
+    results["decode_ttft_cold_ms_p50"] = s.get("decode_ttft_cold_ms_p50",
+                                               0.0)
+    results["decode_sessions_per_gib"] = sessions_per_gib(
+        paged, engine_timeline.events())
+    log(f"decode timeline (paged+radix): {s['decode_steps']} steps, "
+        f"occupancy {s['decode_occupancy_pct']}%, stranded KV "
+        f"{s['decode_kv_stranded_pct']}% (dense before: "
+        f"{sd['decode_kv_stranded_pct']}%), prefix share "
+        f"{s['decode_prefix_share_pct']}%, radix hits "
+        f"{results['decode_radix_hit_pct']}% of prompt tokens, sessions/GiB "
+        f"{results['decode_sessions_per_gib']} (dense "
+        f"{results['decode_sessions_per_gib_dense']}), TTFT p50 "
+        f"{s['decode_ttft_ms_p50']}ms (radix hit "
+        f"{results['decode_ttft_hit_ms_p50']}ms vs cold "
+        f"{results['decode_ttft_cold_ms_p50']}ms), TPOT p50 "
         f"{s['decode_tpot_ms_p50']}ms; dominant stall: "
         f"{s['dominant_stall']}")

@@ -774,7 +774,7 @@ embed-side packing opportunity. Every decode-plane PR of ROADMAP items
             "`decode_kv_stranded_pct`, `decode_prefix_share_pct`, "
             "`decode_ttft_ms_p50`, `decode_tpot_ms_p50`) will appear from "
             "the next full `python bench.py` run.\n\n")
-    return header + (
+    measured = (
         f"Measured this run over "
         f"{_fmt(f.get('decode_timeline_steps', 0))} decode steps / "
         f"{_fmt(f.get('decode_timeline_admits', 0))} admissions: batch "
@@ -783,6 +783,27 @@ embed-side packing opportunity. Every decode-plane PR of ROADMAP items
         f"prefix share **{f['decode_prefix_share_pct']} %**, TTFT p50 "
         f"{f.get('decode_ttft_ms_p50', '—')} ms, TPOT p50 "
         f"{f.get('decode_tpot_ms_p50', '—')} ms/token.\n\n")
+    if "decode_sessions_per_gib" not in f:
+        # the paged-KV + radix-cache primaries (symbiont_tpu/kv/) land
+        # in the archive once the tier runs against that subsystem
+        return header + measured + (
+            "This archive predates the paged-KV tier rewrite, so the "
+            "paged fields (`decode_sessions_per_gib` vs "
+            "`decode_sessions_per_gib_dense`, `decode_radix_hit_pct`, "
+            "`decode_ttft_hit_ms_p50` / `decode_ttft_cold_ms_p50`) will "
+            "appear from the next full `python bench.py` run.\n\n")
+    dense = f.get("decode_sessions_per_gib_dense", 0) or 0
+    ratio = (f["decode_sessions_per_gib"] / dense) if dense else 0.0
+    return header + measured + (
+        f"Paged KV + radix prefix cache (`symbiont_tpu/kv/`): "
+        f"**{_fmt(f['decode_sessions_per_gib'])} sessions/GiB** vs "
+        f"{_fmt(dense)} for the dense layout on the same mix "
+        f"(**{ratio:.2f}×**), radix cache served "
+        f"**{f['decode_radix_hit_pct']} %** of prompt tokens from "
+        f"committed pages, and a full-prompt radix hit cut TTFT p50 to "
+        f"**{f.get('decode_ttft_hit_ms_p50', '—')} ms** (one decode "
+        f"chunk) vs {f.get('decode_ttft_cold_ms_p50', '—')} ms for a "
+        f"cold prefill.\n\n")
 
 
 def _render_autoscale(f: dict) -> str:

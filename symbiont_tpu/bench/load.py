@@ -159,7 +159,7 @@ async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
                           sse_keepalive_s=0.5),
             lm=LmConfig(enabled=True, hidden_size=32, num_layers=1,
                         num_heads=2, intermediate_size=64, max_positions=64,
-                        dtype="float32", prompt_buckets=[16],
+                        dtype="float32", prompt_buckets=[16, 32],
                         new_token_buckets=[16], stream_chunk=8,
                         gen_flush_deadline_ms=5.0, temperature=0.0),
             # slo_interval_s far beyond the tier's runtime: scenario 6
@@ -372,13 +372,24 @@ async def _drive(results: dict, load_seed: int, chaos_seed: int) -> None:
             results["load_deadline_429"] = 1.0
 
             # ---- scenario 3: streaming generation (TTFT over SSE) --------
+            # mixed-length mix: prompts spanning both prompt buckets and
+            # varying new-token budgets, so TTFT covers bucket mixing the
+            # way real traffic does (and the paged-KV layout sees uneven
+            # per-row page growth rather than one uniform shape)
+            GEN_MIX = [("symbiont tensor", 6),
+                       ("symbiont tensor graft compiles static shapes", 12),
+                       ("symbiont tensor graft streams paged kv pages "
+                        "across the decode plane under load", 16)]
+
             async def one_stream(i, timeout_s=90.0):
+                prompt, max_len = GEN_MIX[
+                    (i if isinstance(i, int) else 0) % len(GEN_MIX)]
                 tid = f"load-gen-{i}"
                 t3 = time.monotonic()
                 status, _ = await http(
                     "POST", "/api/generate-text",
-                    {"task_id": tid, "prompt": "symbiont tensor",
-                     "max_length": 12, "stream": True},
+                    {"task_id": tid, "prompt": prompt,
+                     "max_length": max_len, "stream": True},
                     {"X-Symbiont-Tenant": "gen"})
                 assert status == 200, status
                 deadline = time.monotonic() + timeout_s

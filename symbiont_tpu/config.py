@@ -187,6 +187,31 @@ class LmConfig:
     # cost of ~0.4% K/V rounding (greedy-identity gate:
     # tests/test_quantization.py).
     kv_quant: str = "none"
+    # KV-cache LAYOUT for continuous-batching decode sessions (the paged KV
+    # subsystem, symbiont_tpu/kv/ — docs/KV.md). "dense" keeps one
+    # max-length slab per session row (the pre-paged behavior); "paged"
+    # stores K/V in fixed-size pages drawn from a preallocated device pool
+    # (kv/pool.py) gathered into attention via a per-row page table, so a
+    # session occupies pages proportional to tokens actually decoded
+    # instead of its worst-case slab. Token-identical to dense across
+    # kv_quant modes (tests/test_kv_paged.py); composes with kv_quant=int8
+    # (int8 page pools + f32 scale pools).
+    kv_layout: str = "dense"
+    # tokens per KV page. Must divide every prompt bucket so the prompt
+    # region of a row is whole pages (the radix cache shares at page
+    # granularity and decode writes never land in a shared prompt page).
+    # Smaller pages waste less on short sessions but grow the page table.
+    kv_page_tokens: int = 16
+    # device pool size in pages; 0 = auto (dense-equivalent capacity for
+    # one max-geometry session batch, ×2 headroom for radix retention).
+    kv_pool_pages: int = 0
+    # refcounted radix prefix cache over committed prompt pages
+    # (kv/radix.py): admits whose prompts share a cached prefix reuse the
+    # committed pages (refcount++) instead of re-materializing them, and a
+    # FULL-prompt hit skips its prefill entirely (TTFT collapses to ~one
+    # decode chunk). Refcount-0 pages are retained and evicted LRU under
+    # pool pressure. Only meaningful with kv_layout="paged".
+    kv_radix: bool = True
     # online fine-tune over ingested text (train/online.py): the LM analog of
     # the Markov backend's continuous learning. Off by default — training
     # shares the device with serving.
@@ -210,6 +235,24 @@ class LmConfig:
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"lm.kv_quant must be none|int8, got {self.kv_quant!r}")
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"lm.kv_layout must be dense|paged, got {self.kv_layout!r}")
+        if self.kv_layout == "paged":
+            if self.kv_page_tokens < 1:
+                raise ValueError("lm.kv_page_tokens must be >= 1")
+            bad = [b for b in self.prompt_buckets
+                   if b % self.kv_page_tokens]
+            if bad:
+                # prompt region must be whole pages: the radix cache shares
+                # committed prompt pages between sessions, and a page
+                # straddling the prompt/decode boundary would receive
+                # per-session decode writes — unshareable by construction
+                raise ValueError(
+                    f"kv_page_tokens={self.kv_page_tokens} must divide "
+                    f"every prompt bucket; offending buckets: {bad}")
+            if self.kv_pool_pages < 0:
+                raise ValueError("lm.kv_pool_pages must be >= 0 (0 = auto)")
         if self.gen_tenant_lane_depth < 0:
             raise ValueError("lm.gen_tenant_lane_depth must be >= 0")
         # the streaming decode loop runs whole chunks against a KV cache with

@@ -29,8 +29,10 @@ from typing import Optional, Sequence
 import numpy as np
 
 from symbiont_tpu.config import LmConfig
+from symbiont_tpu.kv.pool import PagePool, kv_dtype_label
+from symbiont_tpu.kv.radix import RadixCache
 from symbiont_tpu.models import gpt as gpt_mod
-from symbiont_tpu.models.gpt import GPTConfig
+from symbiont_tpu.models.gpt import GPTConfig, PagedKVCache
 from symbiont_tpu.obs.engine_timeline import engine_timeline
 from symbiont_tpu.obs.usage import usage
 from symbiont_tpu.resilience.admission import DEFAULT_TENANT
@@ -257,7 +259,47 @@ class LmEngine:
         # whole decode calls and a scrape must never block behind one).
         self._sessions: "weakref.WeakSet" = weakref.WeakSet()
         self._sessions_lock = threading.Lock()
+        # paged KV subsystem (symbiont_tpu/kv/, docs/KV.md): one engine-
+        # global device page pool + host allocator, and optionally the
+        # radix prefix cache over committed prompt pages. Dense layout
+        # leaves both None and every downstream branch on the old path.
+        self.pool: Optional[PagePool] = None
+        self.radix: Optional[RadixCache] = None
+        if cfg.kv_layout == "paged":
+            import jax.numpy as jnp
+
+            n_pages = cfg.kv_pool_pages or self._auto_pool_pages()
+            self.pool = PagePool(
+                model_cfg.num_layers, n_pages, cfg.kv_page_tokens,
+                model_cfg.kv_heads, model_cfg.head_dim,
+                jnp.dtype(model_cfg.dtype),
+                quantized=(model_cfg.kv_quant == "int8"),
+                dtype_label=kv_dtype_label(model_cfg.dtype,
+                                           model_cfg.kv_quant))
+            if cfg.kv_radix:
+                self.radix = RadixCache(self.pool, cfg.kv_page_tokens)
+            log.info("paged KV pool: %d pages x %d tokens (%.1f MiB%s)",
+                     n_pages, cfg.kv_page_tokens,
+                     self.pool.device_bytes / (1 << 20),
+                     ", radix on" if self.radix is not None else "")
         self._register_gauges()
+
+    def _auto_pool_pages(self) -> int:
+        """kv_pool_pages=0 sizing: the dense-equivalent capacity of ONE
+        max-geometry session batch (every row at the largest in-range
+        (prompt, new) bucket pair), x2 for radix retention headroom, +1
+        for the scratch page. Paging wins by needing far fewer of these
+        pages live at once — the x2 pool still beats dense slabs because
+        dense allocates that worst case PER SESSION."""
+        cfg = self.config
+        new_b = max(cfg.new_token_buckets)
+        cap = self.model_cfg.max_position_embeddings - new_b
+        usable = [b for b in cfg.prompt_buckets if b <= cap]
+        T = (usable[-1] if usable else max(cap, 1)) + new_b
+        rows = max(cfg.session_min_rows, cfg.gen_max_batch, 1)
+        bb = 1 << (rows - 1).bit_length() if rows > 1 else 1
+        blocks = -(-T // cfg.kv_page_tokens)
+        return 2 * bb * blocks + 1
 
     def _register_gauges(self) -> None:
         """Engine-plane decode gauges (docs/OBSERVABILITY.md): KV-cache row
@@ -289,7 +331,11 @@ class LmEngine:
             # dtype-adjusted occupancy: actual at-rest bytes of every live
             # session's cache (int8 slabs + scale planes when kv_quant is
             # on) — the companion to the row counts above, so capacity
-            # planning sees bytes, not just rows
+            # planning sees bytes, not just rows. Paged layout: the pool
+            # IS the resident allocation (sessions hold page tables, not
+            # slabs), so report its preallocated device bytes.
+            if lm.pool is not None:
+                return lm.pool.device_bytes
             with lm._sessions_lock:
                 sessions = list(lm._sessions)
             return sum(gpt_mod.cache_bytes(s._cache) for s in sessions
@@ -298,9 +344,19 @@ class LmEngine:
         def kv_rows_per_gib(lm):
             # how many session rows one GiB of HBM holds at the live
             # geometry and cache dtype — the "dtype-adjusted capacity"
-            # number (int8 ≈ 2× bf16's, ≈ 4× f32's)
+            # number (int8 ≈ 2× bf16's, ≈ 4× f32's). Paged layout: rows
+            # per GiB of OCCUPIED page bytes (live pages only) — the
+            # tentpole's density win: short/finished rows stop paying for
+            # their worst-case slab.
             with lm._sessions_lock:
                 sessions = [s for s in lm._sessions if not s.done()]
+            if lm.pool is not None:
+                rows = sum(sum(1 for r in s.rows if r is not None)
+                           for s in sessions)
+                occupied = (lm.pool.pages_live * lm.pool.device_bytes
+                            / lm.pool.n_pages)
+                return round(rows * (1 << 30) / occupied, 1) if occupied \
+                    else 0.0
             total = sum(gpt_mod.cache_bytes(s._cache) for s in sessions)
             rows = sum(s.bb for s in sessions)
             return round(rows * (1 << 30) / total, 1) if total else 0.0
@@ -308,13 +364,38 @@ class LmEngine:
         def kv_stranded(lm):
             # rows allocated in dense max-length slabs but NOT live (the
             # batch-bucket padding + finished/cancelled rows a paged KV
-            # layout would reclaim — ROADMAP item 2's target number)
+            # layout would reclaim — ROADMAP item 2's target number).
+            # Paged layout: a freed row returns its pages at the chunk
+            # boundary it died on, so rows holding device memory == live
+            # rows and this reads 0 by construction.
             with lm._sessions_lock:
                 sessions = [s for s in lm._sessions if not s.done()]
+            if lm.pool is not None:
+                holding = sum(s.rows_holding_pages() for s in sessions)
+                live = sum(sum(1 for r in s.rows if r is not None)
+                           for s in sessions)
+                return holding - live
             alloc = sum(s.bb for s in sessions)
             live = sum(sum(1 for r in s.rows if r is not None)
                        for s in sessions)
             return alloc - live
+
+        def page_fragmentation(lm):
+            # allocated-but-dead page SLOTS across live rows (left-pad
+            # slots inside prompt pages + the unfilled tail of the newest
+            # decode page), as a pct of every slot the live rows map.
+            # Shared radix pages are counted once per mapping row — this
+            # is a utilization ratio of what rows hold, not of the pool.
+            if lm.pool is None:
+                return 0.0
+            with lm._sessions_lock:
+                sessions = [s for s in lm._sessions if not s.done()]
+            toks = slots = 0
+            for s in sessions:
+                t, sl = s.page_occupancy()
+                toks += t
+                slots += sl
+            return round(100.0 * (1.0 - toks / slots), 2) if slots else 0.0
 
         labels = {"service": "lm",
                   "kv_dtype": ("int8" if self.model_cfg.kv_quant == "int8"
@@ -331,6 +412,13 @@ class LmEngine:
                                        kv_rows_per_gib, labels=labels)
         metrics.register_weakref_gauge("lm.decode_tok_per_s", self,
                                        tok_per_s, labels=labels)
+        if self.pool is not None:
+            # pool-side kv.pages_free / kv.pages_live registered by the
+            # PagePool itself; fragmentation needs per-session token
+            # counts only the engine sees, so its reader lives here
+            metrics.register_weakref_gauge("kv.page_fragmentation_pct",
+                                           self, page_fragmentation,
+                                           labels=labels)
 
     def _note_param_bytes(self, params, storage) -> None:
         """Dtype-labeled at-rest parameter bytes (docs/OBSERVABILITY.md) —
@@ -629,21 +717,89 @@ class LmEngine:
     def kv_row_counts(self) -> tuple:
         """(live, allocated) decode rows across live sessions in ONE
         sessions-lock pass — the engine-timeline step events read both at
-        every chunk boundary."""
+        every chunk boundary. Under the paged layout "allocated" counts
+        rows actually HOLDING pages (freed rows return theirs at the
+        chunk boundary they die on), so the stranded gap dense slabs
+        carry reads zero by construction."""
         with self._sessions_lock:
             sessions = [s for s in self._sessions if not s.done()]
-        alloc = sum(s.bb for s in sessions)
+        if self.pool is not None:
+            alloc = sum(s.rows_holding_pages() for s in sessions)
+        else:
+            alloc = sum(s.bb for s in sessions)
         live = sum(sum(1 for r in s.rows if r is not None)
                    for s in sessions)
         return live, alloc
 
-    def can_admit(self, n_rows: int = 1, max_kv_rows: int = 0) -> bool:
+    def pages_reserved(self) -> int:
+        """Pages live sessions may still lazily allocate for rows already
+        admitted (their worst-case remaining decode blocks). Admission
+        must leave this many free+evictable pages untouched or a session
+        could hit PoolExhausted mid-decode."""
+        with self._sessions_lock:
+            sessions = [s for s in self._sessions if not s.done()]
+        return sum(s.pages_reserved() for s in sessions)
+
+    def _pages_needed(self, n_rows: int, prompts=None,
+                      max_new_tokens=None) -> int:
+        """FRESH pages `n_rows` admissions will need. Without prompts:
+        the worst-case block count at the largest in-range (prompt, new)
+        bucket pair. With prompts (and the radix cache on): the exact
+        quote — each prompt is encoded, bucketed, and radix-matched, and
+        blocks already committed for its prefix cost nothing (a
+        radix-hit admit needs fewer fresh pages, so admission control
+        stops 429ing traffic the pool can actually serve)."""
+        cfg = self.config
+        page = cfg.kv_page_tokens
+        if prompts is None:
+            new_b = max(cfg.new_token_buckets)
+            cap = self.model_cfg.max_position_embeddings - new_b
+            usable = [b for b in cfg.prompt_buckets if b <= cap]
+            T = (usable[-1] if usable else max(cap, 1)) + new_b
+            return max(1, int(n_rows)) * (-(-T // page))
+        total = 0
+        wants = list(max_new_tokens) if max_new_tokens is not None else \
+            [max(cfg.new_token_buckets)] * len(prompts)
+        for prompt, want in zip(prompts, wants):
+            new_b = _round_up(int(want), cfg.new_token_buckets)
+            cap = self.model_cfg.max_position_embeddings - new_b
+            avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
+            ids = self.tokenizer.encode(prompt or "", 1 << 30)[-avail[-1]:]
+            if not ids:
+                ids = [getattr(self.tokenizer, "bos_id", 0)]
+            P = _round_up(len(ids), avail)
+            blocks = -(-(P + new_b) // page)
+            hit = 0
+            if self.radix is not None:
+                pad = P - len(ids)
+                ids_r = np.zeros(P, np.int32)
+                ids_r[pad:] = ids
+                hit = self.radix.match(P, pad, ids_r).blocks
+            total += blocks - hit
+        return total
+
+    def can_admit(self, n_rows: int = 1, max_kv_rows: int = 0,
+                  prompts=None, max_new_tokens=None) -> bool:
         """Capacity-aware generation admission (resilience/admission.py):
         may `n_rows` more decode rows start without pushing allocated KV
         rows past `max_kv_rows`? The API edge consults this BEFORE
         accepting a generation stream, so overload answers 429 instead of
         growing KV caches until the device OOMs. cap <= 0 = unbounded
-        (the pre-plane behavior)."""
+        (the pre-plane behavior).
+
+        Paged layout: the binding resource is PAGES, not slab rows — the
+        quote is fresh pages needed (worst-case by default; exact, radix
+        hits deducted, when `prompts`/`max_new_tokens` are passed) against
+        free + LRU-evictable pages minus what admitted rows may still
+        lazily claim. The row cap still applies on top when set."""
+        if self.pool is not None:
+            need = self._pages_needed(max(1, int(n_rows)), prompts,
+                                      max_new_tokens)
+            with self.pool.lock:
+                avail = (self.pool.pages_free + self.pool.pages_retained
+                         - self.pages_reserved())
+            if need > avail:
+                return False
         if max_kv_rows <= 0:
             return True
         return self.kv_rows_allocated() + max(1, int(n_rows)) <= max_kv_rows
@@ -658,6 +814,13 @@ class LmEngine:
         (OnlineLmTrainer passes a copy)."""
         with self._lock:
             self.params = self._place_params(params)
+        if self.radix is not None:
+            # committed prefix pages (and their stored full-prompt logits)
+            # were computed under the OLD weights — a post-swap admit must
+            # not splice them into its context. Live rows keep their own
+            # pages: same old-params-context contract as an in-progress
+            # stream.
+            self.radix.clear()
 
     def warmup(self, new_bucket: Optional[int] = None) -> None:
         """Pre-compile the hot (prompt, new) executable pair."""
@@ -684,14 +847,35 @@ def _real_token_rows(prompt_ids, prompt_mask, n: int) -> list:
     return out
 
 
+def _right_aligned_rows(prompt_ids, prompt_mask) -> tuple:
+    """Host mirror of gpt._align_prompt's token layout: (ids_r [bb, P]
+    with 0 at left-pad slots, pads [bb]). The radix cache keys pages by
+    exactly the token layout the staged prefill writes, so its match keys
+    must be computed the same way."""
+    bb, P = prompt_ids.shape
+    ids_r = np.zeros((bb, P), np.int32)
+    pads = np.empty(bb, np.int32)
+    for i in range(bb):
+        ln = int(prompt_mask[i].sum())
+        pads[i] = P - ln
+        if ln:
+            ids_r[i, P - ln:] = prompt_ids[i, :ln]
+    return ids_r, pads
+
+
 class _SessionRow:
-    __slots__ = ("tag", "want", "tokens", "tenant", "created", "first_tok")
+    __slots__ = ("tag", "want", "tokens", "tenant", "created", "first_tok",
+                 "radix_hit")
 
     def __init__(self, tag: int, want: int, tenant: str = DEFAULT_TENANT,
-                 created: Optional[float] = None):
+                 created: Optional[float] = None, radix_hit: bool = False):
         self.tag = tag
         self.want = want
         self.tokens: list = []
+        # FULL radix hit: the row's prefill was skipped outright (its
+        # whole prompt was committed pages + stored logits) — feeds the
+        # hit-vs-cold TTFT split in the engine timeline
+        self.radix_hit = radix_hit
         # usage ledger + engine-side TTFT (obs/engine_timeline.py): the
         # fairness-lane tenant this row bills to, when the row's PREFILL
         # started (splice passes prepare_admit's entry time — a spliced
@@ -750,6 +934,23 @@ class BatchSession:
         self.rows += [None] * (self.bb - n)  # free slots from the row bucket
         self.steps_done = 0
         self.decode_s = 0.0
+        # paged-KV bookkeeping (symbiont_tpu/kv/): the HOST page-table
+        # mirror is authoritative — the device table is rebuilt from it
+        # whenever it changes (`_pt_dirty`; a [bb, n_blocks] int32 H2D is
+        # noise next to a decode chunk). Unmapped blocks point at the
+        # scratch page. `_row_pages` holds the page ids each row has a
+        # refcount on (released the moment the row finishes/cancels).
+        self._paged = lm.pool is not None
+        self._plen = prompt_mask.sum(axis=1).astype(np.int32)  # [bb]
+        self._row_pages: list = [[] for _ in range(self.bb)]
+        self._row_blocks = [0] * self.bb
+        if self._paged:
+            page = lm.pool.page_tokens
+            self._n_blocks = -(-(self.P + self.new_bucket) // page)
+            self._prompt_blocks = self.P // page
+            self._pt = np.zeros((self.bb, self._n_blocks), np.int32)
+            self._pt_dev = None
+            self._pt_dirty = True
         # decode-plane probes, all on host data already in hand
         # (obs/engine_timeline.py): token-id prefix overlap vs recently
         # admitted prompts, and exact prompt-token billing per tenant
@@ -758,23 +959,198 @@ class BatchSession:
         for i in range(n):
             usage.note(row_tenants[i],
                        tokens_in=int(prompt_mask[i].sum()))
+        # radix matching + prompt-page wiring, ONE pool-lock critical
+        # section: a matched page must be retained before any alloc in the
+        # same admission can LRU-evict it out from under us
+        matches: list = [None] * self.bb
+        skip_prefill = False
+        hit_tokens = 0
+        if self._paged:
+            ids_r_host, pads = _right_aligned_rows(prompt_ids, prompt_mask)
+            self._ids_r_host, self._pads = ids_r_host, pads
+            pool = lm.pool
+            with pool.lock:
+                for i in range(n):
+                    if lm.radix is not None:
+                        matches[i] = lm.radix.match(
+                            self.P, int(pads[i]), ids_r_host[i])
+                        for pid in matches[i].pages:
+                            pool.retain(pid)
+                skip_prefill = (lm.radix is not None and n > 0 and all(
+                    matches[i] is not None and matches[i].logits is not None
+                    for i in range(n)))
+                for i in range(n):
+                    shared = list(matches[i].pages) if matches[i] else []
+                    hit_tokens += max(0, len(shared) * pool.page_tokens
+                                      - int(pads[i]))
+                    fresh_n = self._prompt_blocks - len(shared)
+                    fresh = pool.alloc(fresh_n) if fresh_n else []
+                    pages = shared + fresh
+                    self._pt[i, :self._prompt_blocks] = pages
+                    self._row_pages[i] = pages
+                    self._row_blocks[i] = self._prompt_blocks
+                    self._pt_dirty = True
+            pool.note_hit_tokens(hit_tokens)
         with lm._lock:
             lm._key, self._sub = jax.random.split(lm._key)
             t0 = time.perf_counter()
-            (self._cache, self._logits, self._kv_valid,
-             prompt_len) = gpt_mod.prefill(
-                lm.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
-                lm.model_cfg, self.new_bucket)
+            if skip_prefill:
+                # every real row's FULL prompt is committed pages + stored
+                # logits: no prefill at all — restore the row state host-
+                # side and decode straight from the shared pages. TTFT
+                # collapses to ~one decode chunk (the radix-hit gate).
+                for i in range(n):
+                    self.rows[i].radix_hit = True
+                logits_np = np.zeros(
+                    (self.bb, lm.model_cfg.vocab_size), np.float32)
+                kvv = np.zeros((self.bb, self.P + self.new_bucket), bool)
+                kvv[:, self.P:] = True
+                for i in range(n):
+                    logits_np[i] = matches[i].logits
+                    kvv[i, int(pads[i]):self.P] = True
+                self._cache = None
+                self._logits = jnp.asarray(logits_np)
+                self._kv_valid = jnp.asarray(kvv)
+                prompt_len = jnp.asarray(self._plen)
+            else:
+                (staging, self._logits, self._kv_valid,
+                 prompt_len) = gpt_mod.prefill(
+                    lm.params, jnp.asarray(prompt_ids),
+                    jnp.asarray(prompt_mask), lm.model_cfg, self.new_bucket)
+                lm._prefill_shapes.add((self.bb, self.P, self.new_bucket))
+                if self._paged:
+                    # adopt the dense-staged prefill into the pool: scatter
+                    # each real row's FRESH prompt blocks (bit-copy — what
+                    # makes paged decode token-identical to dense). Radix-
+                    # shared blocks stay untouched (committed page content
+                    # is immutable); rows with no pages write to scratch.
+                    st = np.zeros((self.bb, self._prompt_blocks), np.int32)
+                    for i in range(n):
+                        nsh = len(matches[i].pages) if matches[i] else 0
+                        st[i, nsh:] = self._pt[i, nsh:self._prompt_blocks]
+                    pool = lm.pool
+                    pk, pv, pks, pvs = gpt_mod._paged.scatter_prompt(
+                        pool.k, pool.v, pool.k_scale, pool.v_scale,
+                        staging, jnp.asarray(st), self.P)
+                    pool.adopt_arrays(pk, pv, pks, pvs)
+                    self._cache = None
+                else:
+                    self._cache = staging
             prefill_s = time.perf_counter() - t0
             self.decode_s += prefill_s
             lm.stats["sessions"] = lm.stats.get("sessions", 0) + 1
-        engine_timeline.note_admit(rows=n, prefill_ms=prefill_s * 1000.0,
-                                  prefix_share=share, kind="start")
-        lm._prefill_shapes.add((self.bb, self.P, self.new_bucket))
+        if self._paged and lm.radix is not None and n and not skip_prefill:
+            # commit the freshly-materialized prompt blocks (and the full-
+            # prompt logits) so the NEXT admit with this prefix shares
+            # them. One host sync on [bb, V] logits, per session start —
+            # off the per-token decode path.
+            logits_host = np.asarray(self._logits)
+            with lm.pool.lock:
+                for i in range(n):
+                    lm.radix.commit(
+                        self.P, int(pads[i]), ids_r_host[i],
+                        [int(p) for p in self._pt[i, :self._prompt_blocks]],
+                        logits_host[i])
+        engine_timeline.note_admit(
+            rows=n, prefill_ms=prefill_s * 1000.0, prefix_share=share,
+            kind="start",
+            hit_tokens=hit_tokens if self._paged else None,
+            prompt_tokens=int(self._plen[:n].sum()) if self._paged else None)
         with lm._sessions_lock:  # weak: KV-occupancy gauges see live sessions
             lm._sessions.add(self)
         self._pos = prompt_len
         self._done = jnp.zeros((self.bb,), bool)
+
+    # ------------------------------------------------------- paged KV state
+
+    def rows_holding_pages(self) -> int:
+        """Rows currently mapping ≥1 pool page — the paged layout's
+        'allocated' row count (freed rows return pages immediately)."""
+        return sum(1 for pages in self._row_pages if pages)
+
+    def pages_reserved(self) -> int:
+        """Pages this session's LIVE rows may still lazily allocate
+        (worst case: every row decodes to the session cap). Admission
+        control subtracts this from the pool's free+evictable total."""
+        if not self._paged:
+            return 0
+        return sum(self._n_blocks - self._row_blocks[i]
+                   for i, r in enumerate(self.rows) if r is not None)
+
+    def page_occupancy(self) -> tuple:
+        """(live_tokens, mapped_page_slots) over live rows — the
+        kv.page_fragmentation_pct numerator/denominator. Shared radix
+        pages count once per mapping row: this measures how well rows
+        fill what they hold, not pool utilization."""
+        if not self._paged:
+            return 0, 0
+        page = self.lm.pool.page_tokens
+        toks = slots = 0
+        for i, r in enumerate(self.rows):
+            if r is None:
+                continue
+            toks += int(self._plen[i]) + len(r.tokens)
+            slots += self._row_blocks[i] * page
+        return toks, slots
+
+    def _refresh_pt(self) -> None:
+        if self._pt_dirty:
+            import jax.numpy as jnp
+
+            self._pt_dev = jnp.asarray(self._pt)
+            self._pt_dirty = False
+
+    def _build_cache(self):
+        """PagedKVCache view for the next device call. Pool arrays are
+        ENGINE-owned and donated through every chunk/splice (the engine
+        re-adopts them from each call's return), so sessions never hold a
+        cache across calls — each builds a fresh tuple from the pool's
+        current buffers, its own device page table, and the host-tracked
+        scalar length (P + steps_done, the same value the dense carry
+        threads on device)."""
+        import jax.numpy as jnp
+
+        pool = self.lm.pool
+        self._refresh_pt()
+        return PagedKVCache(
+            pool.k, pool.v, pool.k_scale, pool.v_scale, self._pt_dev,
+            jnp.asarray(self.P + self.steps_done, jnp.int32))
+
+    def _ensure_decode_blocks(self, chunk: int) -> None:
+        """Lazy page growth — the tentpole's allocation model: before a
+        chunk, every live row maps enough blocks to cover cache slots
+        [0, P + steps_done + chunk). Pages arrive as sessions grow
+        instead of as max-length slabs; rows that die early simply never
+        claim their tail blocks."""
+        pool = self.lm.pool
+        need = min(self._n_blocks,
+                   -(-(self.P + self.steps_done + chunk) // pool.page_tokens))
+        with pool.lock:
+            for i, r in enumerate(self.rows):
+                if r is None:
+                    continue
+                while self._row_blocks[i] < need:
+                    pid = pool.alloc(1)[0]
+                    self._pt[i, self._row_blocks[i]] = pid
+                    self._row_pages[i].append(pid)
+                    self._row_blocks[i] += 1
+                    self._pt_dirty = True
+
+    def _release_row_pages(self, i: int) -> None:
+        """Return row i's pages the moment it finishes/cancels: committed
+        (radix-shared) pages drop to the LRU-retained set, private ones
+        go straight back to the free list, and the row's page-table row
+        points at scratch again."""
+        if not self._paged or not self._row_pages[i]:
+            return
+        pool = self.lm.pool
+        with pool.lock:
+            for pid in self._row_pages[i]:
+                pool.release(pid)
+        self._row_pages[i] = []
+        self._row_blocks[i] = 0
+        self._pt[i, :] = 0
+        self._pt_dirty = True
 
     # ------------------------------------------------------------ admission
 
@@ -800,7 +1176,29 @@ class BatchSession:
                 or int(max_new) > self.remaining_steps()
                 - lookahead_chunks * self.chunk):
             return False
-        return len(self.lm.tokenizer.encode(prompt or "", self.P + 1)) <= self.P
+        if len(self.lm.tokenizer.encode(prompt or "", self.P + 1)) > self.P:
+            return False
+        if self._paged:
+            # page accounting: a radix-hit admit needs only its fresh
+            # (post-fork) blocks now, but reserves the row's full span —
+            # admitting must never let a later lazy decode-block alloc
+            # hit PoolExhausted
+            pool = self.lm.pool
+            enc = self.lm.tokenizer.encode(prompt or "", self.P)
+            if not enc:
+                enc = [getattr(self.lm.tokenizer, "bos_id", 0)]
+            ids_r = np.zeros(self.P, np.int32)
+            ids_r[self.P - len(enc):] = enc
+            with pool.lock:
+                hit = (self.lm.radix.match(
+                    self.P, self.P - len(enc), ids_r).blocks
+                    if self.lm.radix is not None else 0)
+                need = self._n_blocks - hit
+                avail = (pool.pages_free + pool.pages_retained
+                         - self.lm.pages_reserved())
+            if need > avail:
+                return False
+        return True
 
     @staticmethod
     def _admission_rows(k: int) -> int:
@@ -851,14 +1249,31 @@ class BatchSession:
         share = engine_timeline.prompt_prefix_share(
             _real_token_rows(ids, mask, k))
         n_tokens = [int(mask[j].sum()) for j in range(k)]
+        paged_prep = None
+        skip = False
+        if self._paged:
+            # probe-match (no retain — a rejected splice must not leak
+            # refcounts): a FULL hit for every newcomer means no device
+            # prefill at all; splice re-validates under the pool lock
+            ids_r, pads = _right_aligned_rows(ids, mask)
+            if self.lm.radix is not None:
+                with self.lm.pool.lock:
+                    skip = k > 0 and all(
+                        self.lm.radix.match(self.P, int(pads[j]),
+                                            ids_r[j]).logits is not None
+                        for j in range(k))
+            paged_prep = {"ids_r": ids_r, "pads": pads, "skip": skip}
         params = self.lm.params  # snapshot; immutable buffers
         t0 = time.perf_counter()
-        (cache_b, logits_b, kv_valid_b, pos_b) = gpt_mod.prefill(
-            params, jnp.asarray(ids), jnp.asarray(mask),
-            self.lm.model_cfg, self.new_bucket)
-        self.lm._prefill_shapes.add((bb2, self.P, self.new_bucket))
+        if skip:
+            cache_b = logits_b = kv_valid_b = pos_b = None
+        else:
+            (cache_b, logits_b, kv_valid_b, pos_b) = gpt_mod.prefill(
+                params, jnp.asarray(ids), jnp.asarray(mask),
+                self.lm.model_cfg, self.new_bucket)
+            self.lm._prefill_shapes.add((bb2, self.P, self.new_bucket))
         return {"k": k, "bb2": bb2, "cache": cache_b, "logits": logits_b,
-                "kv_valid": kv_valid_b, "pos": pos_b,
+                "kv_valid": kv_valid_b, "pos": pos_b, "paged": paged_prep,
                 "max_new": [int(w) for w in max_new_tokens],
                 "temps": self.lm._norm_sampling_rows(
                     temperature, cfg.temperature, bb2, k, float),
@@ -876,32 +1291,79 @@ class BatchSession:
         prefill. Returns a tag per prepared newcomer, or None where the
         request no longer fits (chunks decoded between prepare and splice
         shrank the remaining budget — truncating would break standalone
-        equivalence, so the caller re-queues those for the next session)."""
+        equivalence, so the caller re-queues those for the next session).
+
+        Paged sessions additionally wire pages here, under the pool lock:
+        each taken row RE-matches the radix trie (prepare's probe is
+        advisory — pages can be LRU-evicted in between), retains the
+        still-shared pages, allocates fresh ones past the fork, and builds
+        the scatter table that adopts the staged prefill's fresh blocks
+        into the pool. A full-hit prep (no staged values at all) whose hit
+        degraded is REJECTED the same way a budget miss is — there is
+        nothing to materialize its pages from."""
+        import contextlib
+
         import jax.numpy as jnp
 
+        pg = prep.get("paged")
+        pool = self.lm.pool
         free = [i for i, r in enumerate(self.rows) if r is None]
         row_map = np.full((self.bb,), -1, np.int32)
         tags: list = []
         taken = 0
-        for j in range(prep["k"]):
-            if (taken >= len(free)
-                    or prep["max_new"][j] > self.remaining_steps()):
-                tags.append(None)
-                continue
-            i = free[taken]
-            taken += 1
-            row_map[i] = j
-            self.rows[i] = _SessionRow(self._next_tag, prep["max_new"][j],
-                                       tenant=prep.get("tenants",
-                                                       [DEFAULT_TENANT]
-                                                       * prep["k"])[j],
-                                       created=prep.get("t_enter"))
-            usage.note(self.rows[i].tenant,
-                       tokens_in=prep.get("n_tokens", [0] * prep["k"])[j])
-            tags.append(self._next_tag)
-            self._next_tag += 1
-            self._temps[i] = prep["temps"][j]
-            self._ks[i] = prep["ks"][j]
+        matches_by_row: dict = {}
+        hit_tokens = 0
+        lock = pool.lock if self._paged else contextlib.nullcontext()
+        with lock:
+            for j in range(prep["k"]):
+                if (taken >= len(free)
+                        or prep["max_new"][j] > self.remaining_steps()):
+                    tags.append(None)
+                    continue
+                if self._paged:
+                    m = (self.lm.radix.match(
+                        self.P, int(pg["pads"][j]), pg["ids_r"][j])
+                        if self.lm.radix is not None else None)
+                    if prep["cache"] is None and (m is None
+                                                  or m.logits is None):
+                        tags.append(None)
+                        continue
+                    shared = list(m.pages) if m is not None else []
+                    for pid in shared:
+                        pool.retain(pid)
+                    need = self._prompt_blocks - len(shared)
+                    if not pool.can_alloc(need):
+                        for pid in shared:
+                            pool.release(pid)
+                        tags.append(None)
+                        continue
+                i = free[taken]
+                taken += 1
+                row_map[i] = j
+                if self._paged:
+                    pages = shared + (pool.alloc(need) if need else [])
+                    self._pt[i, :self._prompt_blocks] = pages
+                    self._row_pages[i] = pages
+                    self._row_blocks[i] = self._prompt_blocks
+                    self._pt_dirty = True
+                    matches_by_row[i] = (j, m, len(shared))
+                    hit_tokens += max(0, len(shared) * pool.page_tokens
+                                      - int(pg["pads"][j]))
+                self.rows[i] = _SessionRow(
+                    self._next_tag, prep["max_new"][j],
+                    tenant=prep.get("tenants",
+                                    [DEFAULT_TENANT] * prep["k"])[j],
+                    created=prep.get("t_enter"),
+                    radix_hit=(self._paged and prep["cache"] is None))
+                usage.note(self.rows[i].tenant,
+                           tokens_in=prep.get("n_tokens",
+                                              [0] * prep["k"])[j])
+                tags.append(self._next_tag)
+                self._next_tag += 1
+                self._temps[i] = prep["temps"][j]
+                self._ks[i] = prep["ks"][j]
+        if self._paged:
+            pool.note_hit_tokens(hit_tokens)
         if taken == 0:
             # even a fully-rejected admission paid its prefill — keep it in
             # the timing stats or wasted cold-compile work becomes invisible
@@ -911,18 +1373,75 @@ class BatchSession:
         with self.lm._lock:
             t0 = time.perf_counter()
             done_b = jnp.zeros((prep["bb2"],), bool)
-            (self._cache, self._logits, self._pos, self._done,
-             self._kv_valid) = gpt_mod.merge_rows(
-                self._cache, self._logits, self._pos, self._done,
-                self._kv_valid, prep["cache"], prep["logits"], prep["pos"],
-                done_b, prep["kv_valid"], jnp.asarray(row_map),
-                prompt_width=self.P)
+            if self._paged:
+                staging = prep["cache"]
+                st = np.zeros((prep["bb2"], self._prompt_blocks), np.int32)
+                for i, (j, m, nsh) in matches_by_row.items():
+                    # fresh (post-fork) blocks only: committed page
+                    # content is immutable, rejected rows stay on scratch
+                    st[j, nsh:] = self._pt[i, nsh:self._prompt_blocks]
+                if staging is None:
+                    # full-hit splice: every taken row's prompt is shared
+                    # pages + stored logits — restore row state host-side,
+                    # nothing touches the device but the row merge
+                    ln = np.zeros((prep["bb2"],
+                                   self.lm.model_cfg.vocab_size), np.float32)
+                    pn = np.zeros((prep["bb2"],), np.int32)
+                    kn = np.zeros((prep["bb2"],
+                                   self.P + self.new_bucket), bool)
+                    kn[:, self.P:] = True
+                    for _, (j, m, nsh) in matches_by_row.items():
+                        ln[j] = m.logits
+                        pn[j] = self.P - int(pg["pads"][j])
+                        kn[j, int(pg["pads"][j]):self.P] = True
+                    logits_b, pos_b, kv_valid_b = (jnp.asarray(ln),
+                                                   jnp.asarray(pn),
+                                                   jnp.asarray(kn))
+                else:
+                    logits_b, pos_b, kv_valid_b = (prep["logits"],
+                                                   prep["pos"],
+                                                   prep["kv_valid"])
+                self._refresh_pt()
+                cache_a = self._build_cache()
+                (cache, self._logits, self._pos, self._done,
+                 self._kv_valid) = gpt_mod.merge_rows(
+                    cache_a, self._logits, self._pos, self._done,
+                    self._kv_valid,
+                    (staging, jnp.asarray(st), self._pt_dev),
+                    logits_b, pos_b, done_b, kv_valid_b,
+                    jnp.asarray(row_map), prompt_width=self.P)
+                pool.adopt_arrays(cache.k, cache.v,
+                                  cache.k_scale, cache.v_scale)
+                self._pt_dev = cache.page_table
+            else:
+                (self._cache, self._logits, self._pos, self._done,
+                 self._kv_valid) = gpt_mod.merge_rows(
+                    self._cache, self._logits, self._pos, self._done,
+                    self._kv_valid, prep["cache"], prep["logits"],
+                    prep["pos"], done_b, prep["kv_valid"],
+                    jnp.asarray(row_map), prompt_width=self.P)
             self.decode_s += time.perf_counter() - t0 + prep["prefill_s"]
             self.lm.stats["admitted"] = (self.lm.stats.get("admitted", 0)
                                          + taken)
+        if (self._paged and self.lm.radix is not None
+                and prep["cache"] is not None and matches_by_row):
+            # commit the taken rows' freshly-materialized blocks + full-
+            # prompt logits for the next admit (same placement as the
+            # session-start commit: one host sync, off the decode path)
+            logits_host = np.asarray(prep["logits"])
+            with pool.lock:
+                for i, (j, m, nsh) in matches_by_row.items():
+                    self.lm.radix.commit(
+                        self.P, int(pg["pads"][j]), pg["ids_r"][j],
+                        [int(p) for p in self._pt[i, :self._prompt_blocks]],
+                        logits_host[j])
         engine_timeline.note_admit(
             rows=taken, prefill_ms=prep["prefill_s"] * 1000.0,
-            prefix_share=prep.get("prefix_share"), kind="splice")
+            prefix_share=prep.get("prefix_share"), kind="splice",
+            hit_tokens=hit_tokens if self._paged else None,
+            prompt_tokens=(sum(prep["n_tokens"][j] for (j, _, _)
+                               in matches_by_row.values())
+                           if self._paged else None))
         return tags
 
     def admit(self, prompts: Sequence[str], max_new_tokens: Sequence[int],
@@ -948,6 +1467,11 @@ class BatchSession:
         for i, row in enumerate(self.rows):
             if row is not None and row.tag == tag:
                 self.rows[i] = None
+                # pages return to the pool IMMEDIATELY (mid-chunk cancels
+                # included): private pages to the free list, radix-shared
+                # ones to the evictable retained set — the kv.* gauges
+                # read baseline again as soon as every row is gone
+                self._release_row_pages(i)
                 usage.note(row.tenant, tokens_out=len(row.tokens))
                 engine_timeline.note_cancel()
                 with self.lm._lock:
@@ -974,15 +1498,29 @@ class BatchSession:
         if self.done():
             return self._drain_all()
         chunk = min(self.chunk, self.remaining_steps())
+        if self._paged:
+            # lazy page growth happens at the chunk boundary, off the
+            # engine lock (host-only free-list work)
+            self._ensure_decode_blocks(chunk)
         with self.lm._lock:
             t0 = time.perf_counter()
             self._sub, use = jax.random.split(self._sub)
             keys = jax.random.split(use, chunk)
-            (self._cache, self._logits, self._pos, self._done, toks,
+            cache_in = self._build_cache() if self._paged else self._cache
+            (cache_out, self._logits, self._pos, self._done, toks,
              counted) = gpt_mod.decode_chunk(
-                self.lm.params, self._cache, self._logits, self._pos,
+                self.lm.params, cache_in, self._logits, self._pos,
                 self._done, self._kv_valid, keys, self.lm.model_cfg,
                 temperature=self._temps, top_k=self._ks, eos_id=self._eos)
+            if self._paged:
+                # pool buffers were donated through the chunk — hand the
+                # returned arrays back to the engine-global pool
+                self.lm.pool.adopt_arrays(cache_out.k, cache_out.v,
+                                          cache_out.k_scale,
+                                          cache_out.v_scale)
+                self._pt_dev = cache_out.page_table
+            else:
+                self._cache = cache_out
             toks = np.asarray(toks)
             counted = np.asarray(counted)
             step_s = time.perf_counter() - t0
@@ -995,10 +1533,14 @@ class BatchSession:
         # live DURING the chunk (before this chunk's finishes free them).
         live_rows = [r for r in self.rows if r is not None]
         kv_live, kv_alloc = self.lm.kv_row_counts()
+        pool = self.lm.pool
         engine_timeline.note_decode_step(
             wall_ms=step_s * 1000.0, rows_live=len(live_rows),
             rows_capacity=self.bb, kv_rows_live=kv_live,
-            kv_rows_allocated=kv_alloc, steps=chunk)
+            kv_rows_allocated=kv_alloc, steps=chunk,
+            pages_free=pool.pages_free if self._paged else None,
+            pages_live=pool.pages_live if self._paged else None,
+            pages_total=pool.n_pages - 1 if self._paged else None)
         if chunk:
             metrics.observe("lm.tpot_ms", step_s * 1000.0 / chunk,
                             labels={"service": "lm"})
@@ -1037,11 +1579,13 @@ class BatchSession:
     def _finish(self, i: int):
         row = self.rows[i]
         self.rows[i] = None
+        self._release_row_pages(i)
         usage.note(row.tenant, tokens_out=len(row.tokens))
         engine_timeline.note_finish(
             tokens=len(row.tokens),
             ttft_ms=((row.first_tok - row.created) * 1000.0
-                     if row.first_tok is not None else None))
+                     if row.first_tok is not None else None),
+            radix_hit=row.radix_hit if self._paged else None)
         with self.lm._lock:
             self.lm.stats["generate_calls"] += 1
             self.lm.stats["tokens_generated"] += len(row.tokens)

@@ -1,0 +1,22 @@
+"""Paged KV subsystem (ROADMAP item 1, the decode-at-the-HBM-limit plane).
+
+Three pieces, layered bottom-up:
+
+- ``kv/paged.py`` — the ``PagedKVCache`` layout (the THIRD cache layout
+  next to models/gpt.py's dense ``KVCache`` and int8 ``QuantKVCache``) plus
+  the jitted scatter/gather ops the attention kernel and the admission
+  splice use. Pure JAX; imports nothing above models/quant.
+- ``kv/pool.py`` — the host-side page allocator over one preallocated
+  device pool: free list, per-page refcounts, scratch-page sink, the
+  ``kv.*`` gauges, and LRU eviction of committed-but-unreferenced pages.
+- ``kv/radix.py`` — the refcounted radix prefix cache: a token trie over
+  committed prompt pages with copy-on-write forking at divergence, so an
+  admit whose prompt hits a cached prefix reuses pages instead of
+  re-materializing them, and a full-prompt hit skips prefill entirely.
+
+Wiring lives in engine/lm.py (sessions), models/gpt.py (attention +
+merge_rows), and runner.py (boot-time gauge registration). docs/KV.md is
+the operator story.
+"""
+
+from symbiont_tpu.kv.paged import PagedKVCache  # noqa: F401

@@ -1,0 +1,155 @@
+"""PagedKVCache — the third KV-cache layout (after the dense ``KVCache``
+and int8 ``QuantKVCache`` in models/gpt.py).
+
+K/V live in a preallocated device POOL of fixed-size pages,
+``[L, n_pages, page, kv_heads, head_dim]``, and each batch row maps its
+cache-index space onto pool pages through a small per-session page table
+``[B, n_blocks]`` (block b covers cache slots ``[b·page, (b+1)·page)``).
+The LOGICAL cache-index space is identical to the dense layout — prompts
+stay right-aligned, every row shares the scalar ``length``, causality and
+kv_valid masks are unchanged — pages only add physical indirection. The
+attention kernel gathers the pool through the page table into exactly the
+``[B, T, kv_heads, head_dim]`` tensor the dense path reads, element for
+element, which is what makes paged decode TOKEN-IDENTICAL to dense decode
+(the hard gate in tests/test_kv_paged.py) for both kv_quant modes.
+
+Page 0 is a SCRATCH sink: rows with nothing mapped at a block (padding
+rows, freed rows, not-yet-allocated decode blocks) point there. Writes to
+scratch are harmless garbage; reads from scratch are always masked —
+either by causality (future blocks), kv_valid (gap/padding slots), or
+because the row's output is discarded (padding rows).
+
+Field conventions match the other two layouts where they matter: the
+scalar ``length`` is last, so the decode scan's ``_replace(length=...)``
+and the donation-carrying chunk loop treat all three shapes uniformly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+SCRATCH_PAGE = 0  # reserved sink page; never allocated, never trusted
+
+
+class PagedKVCache(NamedTuple):
+    """Pool arrays + page table + the dense-compatible scalar length.
+
+    ``k``/``v``: [L, n_pages, page, kv_heads, head_dim] (model dtype, or
+    int8 when composed with kv_quant=int8). ``k_scale``/``v_scale``: f32
+    [L, n_pages, page, kv_heads] scale pools (zero-size n_pages axis when
+    unquantized, so one NamedTuple covers both compositions).
+    ``page_table``: [B, n_blocks] int32 into the pool's page axis.
+    ``length``: [] int32 — same semantics as the dense layouts."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array
+    v_scale: jax.Array
+    page_table: jax.Array
+    length: jax.Array
+
+    @property
+    def page_tokens(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
+
+
+def init_pool_arrays(num_layers: int, n_pages: int, page: int,
+                     kv_heads: int, head_dim: int, dtype,
+                     quantized: bool):
+    """Zeroed device pools (k, v, k_scale, v_scale). Zeros matter: scratch
+    reads before any write must be finite (they multiply exactly-zero
+    masked attention probabilities)."""
+    shape = (num_layers, n_pages, page, kv_heads, head_dim)
+    sshape = (num_layers, n_pages, page, kv_heads)
+    if quantized:
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(sshape, jnp.float32),
+                jnp.zeros(sshape, jnp.float32))
+    empty = (num_layers, 0, page, kv_heads)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros(empty, jnp.float32), jnp.zeros(empty, jnp.float32))
+
+
+def flat_slot_index(page_table: jax.Array, slots: jax.Array,
+                    page: int) -> jax.Array:
+    """Cache slots [S] → flat pool indices [B, S] over the flattened
+    (n_pages·page) token axis, through the page table."""
+    blocks = slots // page
+    offs = slots % page
+    pids = jnp.take(page_table, blocks, axis=1)  # [B, S]
+    return pids * page + offs[None, :]
+
+
+@partial(jax.jit, static_argnames=("prompt_width",),
+         donate_argnames=("pool_k", "pool_v", "pool_ks", "pool_vs"))
+def scatter_prompt(pool_k, pool_v, pool_ks, pool_vs, staged,
+                   page_table_b, prompt_width: int):
+    """Adopt a dense-staged prefill into the pool: scatter every staged
+    row's prompt region [0, prompt_width) into the pages its page-table
+    row maps. One scatter per field across all layers (layer offsets are
+    folded into the flat index). ``page_table_b`` is the SCATTER table,
+    not the row's real page table: it maps only the row's FRESH blocks,
+    with radix-shared blocks (and whole non-admitted rows) pointed at the
+    scratch sink — committed page content is immutable, because other
+    live sessions are reading those pages and a recomputed value is not
+    guaranteed bitwise-equal across batch shapes.
+
+    ``staged`` is a dense KVCache or QuantKVCache (models/gpt.py); the
+    pools are DONATED (they are the multi-GB resident buffers — the
+    engine reassigns from the return at every call site)."""
+    L, NP, page = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
+    P = prompt_width
+    slots = jnp.arange(P, dtype=jnp.int32)
+    flat = flat_slot_index(page_table_b, slots, page)          # [B2, P]
+    lflat = flat[None] + (jnp.arange(L, dtype=jnp.int32)
+                          * NP * page)[:, None, None]          # [L, B2, P]
+
+    def scat(pool, vals):
+        tok_shape = (L * NP * page,) + pool.shape[3:]
+        return pool.reshape(tok_shape).at[lflat].set(
+            vals.astype(pool.dtype)).reshape(pool.shape)
+
+    # staged fields: k/v [L, B2, T, kvh, hd] (+ scale planes when int8)
+    pool_k = scat(pool_k, staged.k[:, :, :P])
+    pool_v = scat(pool_v, staged.v[:, :, :P])
+    if pool_ks.shape[1] > 0:  # int8 composition: scale pools ride along
+        pool_ks = scat(pool_ks, staged.k_scale[:, :, :P])
+        pool_vs = scat(pool_vs, staged.v_scale[:, :, :P])
+    return pool_k, pool_v, pool_ks, pool_vs
+
+
+@partial(jax.jit, static_argnames=("prompt_width",))
+def merge_row_state(logits_a, pos_a, done_a, kv_valid_a,
+                    logits_b, pos_b, done_b, kv_valid_b,
+                    row_map, length, prompt_width: int):
+    """The row-state half of a paged splice: pick logits/pos/done/kv_valid
+    rows from the prepared state by row_map, with the same gap-masking
+    contract as gpt.merge_rows (cache slots [prompt_width, length) — the
+    steps the session decoded before this admission — stay invalid for
+    spliced rows forever). The CACHE half happens in the pool
+    (scatter_prompt + host page-table updates), so nothing here is
+    donation-sized."""
+    B = logits_a.shape[0]
+    T = kv_valid_a.shape[1]
+    sel = row_map >= 0
+    j = jnp.clip(row_map, 0, logits_b.shape[0] - 1)
+
+    def pick(a, b):
+        take = jnp.take(b, j, axis=0)
+        shape = [1] * a.ndim
+        shape[0] = B
+        return jnp.where(sel.reshape(shape), take, a)
+
+    t_idx = jnp.arange(T)
+    gap = (t_idx >= prompt_width) & (t_idx < length)
+    kv_b = kv_valid_b & ~gap[None, :]
+    return (pick(logits_a, logits_b), pick(pos_a, pos_b),
+            pick(done_a, done_b), pick(kv_valid_a, kv_b))

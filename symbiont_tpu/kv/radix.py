@@ -1,0 +1,166 @@
+"""Refcounted radix prefix cache over committed prompt pages.
+
+A token trie at PAGE granularity: each node is one prompt block (the
+``kv_page_tokens`` token ids covering cache slots ``[b·page, (b+1)·page)``
+of a right-aligned prompt row) and owns the pool page holding that block's
+K/V. An admit walks the trie with its own prompt blocks; every matched
+node's page is wired straight into the new row's page table (pool
+refcount++) instead of being re-materialized — the prompt's shared prefix
+is prefilled ONCE per process, not once per session. The first divergent
+block ends the walk: the row gets a fresh private page there (the
+copy-on-write fork — the fork block's pre-divergence slots are
+re-materialized into the private page by the row's own prefill scatter,
+never written into the shared page).
+
+Trie roots are keyed by ``(prompt_bucket, pad)``: right-alignment makes a
+slot's K/V depend on its logical position (= slot − pad), so only rows
+with equal prompt length inside the same bucket can share pages. That is
+the honest limitation of page-sharing under right-aligned static shapes —
+and the common RAG-template workload (fixed template + fixed-width query
+slot) sits squarely inside it (docs/KV.md).
+
+A FULL-prompt terminal additionally stores the last-token logits (host
+numpy, one [vocab] row), so an admit whose entire prompt is committed
+skips its prefill outright: pages are wired, logits restored, and TTFT
+collapses to ~one decode chunk (the tentpole's radix-hit gate).
+
+Eviction: committed pages whose row refcount is 0 are RETAINED by the pool
+and evicted LRU under allocation pressure (PagePool._evict_lru_locked →
+``forget_page`` here → the page's whole trie subtree decommits, since a
+child block is meaningless without its prefix).
+
+Locking: every method runs under the pool's RLock (``self._lock`` IS
+``pool.lock``); the engine calls match/commit under it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from symbiont_tpu.kv.pool import PagePool
+
+
+class _Node:
+    __slots__ = ("parent", "key", "page", "children", "logits")
+
+    def __init__(self, parent: Optional["_Node"], key, page: int):
+        self.parent = parent
+        self.key = key              # the block's token-id tuple
+        self.page = page            # pool page backing this block's K/V
+        self.children: Dict[tuple, "_Node"] = {}
+        self.logits: Optional[np.ndarray] = None  # full-prompt terminal
+
+
+class Match(NamedTuple):
+    """One row's walk result: the committed page per matched block (in
+    block order from 0), and — when every prompt block matched and the
+    terminal stored logits — the host logits that make the admit a
+    FULL hit (prefill skipped entirely)."""
+
+    pages: List[int]
+    logits: Optional[np.ndarray]
+
+    @property
+    def blocks(self) -> int:
+        return len(self.pages)
+
+
+class RadixCache:
+    def __init__(self, pool: PagePool, page_tokens: int):
+        self.pool = pool
+        self.page = int(page_tokens)
+        self._lock = pool.lock
+        self._roots: Dict[Tuple[int, int], _Node] = {}  # (P, pad) → root
+        self._page_nodes: Dict[int, _Node] = {}
+        pool._on_evict = self.forget_page
+        self.stats = {"hits": 0, "full_hits": 0, "misses": 0,
+                      "committed_pages": 0}
+
+    # ------------------------------------------------------------- matching
+
+    def _blocks(self, row_ids: np.ndarray) -> List[tuple]:
+        P = len(row_ids)
+        return [tuple(int(t) for t in row_ids[b:b + self.page])
+                for b in range(0, P, self.page)]
+
+    def match(self, P: int, pad: int, row_ids: np.ndarray) -> Match:
+        """Walk the trie with one right-aligned prompt row [P]. Matched
+        pages are LRU-touched but NOT retained — the caller retains
+        exactly the pages it wires at splice time (a rejected admit must
+        not leak refcounts)."""
+        with self._lock:
+            node = self._roots.get((P, pad))
+            pages: List[int] = []
+            for key in self._blocks(row_ids):
+                node = node.children.get(key) if node is not None else None
+                if node is None:
+                    break
+                pages.append(node.page)
+                self.pool.touch(node.page)
+            full = (node is not None and len(pages) == P // self.page
+                    and node.logits is not None)
+            if pages:
+                self.stats["hits"] += 1
+            else:
+                self.stats["misses"] += 1
+            if full:
+                self.stats["full_hits"] += 1
+            return Match(pages, node.logits if full else None)
+
+    # ----------------------------------------------------------- committing
+
+    def commit(self, P: int, pad: int, row_ids: np.ndarray,
+               block_pages: List[int],
+               logits: Optional[np.ndarray] = None) -> None:
+        """Commit one admitted row's prompt blocks. ``block_pages[b]`` is
+        the page NOW backing block b in the row's page table (shared pages
+        for matched blocks, the row's fresh private pages past the fork).
+        New trie nodes adopt the fresh pages (pool.commit → they outlive
+        the row); blocks already committed keep their existing page — the
+        row's private duplicate stays private and frees with the row."""
+        with self._lock:
+            root = self._roots.setdefault((P, pad), _Node(None, (), -1))
+            node = root
+            for b, key in enumerate(self._blocks(row_ids)):
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(node, key, block_pages[b])
+                    node.children[key] = child
+                    self.pool.commit(block_pages[b])
+                    self._page_nodes[block_pages[b]] = child
+                    self.stats["committed_pages"] += 1
+                node = child
+            if logits is not None:
+                node.logits = np.asarray(logits, np.float32).copy()
+
+    # ------------------------------------------------------------- eviction
+
+    def forget_page(self, pid: int) -> None:
+        """Evict the trie subtree rooted at pid's node (PagePool LRU
+        callback — a block without its prefix is unreachable, so the
+        whole subtree decommits with it)."""
+        with self._lock:
+            node = self._page_nodes.pop(pid, None)
+            if node is None:  # already gone (subtree of an earlier evict)
+                self.pool.decommit(pid)
+                return
+            if node.parent is not None:
+                node.parent.children.pop(node.key, None)
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                n.children.clear()
+                self._page_nodes.pop(n.page, None)
+                self.stats["committed_pages"] -= 1
+                self.pool.decommit(n.page)
+
+    def clear(self) -> None:
+        """Drop every committed prefix (params swap: cached K/V and stored
+        logits are stale against the new weights)."""
+        with self._lock:
+            for pid in list(self._page_nodes):
+                self.forget_page(pid)
+            self._roots.clear()

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from symbiont_tpu.kv import paged as _paged
+from symbiont_tpu.kv.paged import PagedKVCache
 from symbiont_tpu.models import quant
 
 Params = Any
@@ -216,7 +218,55 @@ def _attn(
         idx = (layer_idx, 0, start, 0, 0) if rank5 else (layer_idx, 0, start, 0)
         return jax.lax.dynamic_update_slice(slab, update[None], idx)
 
-    if isinstance(cache, QuantKVCache):
+    if isinstance(cache, PagedKVCache):
+        # third layout (kv/paged.py): scatter the S fresh tokens through the
+        # row's page-table into the flattened pool token axis, then gather
+        # the row's WHOLE cache-index space [0, T) back out — element for
+        # element the [B, T, kvh, hd] tensor the dense path reads, so the
+        # masks / einsums / softmax below are shared verbatim and paged
+        # decode stays token-identical to dense (tests/test_kv_paged.py).
+        # Rows with nothing mapped at a block (padding rows, freed rows)
+        # write to and read from the scratch page; those reads are always
+        # masked (causality / kv_valid / discarded padding-row outputs) and
+        # land on finite values, so masked probabilities stay exactly 0.0.
+        assert kv_valid is not None, "paged attention requires kv_valid"
+        page = cache.page_tokens
+        flat_w = _paged.flat_slot_index(
+            cache.page_table, start + jnp.arange(S, dtype=jnp.int32), page)
+
+        def _tok(pool):  # [L, n_pages, page, ...] → [L, n_pages·page, ...]
+            return pool.reshape((pool.shape[0], -1) + pool.shape[3:])
+
+        def _scat(pool, vals):
+            return _tok(pool).at[layer_idx, flat_w].set(
+                vals.astype(pool.dtype)).reshape(pool.shape)
+
+        T_r = kv_valid.shape[1]
+        flat_r = _paged.flat_slot_index(
+            cache.page_table, jnp.arange(T_r, dtype=jnp.int32), page)
+        if cache.quantized:
+            k_q, k_s = quant.kv_channel_quantize(k)
+            v_q, v_s = quant.kv_channel_quantize(v)
+            new_cache = PagedKVCache(
+                _scat(cache.k, k_q), _scat(cache.v, v_q),
+                _scat(cache.k_scale, k_s), _scat(cache.v_scale, v_s),
+                cache.page_table, cache.length)
+            k_all = quant.kv_dequantize(
+                jnp.take(_tok(new_cache.k)[layer_idx], flat_r, axis=0),
+                jnp.take(_tok(new_cache.k_scale)[layer_idx], flat_r, axis=0),
+                x.dtype)
+            v_all = quant.kv_dequantize(
+                jnp.take(_tok(new_cache.v)[layer_idx], flat_r, axis=0),
+                jnp.take(_tok(new_cache.v_scale)[layer_idx], flat_r, axis=0),
+                x.dtype)
+        else:
+            new_cache = PagedKVCache(
+                _scat(cache.k, k), _scat(cache.v, v),
+                cache.k_scale, cache.v_scale,
+                cache.page_table, cache.length)
+            k_all = jnp.take(_tok(new_cache.k)[layer_idx], flat_r, axis=0)
+            v_all = jnp.take(_tok(new_cache.v)[layer_idx], flat_r, axis=0)
+    elif isinstance(cache, QuantKVCache):
         # quantize-on-append: each fresh (position, head) K/V vector gets
         # its own int8 scale; dequant-on-attend reads the int8 slab + the
         # head_dim×-smaller scale plane out of HBM and upcasts in registers
@@ -526,8 +576,6 @@ def decode_chunk(params, cache, cur_logits, cur_pos, done, kv_valid, keys,
         t, k, cfg, top_k_bucket=bucket, eos_id=eos_id)
 
 
-@partial(jax.jit, static_argnames=("prompt_width",),
-         donate_argnames=("cache_a",))
 def merge_rows(cache_a, logits_a, pos_a, done_a, kv_valid_a,
                cache_b, logits_b, pos_b, done_b, kv_valid_b,
                row_map, prompt_width: int):
@@ -547,8 +595,43 @@ def merge_rows(cache_a, logits_a, pos_a, done_a, kv_valid_a,
     its output is EXACTLY what a standalone decode would produce (the same
     right-alignment independence generate() guarantees across batchmates).
 
+    Three layouts splice through here. Dense KVCache and int8 QuantKVCache
+    share the field-wise jit below (scale planes ride batch axis 1 like the
+    slabs). For the paged layout cache_a is a PagedKVCache and cache_b is a
+    TRIPLE ``(staging, scatter_table, new_page_table)``: the dense-staged
+    prefill (None when every admitted row was a full radix hit and prefill
+    was skipped outright), a [bb, prompt_width/page] table mapping each
+    staging row's prompt blocks to the pool pages the engine allocated for
+    it (all-scratch rows for rejected / full-hit staging rows), and the
+    session's rebuilt [B, n_blocks] device page table. The cache half then
+    happens IN THE POOL (kv/paged.scatter_prompt, pools donated) while the
+    row-state half (kv/paged.merge_row_state) applies the same row_map +
+    gap-masking contract as the dense splice.
+
     One compiled executable per (shapes, prompt_width); the row pattern is
     traced, so which rows get replaced never recompiles."""
+    if isinstance(cache_a, PagedKVCache):
+        staging, scatter_table, new_page_table = cache_b
+        k, v, ks, vs = cache_a.k, cache_a.v, cache_a.k_scale, cache_a.v_scale
+        if staging is not None:
+            k, v, ks, vs = _paged.scatter_prompt(
+                k, v, ks, vs, staging, scatter_table, prompt_width)
+        logits, pos, done, kvv = _paged.merge_row_state(
+            logits_a, pos_a, done_a, kv_valid_a,
+            logits_b, pos_b, done_b, kv_valid_b,
+            row_map, cache_a.length, prompt_width)
+        cache = PagedKVCache(k, v, ks, vs, new_page_table, cache_a.length)
+        return cache, logits, pos, done, kvv
+    return _merge_rows_jit(cache_a, logits_a, pos_a, done_a, kv_valid_a,
+                           cache_b, logits_b, pos_b, done_b, kv_valid_b,
+                           row_map, prompt_width=prompt_width)
+
+
+@partial(jax.jit, static_argnames=("prompt_width",),
+         donate_argnames=("cache_a",))
+def _merge_rows_jit(cache_a, logits_a, pos_a, done_a, kv_valid_a,
+                    cache_b, logits_b, pos_b, done_b, kv_valid_b,
+                    row_map, prompt_width: int):
     B = logits_a.shape[0]
     T = cache_a.k.shape[2]
     sel = row_map >= 0

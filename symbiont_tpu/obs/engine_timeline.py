@@ -122,35 +122,68 @@ class EngineTimeline:
     def note_decode_step(self, wall_ms: float, rows_live: int,
                          rows_capacity: int, kv_rows_live: int,
                          kv_rows_allocated: int, steps: int,
-                         sessions: int = 1) -> None:
-        """One decode chunk at its existing chunk-boundary host sync."""
+                         sessions: int = 1,
+                         pages_free: Optional[int] = None,
+                         pages_live: Optional[int] = None,
+                         pages_total: Optional[int] = None) -> None:
+        """One decode chunk at its existing chunk-boundary host sync.
+        ``pages_*`` are the paged-KV pool occupancy snapshot (host free-
+        list counters, no device sync) — None on dense-layout engines."""
         if not self._enabled:
+            return
+        # dense engines never pass pages_*: keep their path the exact
+        # single-literal append the decode chunk boundary always paid
+        if pages_total is None:
+            self._append({"kind": STEP, "t": time.time(),
+                          "wall_ms": wall_ms,
+                          "rows_live": int(rows_live),
+                          "rows_capacity": int(rows_capacity),
+                          "kv_rows_live": int(kv_rows_live),
+                          "kv_rows_allocated": int(kv_rows_allocated),
+                          "steps": int(steps), "sessions": int(sessions)})
             return
         self._append({"kind": STEP, "t": time.time(), "wall_ms": wall_ms,
                       "rows_live": int(rows_live),
                       "rows_capacity": int(rows_capacity),
                       "kv_rows_live": int(kv_rows_live),
                       "kv_rows_allocated": int(kv_rows_allocated),
-                      "steps": int(steps), "sessions": int(sessions)})
+                      "steps": int(steps), "sessions": int(sessions),
+                      "pages_free": int(pages_free or 0),
+                      "pages_live": int(pages_live or 0),
+                      "pages_total": int(pages_total)})
 
     def note_admit(self, rows: int, prefill_ms: float,
                    prefix_share: Optional[float] = None,
-                   kind: str = "start") -> None:
+                   kind: str = "start",
+                   hit_tokens: Optional[int] = None,
+                   prompt_tokens: Optional[int] = None) -> None:
+        """``hit_tokens``/``prompt_tokens`` (paged engines only): prompt
+        tokens served from radix-shared pages vs total prompt tokens in
+        this admit — the pair behind ``decode_radix_hit_pct``."""
         if not self._enabled:
             return
         ev = {"kind": ADMIT, "t": time.time(), "rows": int(rows),
               "prefill_ms": prefill_ms, "admit_kind": kind}
         if prefix_share is not None:
             ev["prefix_share"] = prefix_share
+        if prompt_tokens is not None:
+            ev["hit_tokens"] = int(hit_tokens or 0)
+            ev["prompt_tokens"] = int(prompt_tokens)
         self._append(ev)
 
     def note_finish(self, tokens: int,
-                    ttft_ms: Optional[float] = None) -> None:
+                    ttft_ms: Optional[float] = None,
+                    radix_hit: Optional[bool] = None) -> None:
+        """``radix_hit`` (paged engines only): the request's FULL prompt
+        was served from the radix cache, so its prefill was skipped —
+        splits the TTFT population into hit vs cold."""
         if not self._enabled:
             return
         ev = {"kind": FINISH, "t": time.time(), "tokens": int(tokens)}
         if ttft_ms is not None:
             ev["ttft_ms"] = ttft_ms
+        if radix_hit is not None:
+            ev["radix_hit"] = bool(radix_hit)
         self._append(ev)
 
     def note_cancel(self) -> None:
@@ -273,11 +306,22 @@ class EngineTimeline:
         step_ms = [e["wall_ms"] for e in steps]
         tpot_ms = [e["wall_ms"] / e["steps"] for e in steps if e["steps"]]
         ttfts = [e["ttft_ms"] for e in finishes if "ttft_ms" in e]
+        ttft_hit = [e["ttft_ms"] for e in finishes
+                    if "ttft_ms" in e and e.get("radix_hit")]
+        ttft_cold = [e["ttft_ms"] for e in finishes
+                     if "ttft_ms" in e and e.get("radix_hit") is False]
         shares = [e["prefix_share"] for e in admits if "prefix_share" in e]
         prefill_ms = sum(e["prefill_ms"] for e in admits)
         decode_ms = sum(step_ms)
         real_tok = sum(e["real_tokens"] for e in flushes)
         total_tok = sum(e["total_tokens"] for e in flushes)
+        # paged-KV view: pool occupancy from step snapshots, radix hit
+        # rate from the admit events' token counts
+        paged_steps = [e for e in steps if "pages_total" in e]
+        hit_tok = sum(e["hit_tokens"] for e in admits
+                      if "prompt_tokens" in e)
+        prompt_tok = sum(e["prompt_tokens"] for e in admits
+                         if "prompt_tokens" in e)
 
         out = {
             "decode_steps": len(steps),
@@ -299,6 +343,14 @@ class EngineTimeline:
             "embed_padding_pct": pct(total_tok - real_tok, total_tok),
             "packing_opportunity_pct": pct(total_tok - real_tok, total_tok),
         }
+        if paged_steps or prompt_tok:
+            out["decode_radix_hit_pct"] = pct(hit_tok, prompt_tok)
+            out["decode_ttft_hit_ms_p50"] = quantile(ttft_hit, 0.50)
+            out["decode_ttft_cold_ms_p50"] = quantile(ttft_cold, 0.50)
+        if paged_steps:
+            live = sum(e["pages_live"] for e in paged_steps)
+            total = sum(e["pages_total"] for e in paged_steps)
+            out["decode_pages_live_pct"] = pct(live, total)
         out["dominant_stall"] = self._dominant_stall(out)
         return out
 
@@ -326,6 +378,16 @@ class EngineTimeline:
                 candidates.append(
                     (f"admission prefills ({prefill_pct}% of engine wall)",
                      prefill_pct))
+            if "decode_radix_hit_pct" in s:
+                # prefix overlap the radix cache did NOT convert into
+                # shared pages — cold prefills of material other sessions
+                # already paid for
+                cold = max(0.0, s["decode_prefix_share_pct"]
+                           - s["decode_radix_hit_pct"])
+                candidates.append(
+                    ("cold prefix prefills (prefix share "
+                     f"{s['decode_prefix_share_pct']}% vs radix hits "
+                     f"{s['decode_radix_hit_pct']}%)", round(cold, 2)))
         if s["embed_flushes"]:
             candidates.append(("embed padding (packing opportunity "
                                f"{s['packing_opportunity_pct']}%)",

@@ -115,6 +115,11 @@ class SymbiontStack:
                                engine_timeline.__len__)
         usage.set_max_tenants(cfg.obs.usage_max_tenants)
         usage.register_zero()
+        # kv.* page-pool/radix families at zero BEFORE the engine exists
+        # (zero-returning callbacks a real PagePool later replaces) — the
+        # doc-drift sweep sees them even on a stub stack with no LM
+        from symbiont_tpu.kv.pool import register_zero_gauges
+        register_zero_gauges(cfg.lm.dtype, cfg.lm.kv_quant)
         if cfg.obs.histogram_buckets_ms:
             metrics.set_bucket_bounds(cfg.obs.histogram_buckets_ms)
         register_process_gauges()  # platform-guarded no-op off Linux

@@ -637,6 +637,50 @@ def test_lm_can_admit_counts_allocated_rows():
     assert lm.can_admit(1, sess.bb)
 
 
+def test_lm_can_admit_paged_quotes_pages_not_rows():
+    """The 429-vs-admit boundary under kv_layout=paged: can_admit answers
+    from free-page accounting (pool free + evictable − reserved by live
+    rows), not dense row capacity — and a radix-hit prompt, which needs
+    only its post-fork fresh pages, is admitted where a cold prompt of
+    the same shape is refused."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    def mk(**kw):
+        return LmEngine(LmConfig(
+            enabled=True, hidden_size=32, num_layers=1, num_heads=2,
+            intermediate_size=64, max_positions=256, dtype="float32",
+            prompt_buckets=[16], new_token_buckets=[32], stream_chunk=8,
+            session_min_rows=1, gen_max_batch=1, kv_layout="paged",
+            kv_page_tokens=16, temperature=0.0, **kw))
+
+    # pool sized for ONE session (3 blocks/row: 16 prompt + 32 decode
+    # tokens at 16/page): a second concurrent session must 429 even
+    # though a dense engine would have row capacity for it
+    lm = mk(kv_pool_pages=5, kv_radix=False)
+    assert lm.can_admit(1, 0)
+    sess = lm.start_session(["hold the pool"], [32], temperature=0.0)
+    assert not lm.can_admit(1, 0)
+    while not sess.done():
+        sess.step()
+    assert lm.can_admit(1, 0)  # pages returned → admissible again
+
+    # radix deduction: same boundary, but a warm prompt's shared pages
+    # don't count against the quote
+    lm2 = mk(kv_pool_pages=6)
+    sess2 = lm2.start_session(["warm this prompt"], [32], temperature=0.0)
+    while not sess2.done():
+        sess2.step()
+    held = lm2.pool.alloc(3)  # leave 1 free + 1 retained
+    assert lm2.can_admit(1, 0, prompts=["warm this prompt"],
+                         max_new_tokens=[32])
+    assert not lm2.can_admit(1, 0, prompts=["cold prompt here"],
+                             max_new_tokens=[32])
+    for pid in held:
+        lm2.pool.release(pid)
+
+
 # -------------------------------------------- deadline propagation (chaos)
 
 

@@ -78,6 +78,32 @@ def test_timeline_summary_arithmetic():
                          "admission prefills"))
 
 
+def test_timeline_summary_paged_fields():
+    # the paged-KV fields (PR 16) appear only when steps carry pages_*
+    # and admits carry prompt_tokens — dense timelines stay unchanged
+    tl = _tl()
+    tl.note_decode_step(wall_ms=2.0, rows_live=2, rows_capacity=4,
+                        kv_rows_live=2, kv_rows_allocated=4, steps=8,
+                        pages_free=6, pages_live=2, pages_total=8)
+    tl.note_admit(rows=1, prefill_ms=5.0, prefix_share=0.5, kind="splice",
+                  hit_tokens=24, prompt_tokens=32)
+    tl.note_finish(tokens=4, ttft_ms=2.0, radix_hit=True)
+    tl.note_finish(tokens=4, ttft_ms=40.0, radix_hit=False)
+    s = tl.summary()
+    assert s["decode_radix_hit_pct"] == pytest.approx(75.0)
+    assert s["decode_pages_live_pct"] == pytest.approx(25.0)
+    assert s["decode_ttft_hit_ms_p50"] == pytest.approx(2.0)
+    assert s["decode_ttft_cold_ms_p50"] == pytest.approx(40.0)
+    # a dense timeline never grows the paged keys
+    dense = _tl()
+    dense.note_decode_step(wall_ms=2.0, rows_live=2, rows_capacity=4,
+                           kv_rows_live=2, kv_rows_allocated=4, steps=8)
+    dense.note_finish(tokens=4, ttft_ms=2.0)
+    ds = dense.summary()
+    assert "decode_pages_live_pct" not in ds
+    assert "decode_radix_hit_pct" not in ds
+
+
 def test_timeline_disabled_records_nothing():
     tl = _tl(capacity=0)
     tl.note_decode_step(wall_ms=1.0, rows_live=1, rows_capacity=1,
