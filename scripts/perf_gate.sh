@@ -61,4 +61,40 @@ else
 fi
 
 echo "perf_gate: gating $CANDIDATE against $BASELINE" >&2
-exec python bench.py --gate "$CANDIDATE" "$BASELINE"
+python bench.py --gate "$CANDIDATE" "$BASELINE"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  # A red gate against a baseline archived on a DIFFERENT machine is very
+  # often the environment, not the code: the quick tiers are pure host CPU
+  # timing, and BENCH_GATE_BASELINE numbers from one CPU model do not bound
+  # another. Every emitted line carries archive.host_fingerprint(); compare
+  # the baseline's against this host and shout when they disagree. The gate
+  # verdict (rc) is NOT changed — a mismatch explains, it never excuses.
+  python - "$BASELINE" >&2 <<'PY' || true
+import sys
+from symbiont_tpu.bench.archive import host_fingerprint, load_archive
+
+base = load_archive(sys.argv[1])
+cur = host_fingerprint()
+mismatch = [(k, base[k], cur.get(k)) for k in ("host_cpu_model",
+                                               "host_cpu_cores")
+            if k in base and base[k] != cur.get(k)]
+if mismatch:
+    bar = "!" * 72
+    print(bar)
+    print("perf_gate: ENVIRONMENT MISMATCH — the baseline was archived on "
+          "a different host.")
+    for k, b, c in mismatch:
+        print(f"perf_gate:   {k}: baseline={b!r}  this host={c!r}")
+    print("perf_gate: host-only micro-tier numbers are CPU-bound; re-baseline"
+          " on THIS host")
+    print("perf_gate: (python bench.py --only obs,serialization > "
+          "BENCH_GATE_BASELINE.json) before trusting this verdict.")
+    print(bar)
+elif "host_cpu_model" not in base:
+    print("perf_gate: note: baseline archives no host fingerprint "
+          "(pre-fingerprint line) — cannot rule out an environment "
+          "mismatch behind this failure.")
+PY
+fi
+exit "$rc"

@@ -84,6 +84,13 @@ if s.get("decode_radix_hit_pct") is not None:
         paged.append(("page fragmentation", f"{frag}%"))
     for name, val in paged:
         print("  " + name.ljust(28) + val)
+# compute-plane dispatch rows (obs/xprof.py host-gap attribution) appear
+# only once decode steps carry dispatch counts — guard like the paged rows
+if s.get("decode_host_gap_pct") is not None:
+    print("  " + "dispatches per token".ljust(28)
+          + f"{s['decode_dispatches_per_token']}")
+    print("  " + "host gap (chunk wall)".ljust(28)
+          + f"{s['decode_host_gap_pct']}% host-side between dispatches")
 print("dominant stall:", s["dominant_stall"])
 print(f"(Perfetto view: curl http://{api}"
       "'/api/engine/timeline?fmt=chrome' > tl.json, open in "

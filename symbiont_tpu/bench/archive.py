@@ -34,7 +34,12 @@ _STRING_FIELDS = {"metric", "unit", "semantic_validation",
                   # explanatory note archived alongside a null ratio when
                   # the same-run prerequisite metric is absent (bench/e2e.py
                   # bulk_ratio_fields)
-                  "e2e_ingest_vs_bulk_note"}
+                  "e2e_ingest_vs_bulk_note",
+                  # host fingerprint (host_fingerprint() below): a gate
+                  # failure on a DIFFERENT machine than the baseline's is
+                  # usually the environment, not the code — perf_gate.sh
+                  # compares these and shouts on mismatch
+                  "host_cpu_model"}
 # fields that may archive as an explicit null ("measured nothing, and here
 # is why" — the paired _note says why); everything else numeric stays
 # non-null so a silent None can never masquerade as a measurement
@@ -90,6 +95,37 @@ def load_archive(path) -> dict:
 def is_null_parsed_wrapper(d: dict) -> bool:
     """True for a driver wrapper whose run produced no parseable line."""
     return "parsed" in d and d["parsed"] is None
+
+
+def host_fingerprint() -> dict:
+    """The host identity every emitted line archives (`host_cpu_model` +
+    `host_cpu_cores`), so a later gate failure can distinguish "the code
+    regressed" from "you are gating laptop numbers against CI numbers".
+    Host-only micro-tier baselines (BENCH_GATE_BASELINE.json) are pure CPU
+    timing — a different CPU model or core count moves them legitimately.
+    Best-effort: unknowable fields are simply absent, never fabricated."""
+    import os
+
+    out: dict = {}
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.lower().startswith(("model name", "hardware")):
+                    model = ln.split(":", 1)[-1].strip()
+                    break
+    except OSError:
+        pass
+    if not model:  # non-Linux fallback
+        import platform
+
+        model = platform.processor() or platform.machine()
+    if model:
+        out["host_cpu_model"] = model
+    cores = os.cpu_count()
+    if cores:
+        out["host_cpu_cores"] = int(cores)
+    return out
 
 
 def _check_number(key: str, v, problems: List[str]) -> None:

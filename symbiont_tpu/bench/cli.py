@@ -191,6 +191,10 @@ def build_line(results: dict, run: tiers.TierRun) -> dict:
         # archived statement, not an absence a judge has to infer
         "tier_failures": run.failures,
         "tier_skips": run.skips,
+        # host identity rides every line so perf_gate.sh can tell a code
+        # regression from a cross-machine comparison (the host-only
+        # micro-tier baselines are pure CPU timing)
+        **archive_mod.host_fingerprint(),
         **results,
     }
 

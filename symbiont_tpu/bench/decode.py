@@ -180,7 +180,9 @@ def tier_streaming(results: dict, ctx) -> None:
 
 @register("decode_timeline",
           primary_metrics=("decode_sessions_per_gib",
-                           "decode_radix_hit_pct"))
+                           "decode_radix_hit_pct",
+                           "decode_dispatches_per_token",
+                           "decode_host_gap_pct"))
 def tier_decode_timeline(results: dict, ctx) -> None:
     """Decode-plane flight recorder under a REAL continuous-batching
     session mix (obs/engine_timeline.py), run TWICE: once on the dense
@@ -305,6 +307,15 @@ def tier_decode_timeline(results: dict, ctx) -> None:
                                                0.0)
     results["decode_sessions_per_gib"] = sessions_per_gib(
         paged, engine_timeline.events())
+    # compute-plane profiler primaries (obs/xprof.py host-gap attribution):
+    # jitted dispatches per generated token and the host-think share of
+    # chunk-to-chunk wall — the before numbers ROADMAP item 5's dispatch-
+    # elimination PR must beat. Both must be NONZERO here: every chunk is
+    # one decode_chunk dispatch (1/stream_chunk per token) and the chunk
+    # boundary always does host bookkeeping.
+    results["decode_dispatches_per_token"] = s.get(
+        "decode_dispatches_per_token", 0.0)
+    results["decode_host_gap_pct"] = s.get("decode_host_gap_pct", 0.0)
     log(f"decode timeline (paged+radix): {s['decode_steps']} steps, "
         f"occupancy {s['decode_occupancy_pct']}%, stranded KV "
         f"{s['decode_kv_stranded_pct']}% (dense before: "
@@ -316,5 +327,7 @@ def tier_decode_timeline(results: dict, ctx) -> None:
         f"{s['decode_ttft_ms_p50']}ms (radix hit "
         f"{results['decode_ttft_hit_ms_p50']}ms vs cold "
         f"{results['decode_ttft_cold_ms_p50']}ms), TPOT p50 "
-        f"{s['decode_tpot_ms_p50']}ms; dominant stall: "
-        f"{s['dominant_stall']}")
+        f"{s['decode_tpot_ms_p50']}ms, "
+        f"{results['decode_dispatches_per_token']} dispatches/token, host "
+        f"gap {results['decode_host_gap_pct']}% of chunk wall; dominant "
+        f"stall: {s['dominant_stall']}")

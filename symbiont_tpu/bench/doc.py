@@ -764,7 +764,11 @@ KV rows stranded by dense max-length slabs (`lm.kv_stranded_rows` — what
 a paged layout reclaims), the prompt prefix share a radix cache would
 prefill once (`lm.prefix_share_ratio`), engine-side TTFT/TPOT, and the
 embed-side packing opportunity. Every decode-plane PR of ROADMAP items
-2-3 measures itself against these fields.
+2-3 measures itself against these fields. Runs recorded by a
+dispatch-aware engine (the compute-plane profiler, `obs/xprof.py`) also
+archive `decode_dispatches_per_token` and `decode_host_gap_pct` — the
+host-side dispatch cost ROADMAP item 5 exists to collapse, gated as
+primaries.
 
 """
     if "decode_occupancy_pct" not in f:
@@ -783,6 +787,18 @@ embed-side packing opportunity. Every decode-plane PR of ROADMAP items
         f"prefix share **{f['decode_prefix_share_pct']} %**, TTFT p50 "
         f"{f.get('decode_ttft_ms_p50', '—')} ms, TPOT p50 "
         f"{f.get('decode_tpot_ms_p50', '—')} ms/token.\n\n")
+    if "decode_host_gap_pct" in f:
+        # compute-plane profiler fields (obs/xprof.py): presence-keyed —
+        # archives that predate the dispatch ledger render without them
+        measured += (
+            f"Host-gap attribution (`obs/xprof.py`): "
+            f"**{f.get('decode_dispatches_per_token', '—')} jitted "
+            f"dispatches per decoded token** and "
+            f"**{f['decode_host_gap_pct']} %** of chunk wall spent "
+            f"host-side between one chunk's device window and the next — "
+            f"the per-token Python dispatch cost ROADMAP item 5's fused "
+            f"sampling loop will collapse, now a gated primary instead of "
+            f"an inference from wall-clock deltas.\n\n")
     if "decode_sessions_per_gib" not in f:
         # the paged-KV + radix-cache primaries (symbiont_tpu/kv/) land
         # in the archive once the tier runs against that subsystem

@@ -25,6 +25,11 @@ seeded synthetic load:
   timeline decode-step records per second (obs/engine_timeline.py) — the
   cost EVERY decode chunk boundary now pays; a regression here is decode
   TPOT inflation wearing an observability costume.
+- `obs_dispatch_record_per_s` (primary, higher is better): dispatch-
+  ledger notes per second (obs/xprof.py) — the cost EVERY jitted
+  dispatch now pays inside the engine's `_time_first_call` wrapper; it
+  sits on the per-token decode critical path, so it gates like the
+  timeline record.
 
 All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
@@ -134,12 +139,14 @@ TIMELINE_EVENTS = 4000   # timeline records per throughput sample
 @register("obs", primary_metrics=("obs_span_record_per_s",
                                   "obs_critical_path_512_ms",
                                   "obs_fleet_merge_per_s",
-                                  "obs_timeline_record_per_s"), quick=True)
+                                  "obs_timeline_record_per_s",
+                                  "obs_dispatch_record_per_s"), quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
     from symbiont_tpu.obs.engine_timeline import EngineTimeline
     from symbiont_tpu.obs.fleet import FleetAggregator
     from symbiont_tpu.obs.trace_store import TraceStore
+    from symbiont_tpu.obs.xprof import DispatchLedger
     from symbiont_tpu.utils.telemetry import Metrics, span
 
     # ---- span-exit throughput: the real global path (registry + ring +
@@ -224,6 +231,24 @@ def tier_obs(results: dict, ctx) -> None:
                             kv_rows_live=4, kv_rows_allocated=8, steps=16)
     assert tl.summary()["decode_steps"] == 4096
 
+    # ---- dispatch-ledger note throughput (obs/xprof.py): the cost every
+    # jitted dispatch pays in the engine's _time_first_call wrapper.
+    # Signatures cycle over a realistic executable population so the
+    # sample pays real OrderedDict moves, not one hot entry.
+    sigs = [f"embed[L={L},B={B}]" for L in (64, 128, 256, 512)
+            for B in (8, 16, 32, 64)]
+
+    def one_dispatch_sample() -> float:
+        ledger = DispatchLedger(max_executables=64, registry=Metrics())
+        t0 = time.perf_counter()
+        for i in range(TIMELINE_EVENTS):
+            ledger.note_dispatch(sigs[i % len(sigs)], 2e-4)
+        return TIMELINE_EVENTS / (time.perf_counter() - t0)
+
+    one_dispatch_sample()  # warm
+    stats.record(results, "obs_dispatch_record_per_s",
+                 [one_dispatch_sample() for _ in range(REPEATS)], digits=0)
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -238,4 +263,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_fleet_merge_per_s_max']:.0f}]; timeline record "
         f"{results['obs_timeline_record_per_s']:.0f}/s "
         f"[{results['obs_timeline_record_per_s_min']:.0f}–"
-        f"{results['obs_timeline_record_per_s_max']:.0f}]")
+        f"{results['obs_timeline_record_per_s_max']:.0f}]; dispatch record "
+        f"{results['obs_dispatch_record_per_s']:.0f}/s "
+        f"[{results['obs_dispatch_record_per_s_min']:.0f}–"
+        f"{results['obs_dispatch_record_per_s_max']:.0f}]")

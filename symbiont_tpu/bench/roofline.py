@@ -132,6 +132,40 @@ def annotate(results: dict) -> None:
             100 * gbps / best_other, 1)
 
 
+def grade_executable(flops: Optional[float], bytes_accessed: Optional[float],
+                     wall_s: float, dispatches: int,
+                     ref_gbps: Optional[float] = None) -> dict:
+    """Place one executable on the roofline from its XLA cost-model
+    estimate (obs/xprof.py cost_analysis_for) and its MEASURED host wall.
+
+    Achieved rates divide the cost model's per-dispatch work by the mean
+    host wall per dispatch — an UNDERESTIMATE of device rates whenever the
+    host wall includes dispatch overhead (that bias is the point: the gap
+    between this number and a device-trace number IS the host overhead
+    this profiler exists to expose). ``*_vs_ref_pct`` grades achieved
+    streaming against the same independent reference kernel the decode
+    roofline uses (``hbm_stream_gbps_measured``) when the caller has one.
+    All-None when the backend exposed no cost model — unknown is not
+    zero."""
+    if (flops is None and bytes_accessed is None) \
+            or dispatches <= 0 or wall_s <= 0:
+        return {"achieved_gflops_per_s": None, "achieved_gbps": None,
+                "arithmetic_intensity": None, "hbm_util_vs_ref_pct": None}
+    per_dispatch_s = wall_s / dispatches
+    gflops = (None if not flops else
+              round(flops / per_dispatch_s / 1e9, 2))
+    gbps = (None if not bytes_accessed else
+            round(bytes_accessed / per_dispatch_s / 1e9, 2))
+    intensity = (round(flops / bytes_accessed, 2)
+                 if flops and bytes_accessed else None)
+    util = (round(100.0 * (bytes_accessed / per_dispatch_s / 1e9) / ref_gbps,
+                  1)
+            if bytes_accessed and ref_gbps else None)
+    return {"achieved_gflops_per_s": gflops, "achieved_gbps": gbps,
+            "arithmetic_intensity": intensity,
+            "hbm_util_vs_ref_pct": util}
+
+
 def annotated_for_render(r: dict) -> dict:
     """Non-destructive annotate for doc rendering: legacy archives carry raw
     `*_hbm_gbps*` + `hbm_stream_gbps_measured` but not the dual fields, so

@@ -455,6 +455,16 @@ class ObsConfig:
     # counted in fleet.role_overflow and ignored (client-suppliable role
     # names must not grow unbounded state)
     fleet_roles_max: int = 64
+    # Compute-plane profiler (obs/xprof.py): the per-executable dispatch
+    # ledger behind xla.dispatches_total / GET /api/engine/executables
+    # (xprof_enabled=False turns every note into a cheap early return),
+    # its LRU bound on distinct executables tracked, and the on-demand
+    # device trace capture (POST /api/profile/device): hard cap on one
+    # capture window and where trace artifacts land.
+    xprof_enabled: bool = True
+    xprof_executables: int = 256
+    xprof_trace_max_s: float = 30.0
+    xprof_trace_dir: str = "/tmp/symbiont_xprof"
 
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
@@ -489,6 +499,12 @@ class ObsConfig:
                 raise ValueError(
                     "obs.histogram_buckets_ms must be positive and "
                     "strictly increasing")
+        if self.xprof_executables < 1:
+            raise ValueError("obs.xprof_executables must be >= 1")
+        if self.xprof_trace_max_s <= 0:
+            raise ValueError("obs.xprof_trace_max_s must be positive")
+        if not self.xprof_trace_dir:
+            raise ValueError("obs.xprof_trace_dir must be non-empty")
         # malformed SLO entries fail at boot, not silently never fire
         from symbiont_tpu.obs.watchdog import parse_thresholds
 

@@ -758,8 +758,16 @@ class GenBatcher(_BatcherBase):
                             prep_fut = (loop.run_in_executor(
                                 None, self._do_prepare, sess, take), take)
                     # 3) decode one chunk (the prepare, if any, is prefilling
-                    #    on another executor thread meanwhile)
+                    #    on another executor thread meanwhile). Turnaround
+                    #    includes the event-loop -> executor hop both ways:
+                    #    subtracting the timeline's device wall for the same
+                    #    chunk isolates the batcher's share of the host gap
+                    #    that obs/xprof.py attributes per chunk.
+                    t_hop = time.monotonic()
                     finished = await loop.run_in_executor(None, sess.step)
+                    metrics.observe("batcher.step_turnaround_ms",
+                                    (time.monotonic() - t_hop) * 1000.0,
+                                    labels={"service": "lm"})
                     for tag, text in finished:
                         p = by_tag.pop(tag)
                         if not p.future.cancelled():

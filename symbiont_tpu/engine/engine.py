@@ -51,6 +51,7 @@ from symbiont_tpu.engine.bucketing import (
 from symbiont_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from symbiont_tpu.models import bert as bert_mod
 from symbiont_tpu.models.bert import BertConfig
+from symbiont_tpu.obs.xprof import cost_analysis_for, dispatch_ledger
 from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
 log = logging.getLogger(__name__)
@@ -371,24 +372,37 @@ class TpuEngine:
 
         Each claimed compile also lands on the flight-recorder timeline
         (trace id "engine-compiles", obs/device.py): a recompile storm is a
-        row of spans in the Perfetto export, not just a counter that rose."""
+        row of spans in the Perfetto export, not just a counter that rose.
+
+        EVERY call (not just the first) reports its host wall to the
+        per-executable dispatch ledger (obs/xprof.py) — kernel-launch
+        counts + host dispatch overhead per executable, the compute-plane
+        profiler's primary feed. The first call additionally captures the
+        XLA cost model (FLOPs / bytes) from the LOWERED computation, so
+        the one real compile still happens inside the first dispatch."""
         first = [True]
+        sig = (f"{key[0]}[L={key[1]},B={key[2]}]" if key is not None
+               else "unknown")
 
         def wrapper(*args):
             if not first[0]:
-                return jitted(*args)
+                t0 = time.perf_counter()
+                out = jitted(*args)
+                dispatch_ledger.note_dispatch(sig, time.perf_counter() - t0)
+                return out
             first[0] = False
+            cost = cost_analysis_for(jitted, args)
             t0 = time.perf_counter()
             start_s = time.time()
             out = jitted(*args)
             dt = time.perf_counter() - t0
             self._bump(compile_s=dt)
+            dispatch_ledger.note_compile(sig, cost)
+            dispatch_ledger.note_dispatch(sig, dt)
             from symbiont_tpu.obs.device import record_compile_event
 
             record_compile_event(
-                "engine.compile", dt, start_s=start_s,
-                signature=(f"{key[0]}[L={key[1]},B={key[2]}]"
-                           if key is not None else "unknown"))
+                "engine.compile", dt, start_s=start_s, signature=sig)
             return out
 
         return wrapper
@@ -566,10 +580,14 @@ class TpuEngine:
                     for rows, n_real, res_dev in grp:
                         out[rows] = allv[off:off + n_real]
                         off += res_dev.shape[0]
+                dispatch_ledger.note_host_sync("TpuEngine.embed_texts",
+                                               len(fetches))
             else:
                 _start_host_copies(batch for _, _, batch in pending)
                 for rows, n_real, res_dev in pending:
                     out[rows] = np.asarray(res_dev)[:n_real]
+                dispatch_ledger.note_host_sync("TpuEngine.embed_texts",
+                                               len(pending))
         self._bump(embed_calls=1, sentences_embedded=len(texts))
         return out
 
@@ -646,6 +664,7 @@ class TpuEngine:
             _start_host_copies(batch for _, _, batch in pending)
             for indices, n_real, res_dev in pending:
                 out[indices] = np.asarray(res_dev)[:n_real]
+            dispatch_ledger.note_host_sync("TpuEngine.rerank", len(pending))
         self._bump(rerank_calls=1)
         return out
 
@@ -668,8 +687,10 @@ class TpuEngine:
                 fn = self._get_executable("embed", L, bb)
                 ids_d, lens_d = self._device_batch(ids, lens)
                 np.asarray(fn(self.params, ids_d, lens_d))
+                dispatch_ledger.note_host_sync("TpuEngine.warmup")
                 if self.cross_params is not None:
                     fn = self._get_executable("rerank", L, bb)
                     len_a = np.full((bb,), L // 2, np.int32)
                     (len_a_d,) = self._device_batch(len_a)
                     np.asarray(fn(self.cross_params, ids_d, lens_d, len_a_d))
+                    dispatch_ledger.note_host_sync("TpuEngine.warmup")

@@ -35,6 +35,7 @@ from symbiont_tpu.models import gpt as gpt_mod
 from symbiont_tpu.models.gpt import GPTConfig, PagedKVCache
 from symbiont_tpu.obs.engine_timeline import engine_timeline
 from symbiont_tpu.obs.usage import usage
+from symbiont_tpu.obs.xprof import dispatch_ledger
 from symbiont_tpu.resilience.admission import DEFAULT_TENANT
 from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
@@ -650,7 +651,11 @@ class LmEngine:
             cache, logits, kv_valid, prompt_len = gpt_mod.prefill(
                 self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
                 self.model_cfg, new_bucket)
-            decode_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            decode_s += dt
+        dispatch_ledger.note_dispatch(
+            f"lm.prefill[P={prompt_ids.shape[1]},B={prompt_ids.shape[0]},"
+            f"new={new_bucket}]", dt)
         done = jnp.zeros((prompt_ids.shape[0],), bool)
         pos = prompt_len
         all_tokens: list = []
@@ -669,7 +674,14 @@ class LmEngine:
                         top_k=int(top_k), eos_id=int(eos_id))
                     toks = np.asarray(toks)[0]
                     counted = np.asarray(counted)[0]
-                    decode_s += time.perf_counter() - t1
+                    dt1 = time.perf_counter() - t1
+                    decode_s += dt1
+                dispatch_ledger.note_dispatch(
+                    f"lm.decode_chunk[P={prompt_ids.shape[1]},B=1,"
+                    f"chunk={chunk}]", dt1)
+                # the chunk-boundary toks/counted materialization above is
+                # the stream's one allowlisted device->host sync
+                dispatch_ledger.note_host_sync("LmEngine.generate_stream")
                 for t, c in zip(toks, counted):
                     if not c:  # EOS (or a post-EOS slot): stream ends here
                         stop = True
@@ -1029,9 +1041,13 @@ class BatchSession:
                         nsh = len(matches[i].pages) if matches[i] else 0
                         st[i, nsh:] = self._pt[i, nsh:self._prompt_blocks]
                     pool = lm.pool
+                    t_sc = time.perf_counter()
                     pk, pv, pks, pvs = gpt_mod._paged.scatter_prompt(
                         pool.k, pool.v, pool.k_scale, pool.v_scale,
                         staging, jnp.asarray(st), self.P)
+                    dispatch_ledger.note_dispatch(
+                        f"lm.scatter_prompt[P={self.P},B={self.bb}]",
+                        time.perf_counter() - t_sc)
                     pool.adopt_arrays(pk, pv, pks, pvs)
                     self._cache = None
                 else:
@@ -1039,6 +1055,10 @@ class BatchSession:
             prefill_s = time.perf_counter() - t0
             self.decode_s += prefill_s
             lm.stats["sessions"] = lm.stats.get("sessions", 0) + 1
+        if not skip_prefill:
+            dispatch_ledger.note_dispatch(
+                f"lm.prefill[P={self.P},B={self.bb},new={self.new_bucket}]",
+                prefill_s)
         if self._paged and lm.radix is not None and n and not skip_prefill:
             # commit the freshly-materialized prompt blocks (and the full-
             # prompt logits) so the NEXT admit with this prefix shares
@@ -1060,6 +1080,11 @@ class BatchSession:
             lm._sessions.add(self)
         self._pos = prompt_len
         self._done = jnp.zeros((self.bb,), bool)
+        # host-gap attribution (obs/xprof.py): end of the last device work
+        # on this session; step() reads it to split chunk-to-chunk wall
+        # into device-busy vs host-think — using ONLY the chunk-boundary
+        # syncs that already exist, no new device syncs
+        self._last_step_end = time.perf_counter()
 
     # ------------------------------------------------------- paged KV state
 
@@ -1272,6 +1297,9 @@ class BatchSession:
                 params, jnp.asarray(ids), jnp.asarray(mask),
                 self.lm.model_cfg, self.new_bucket)
             self.lm._prefill_shapes.add((bb2, self.P, self.new_bucket))
+            dispatch_ledger.note_dispatch(
+                f"lm.prefill[P={self.P},B={bb2},new={self.new_bucket}]",
+                time.perf_counter() - t0)
         return {"k": k, "bb2": bb2, "cache": cache_b, "logits": logits_b,
                 "kv_valid": kv_valid_b, "pos": pos_b, "paged": paged_prep,
                 "max_new": [int(w) for w in max_new_tokens],
@@ -1403,6 +1431,7 @@ class BatchSession:
                                                    prep["kv_valid"])
                 self._refresh_pt()
                 cache_a = self._build_cache()
+                t_mr = time.perf_counter()
                 (cache, self._logits, self._pos, self._done,
                  self._kv_valid) = gpt_mod.merge_rows(
                     cache_a, self._logits, self._pos, self._done,
@@ -1410,16 +1439,23 @@ class BatchSession:
                     (staging, jnp.asarray(st), self._pt_dev),
                     logits_b, pos_b, done_b, kv_valid_b,
                     jnp.asarray(row_map), prompt_width=self.P)
+                dispatch_ledger.note_dispatch(
+                    f"lm.merge_rows[P={self.P},B={self.bb}]",
+                    time.perf_counter() - t_mr)
                 pool.adopt_arrays(cache.k, cache.v,
                                   cache.k_scale, cache.v_scale)
                 self._pt_dev = cache.page_table
             else:
+                t_mr = time.perf_counter()
                 (self._cache, self._logits, self._pos, self._done,
                  self._kv_valid) = gpt_mod.merge_rows(
                     self._cache, self._logits, self._pos, self._done,
                     self._kv_valid, prep["cache"], prep["logits"],
                     prep["pos"], done_b, prep["kv_valid"],
                     jnp.asarray(row_map), prompt_width=self.P)
+                dispatch_ledger.note_dispatch(
+                    f"lm.merge_rows[P={self.P},B={self.bb}]",
+                    time.perf_counter() - t_mr)
             self.decode_s += time.perf_counter() - t0 + prep["prefill_s"]
             self.lm.stats["admitted"] = (self.lm.stats.get("admitted", 0)
                                          + taken)
@@ -1504,6 +1540,10 @@ class BatchSession:
             self._ensure_decode_blocks(chunk)
         with self.lm._lock:
             t0 = time.perf_counter()
+            # host-think since the previous chunk's device window closed:
+            # splice/admission/bookkeeping + batcher scheduling. Measured
+            # from values already on host — no new device syncs.
+            host_gap_s = max(0.0, t0 - self._last_step_end)
             self._sub, use = jax.random.split(self._sub)
             keys = jax.random.split(use, chunk)
             cache_in = self._build_cache() if self._paged else self._cache
@@ -1525,6 +1565,9 @@ class BatchSession:
             counted = np.asarray(counted)
             step_s = time.perf_counter() - t0
             self.decode_s += step_s
+            self._last_step_end = time.perf_counter()
+        dispatch_ledger.note_dispatch(
+            f"lm.decode_chunk[P={self.P},B={self.bb},chunk={chunk}]", step_s)
         self.steps_done += chunk
         # decode-plane flight recorder (obs/engine_timeline.py), recorded
         # at this EXISTING chunk-boundary host sync — everything below is
@@ -1540,7 +1583,8 @@ class BatchSession:
             kv_rows_allocated=kv_alloc, steps=chunk,
             pages_free=pool.pages_free if self._paged else None,
             pages_live=pool.pages_live if self._paged else None,
-            pages_total=pool.n_pages - 1 if self._paged else None)
+            pages_total=pool.n_pages - 1 if self._paged else None,
+            dispatches=1, host_gap_ms=host_gap_s * 1000.0)
         if chunk:
             metrics.observe("lm.tpot_ms", step_s * 1000.0 / chunk,
                             labels={"service": "lm"})

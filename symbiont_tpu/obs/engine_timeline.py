@@ -125,32 +125,43 @@ class EngineTimeline:
                          sessions: int = 1,
                          pages_free: Optional[int] = None,
                          pages_live: Optional[int] = None,
-                         pages_total: Optional[int] = None) -> None:
+                         pages_total: Optional[int] = None,
+                         dispatches: Optional[int] = None,
+                         host_gap_ms: Optional[float] = None) -> None:
         """One decode chunk at its existing chunk-boundary host sync.
         ``pages_*`` are the paged-KV pool occupancy snapshot (host free-
-        list counters, no device sync) — None on dense-layout engines."""
+        list counters, no device sync) — None on dense-layout engines.
+        ``dispatches``/``host_gap_ms`` (obs/xprof.py host-gap attribution)
+        are the chunk's jitted-dispatch count and the host-think wall
+        between the previous chunk's device window and this one — both
+        measured from host clocks already in hand, no new device syncs;
+        None from recorders that predate the compute-plane profiler."""
         if not self._enabled:
             return
         # dense engines never pass pages_*: keep their path the exact
-        # single-literal append the decode chunk boundary always paid
+        # single-literal dict build the decode chunk boundary always paid
         if pages_total is None:
-            self._append({"kind": STEP, "t": time.time(),
-                          "wall_ms": wall_ms,
-                          "rows_live": int(rows_live),
-                          "rows_capacity": int(rows_capacity),
-                          "kv_rows_live": int(kv_rows_live),
-                          "kv_rows_allocated": int(kv_rows_allocated),
-                          "steps": int(steps), "sessions": int(sessions)})
-            return
-        self._append({"kind": STEP, "t": time.time(), "wall_ms": wall_ms,
-                      "rows_live": int(rows_live),
-                      "rows_capacity": int(rows_capacity),
-                      "kv_rows_live": int(kv_rows_live),
-                      "kv_rows_allocated": int(kv_rows_allocated),
-                      "steps": int(steps), "sessions": int(sessions),
-                      "pages_free": int(pages_free or 0),
-                      "pages_live": int(pages_live or 0),
-                      "pages_total": int(pages_total)})
+            ev = {"kind": STEP, "t": time.time(),
+                  "wall_ms": wall_ms,
+                  "rows_live": int(rows_live),
+                  "rows_capacity": int(rows_capacity),
+                  "kv_rows_live": int(kv_rows_live),
+                  "kv_rows_allocated": int(kv_rows_allocated),
+                  "steps": int(steps), "sessions": int(sessions)}
+        else:
+            ev = {"kind": STEP, "t": time.time(), "wall_ms": wall_ms,
+                  "rows_live": int(rows_live),
+                  "rows_capacity": int(rows_capacity),
+                  "kv_rows_live": int(kv_rows_live),
+                  "kv_rows_allocated": int(kv_rows_allocated),
+                  "steps": int(steps), "sessions": int(sessions),
+                  "pages_free": int(pages_free or 0),
+                  "pages_live": int(pages_live or 0),
+                  "pages_total": int(pages_total)}
+        if host_gap_ms is not None:
+            ev["dispatches"] = int(dispatches or 0)
+            ev["host_gap_ms"] = float(host_gap_ms)
+        self._append(ev)
 
     def note_admit(self, rows: int, prefill_ms: float,
                    prefix_share: Optional[float] = None,
@@ -351,6 +362,18 @@ class EngineTimeline:
             live = sum(e["pages_live"] for e in paged_steps)
             total = sum(e["pages_total"] for e in paged_steps)
             out["decode_pages_live_pct"] = pct(live, total)
+        # host-gap attribution (obs/xprof.py): only steps recorded by a
+        # dispatch-aware engine carry these — like the paged fields, the
+        # summary keys appear only when the underlying data exists
+        gap_steps = [e for e in steps if "host_gap_ms" in e]
+        if gap_steps:
+            disp = sum(e["dispatches"] for e in gap_steps)
+            gen_tokens = sum(e["steps"] for e in gap_steps)
+            gap_ms = sum(e["host_gap_ms"] for e in gap_steps)
+            busy_ms = sum(e["wall_ms"] for e in gap_steps)
+            out["decode_dispatches_per_token"] = (
+                round(disp / gen_tokens, 4) if gen_tokens else 0.0)
+            out["decode_host_gap_pct"] = pct(gap_ms, gap_ms + busy_ms)
         out["dominant_stall"] = self._dominant_stall(out)
         return out
 
@@ -388,6 +411,14 @@ class EngineTimeline:
                     ("cold prefix prefills (prefix share "
                      f"{s['decode_prefix_share_pct']}% vs radix hits "
                      f"{s['decode_radix_hit_pct']}%)", round(cold, 2)))
+            if "decode_host_gap_pct" in s:
+                # per-token Python dispatch + chunk-boundary bookkeeping —
+                # the ROADMAP item 5 suspect, now measured (obs/xprof.py)
+                candidates.append(
+                    ("host-dispatch gap ("
+                     f"{s['decode_host_gap_pct']}% of chunk wall host-side, "
+                     f"{s['decode_dispatches_per_token']} dispatches/token)",
+                     s["decode_host_gap_pct"]))
         if s["embed_flushes"]:
             candidates.append(("embed padding (packing opportunity "
                                f"{s['packing_opportunity_pct']}%)",

@@ -115,6 +115,19 @@ class SymbiontStack:
                                engine_timeline.__len__)
         usage.set_max_tenants(cfg.obs.usage_max_tenants)
         usage.register_zero()
+        # compute-plane profiler (obs/xprof.py): size the per-executable
+        # dispatch ledger + device-trace capture, then zero-register the
+        # xla.dispatches_total / engine.host_syncs_total families so the
+        # doc-drift sweep (and /metrics) sees them before any dispatch —
+        # one series per allowlisted host-sync site, even if it never fires
+        from symbiont_tpu.obs.xprof import device_trace, dispatch_ledger
+        dispatch_ledger.configure(enabled=cfg.obs.xprof_enabled,
+                                  max_executables=cfg.obs.xprof_executables)
+        device_trace.configure(trace_dir=cfg.obs.xprof_trace_dir,
+                               max_s=cfg.obs.xprof_trace_max_s)
+        dispatch_ledger.register_zero()
+        metrics.register_gauge("obs.xprof_executables",
+                               dispatch_ledger.__len__)
         # kv.* page-pool/radix families at zero BEFORE the engine exists
         # (zero-returning callbacks a real PagePool later replaces) — the
         # doc-drift sweep sees them even on a stub stack with no LM

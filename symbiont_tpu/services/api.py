@@ -488,6 +488,14 @@ class ApiService:
                 # counter tracks interleaved with the flight recorder's
                 # engine span lanes on one time axis
                 return self._engine_timeline(query)
+            if path == "/api/engine/executables" and method == "GET":
+                # compute-plane profiler (obs/xprof.py): per-executable
+                # dispatch counts + host wall, placed on the roofline from
+                # the XLA cost model captured at compile time
+                return self._engine_executables()
+            if path == "/api/profile/device" and method == "POST":
+                metrics.inc("api.POST./api/profile/device")
+                return await self._profile_device(body)
             if path == "/api/tenants" and method == "GET":
                 # per-tenant usage roll-up (obs/usage.py): this process's
                 # ledger, plus every federated role's tenant.usage.*
@@ -625,8 +633,58 @@ class ApiService:
                 if (chrome_trace.service_of(r.name) in self._TIMELINE_SERVICES
                         and t0 <= r.start_s <= t1):
                     spans.append(r)
-        return 200, json.dumps(chrome_trace.export_timeline(
-            "engine-timeline", spans, events))
+        doc = chrome_trace.export_timeline("engine-timeline", spans, events)
+        # cross-link the newest on-demand device trace (obs/xprof.py): a
+        # reader correlating the host-side timeline with real device
+        # kernels finds the XProf artifact without leaving the export.
+        # Mutated HERE, not in chrome_trace — the span/timeline goldens
+        # pin chrome_trace's own output byte-for-byte.
+        from symbiont_tpu.obs.xprof import device_trace
+
+        if device_trace.last_artifact:
+            doc.setdefault("otherData", {})["device_trace_artifact"] = \
+                device_trace.last_artifact
+        return 200, json.dumps(doc)
+
+    def _engine_executables(self) -> Tuple[int, str]:
+        """``GET /api/engine/executables``: the dispatch ledger's
+        per-executable rows (counts, host wall, compiles, XLA cost model)
+        graded through the roofline accountant. Achieved rates divide
+        cost-model work by MEASURED host wall per dispatch — the gap
+        between these and a device-trace number is host overhead, which
+        is exactly what the compute-plane profiler exists to expose."""
+        from symbiont_tpu.bench.roofline import grade_executable
+        from symbiont_tpu.obs.xprof import device_trace, dispatch_ledger
+
+        rows = dispatch_ledger.snapshot()
+        for r in rows:
+            r.update(grade_executable(
+                r["flops"], r["bytes_accessed"],
+                r["host_wall_ms"] / 1000.0, r["dispatches"]))
+        return 200, json.dumps({
+            "executables": rows,
+            "total_dispatches": sum(r["dispatches"] for r in rows),
+            "device_trace_artifact": device_trace.last_artifact,
+        })
+
+    async def _profile_device(self, body: bytes) -> Tuple[int, str]:
+        """``POST /api/profile/device``: capture a bounded on-demand
+        jax.profiler device trace window ({"duration_s": 1.0}, clamped to
+        obs.xprof_trace_max_s) and return the artifact path. Runs on an
+        executor thread — the capture SLEEPS through its window and must
+        not stall the event loop; concurrency is resolved by the process-
+        global profiler lock (409 when a capture is already in flight)."""
+        from symbiont_tpu.obs.xprof import device_trace
+
+        payload = json.loads(body.decode("utf-8")) if body.strip() else {}
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object")
+        duration = payload.get("duration_s", 1.0)
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(None, device_trace.capture,
+                                         duration)
+        status = {"captured": 200, "busy": 409, "error": 500}[res["status"]]
+        return status, json.dumps(res)
 
     def _tenants_rollup(self) -> Tuple[int, str]:
         """``GET /api/tenants``: local per-tenant usage totals, plus the
