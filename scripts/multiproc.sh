@@ -23,6 +23,12 @@
 #                                        # scale-in hard gates — docs/
 #                                        # RESILIENCE.md "Elastic
 #                                        # autoscaling")
+#   scripts/multiproc.sh --gen-chaos     # the DURABLE-GENERATION phase
+#                                        # (load_multiproc_gen tier: two
+#                                        # journalled LM workers, SIGKILL
+#                                        # mid token stream, exactly-once
+#                                        # SSE gates — docs/RESILIENCE.md
+#                                        # "Durable generation sessions")
 #
 # Device-free: workers run tiny real engines on the JAX CPU backend; the
 # broker is the pure-Python symbus twin (bus/pybroker.py) where the native
@@ -34,15 +40,25 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 seed=1
 tests_only=0
 ramp=0
+gen_chaos=0
 prev=""
 for arg in "$@"; do
   case "$arg" in
     --tests-only) tests_only=1 ;;
     --ramp) ramp=1 ;;
+    --gen-chaos) gen_chaos=1 ;;
     --seed) prev="seed" ;;
     *) if [[ "$prev" == "seed" ]]; then seed="$arg"; prev=""; fi ;;
   esac
 done
+
+if [[ "$gen_chaos" -eq 1 ]]; then
+  echo "== durable-generation chaos scenarios (journal, resume, rescue) ==" >&2
+  python -m pytest tests/test_gen_durability.py -q
+  echo "== load_multiproc_gen bench tier (mid-stream SIGKILL, seed ${seed}) ==" >&2
+  exec python bench.py --only load_multiproc_gen --gen-chaos \
+    --load-seed "${seed}" --chaos-seed "${seed}"
+fi
 
 if [[ "$ramp" -eq 1 ]]; then
   echo "== drain-protocol chaos scenarios (scale-out/in, mid-drain kill) ==" >&2

@@ -10,6 +10,8 @@
                                                # multi-process deployment
     python bench.py --only load_ramp --ramp    # traffic-ramp autoscaler
                                                # phase (scale-out + drain)
+    python bench.py --only load_multiproc_gen --gen-chaos   # mid-stream
+                                               # SIGKILL + journal resume
     python bench.py --render-doc BENCH_rNN.json > docs/PERF.md
     python bench.py --gate NEW.json BASELINE.json   # regression gate
     python bench.py --validate ARCHIVE.json [...]   # schema check
@@ -268,7 +270,13 @@ def main(argv=None) -> int:
                                 # the elastic autoscaler driving scale-out
                                 # and a drained scale-in (scripts/
                                 # multiproc.sh --ramp)
-                                ramp="--ramp" in argv)
+                                ramp="--ramp" in argv,
+                                # --gen-chaos arms the load_multiproc_gen
+                                # tier: journalled LM workers SIGKILLed
+                                # mid-stream; gates exactly-once token
+                                # delivery through the resume plane
+                                # (scripts/multiproc.sh --gen-chaos)
+                                gen_chaos="--gen-chaos" in argv)
     _maybe_register_injection()
 
     quick = "--quick" in argv

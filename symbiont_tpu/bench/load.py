@@ -1663,3 +1663,382 @@ async def _drive_ramp(results: dict, load_seed: int,
             stdio.close()
             page_srv.close()
             await page_srv.wait_closed()
+
+# ---------------------------------------------------------------------------
+# --gen-chaos: the load_multiproc family's DURABLE-GENERATION phase
+# (docs/RESILIENCE.md "Durable generation sessions"; resilience/genlog.py +
+# services/text_generator._handle_resume end-to-end). A lean supervised
+# deployment — pybroker + gateway + TWO journalled LM worker processes (a
+# tiny real decoder, greedy, STREAM_CHUNK=1 so every token is a journalled
+# chunk boundary) — drives three concurrent SSE token streams, then
+# SIGKILLs the worker that owns a mid-flight journal tail. Hard gates:
+#
+# - `load_mp_gen_token_loss` must be EXACTLY 0: for every stream, the
+#   SSE deltas reassembled by seq equal the final generated_text — the
+#   kill lost no tokens (the journal tail re-prefilled prompt+generated
+#   on the adopting replica and greedy decode continued token-identically);
+# - `load_mp_gen_dupes` must be EXACTLY 0: per-stream seqs are strictly
+#   contiguous with no repeats and exactly one final event — the SSE hub's
+#   seq dedupe absorbed the resume's replayed chunk (exactly-once at the
+#   edge, not at-least-once);
+# - at least one victim-owned stream must emit events AFTER the kill
+#   (proof the SIGKILL landed mid-stream and the resume plane — NOT
+#   durable-bus redelivery, whose ack window is deliberately parked at
+#   120s — finished it), archived as `load_mp_gen_resume_s`
+#   (kill -> first adopted token at the edge);
+# - every SSE data chunk arrives `id:`-stamped as `<task_id>:<seq>` (the
+#   Last-Event-ID reconnect contract).
+# ---------------------------------------------------------------------------
+
+GEN_CHAOS_STREAMS = 3
+GEN_CHAOS_MAX_NEW = 64
+
+
+@register("load_multiproc_gen", primary_metrics=(
+        "load_mp_gen_resume_s", "load_mp_gen_token_loss",
+        "load_mp_gen_dupes"))
+def tier_load_multiproc_gen(results: dict, ctx) -> None:
+    import asyncio
+
+    if not getattr(ctx, "gen_chaos", False):
+        from symbiont_tpu.bench.tiers import TierSkip
+
+        raise TierSkip("spawns real OS processes and SIGKILLs an LM worker "
+                       "mid-stream; pass --gen-chaos "
+                       "(scripts/multiproc.sh --gen-chaos)")
+    load_seed = int(getattr(ctx, "load_seed", 0) or 0)
+    chaos_seed = int(getattr(ctx, "chaos_seed", 0) or 0)
+    results["load_mp_gen_seed"] = load_seed
+    results["load_mp_gen_chaos_seed"] = chaos_seed
+    asyncio.run(_drive_gen_chaos(results, load_seed, chaos_seed))
+
+
+async def _drive_gen_chaos(results: dict, load_seed: int,
+                           chaos_seed: int) -> None:
+    import asyncio
+    import json as _json
+    import os
+    import signal
+    import socket
+    import tempfile
+    import urllib.request
+
+    from symbiont_tpu.resilience.genlog import _read_tails
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        pybroker_spec,
+        runner_spec,
+    )
+    from symbiont_tpu.utils.telemetry import metrics as _driver_metrics
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    with tempfile.TemporaryDirectory() as td:
+        broker_port = free_port()
+        api_port = free_port()
+        bus_url = f"symbus://127.0.0.1:{broker_port}"
+        genlog_dir = f"{td}/genlog"
+        common = {
+            "JAX_PLATFORMS": "cpu",
+            "SYMBIONT_OBS_FLEET_PUBLISH_S": "0.3",
+            "SYMBIONT_BUS_DURABLE": "1",
+            # the LONG ack window is the point: a 1s ack_wait would
+            # redeliver the (multi-second, compile-included) LM stream
+            # mid-flight and the re-run's un-seq'd FINAL event would break
+            # the exactly-once gate. Inside this tier, recovery from the
+            # kill must come from the journal resume plane alone.
+            "SYMBIONT_BUS_DURABLE_ACK_WAIT_S": "120.0",
+            "SYMBIONT_BUS_DURABLE_MAX_DELIVER": "3",
+            "SYMBIONT_PARALLEL_ENABLED": "0",
+        }
+        gen_env = {
+            **common,
+            "SYMBIONT_TEXT_GENERATOR_MARKOV_STATE_PATH": f"{td}/markov.json",
+            # tiny real decoder: 2 layers x 64 wide boots and compiles in
+            # seconds on CPU; greedy so the adopted continuation must be
+            # token-identical to the unkilled stream
+            "SYMBIONT_LM_ENABLED": "1",
+            "SYMBIONT_LM_ARCH": "llama",
+            "SYMBIONT_LM_HIDDEN_SIZE": "64",
+            "SYMBIONT_LM_NUM_LAYERS": "2",
+            "SYMBIONT_LM_NUM_HEADS": "4",
+            "SYMBIONT_LM_INTERMEDIATE_SIZE": "128",
+            "SYMBIONT_LM_MAX_POSITIONS": "256",
+            "SYMBIONT_LM_DTYPE": "float32",
+            # the top bucket leaves re-prefill headroom: an adopted resume
+            # enters prompt + generated-so-far (~14 + up to 64 byte tokens)
+            # as its prompt, and truncating it would lose tokens
+            "SYMBIONT_LM_PROMPT_BUCKETS": "[16, 64, 128]",
+            "SYMBIONT_LM_NEW_TOKEN_BUCKETS": "[64]",
+            "SYMBIONT_LM_TEMPERATURE": "0.0",
+            # every token is a chunk boundary: 64 journalled host syncs per
+            # stream = the widest possible kill window
+            "SYMBIONT_LM_STREAM_CHUNK": "1",
+            "SYMBIONT_GEN_JOURNAL_ENABLED": "1",
+            "SYMBIONT_GEN_JOURNAL_DIR": genlog_dir,
+        }
+        gateway_env = {
+            **common,
+            "SYMBIONT_API_HOST": "127.0.0.1",
+            "SYMBIONT_API_PORT": str(api_port),
+            "SYMBIONT_API_SSE_KEEPALIVE_S": "0.5",
+            "SYMBIONT_ADMISSION_GENERATE_RATE": "100.0",
+            "SYMBIONT_ADMISSION_GENERATE_BURST": "100.0",
+        }
+        log_path = f"{td}/workers.log"
+        stdio = open(log_path, "ab")
+        sup = ProcessSupervisor(bus_url=bus_url, stdio=stdio,
+                                fleet_publish_s=0.3)
+        sup.add_worker(pybroker_spec(broker_port, f"{td}/symbus",
+                                     heartbeat_timeout_s=4.0))
+        hb = dict(heartbeat_s=0.4, heartbeat_timeout_s=4.0)
+        sup.add_worker(runner_spec("gateway", "api", bus_url,
+                                   env=gateway_env, **hb))
+        sup.add_worker(runner_spec("gen1", "text_generator", bus_url,
+                                   env=gen_env, **hb))
+        sup.add_worker(runner_spec("gen2", "text_generator", bus_url,
+                                   env=gen_env, **hb))
+        await sup.start()
+        loop = asyncio.get_running_loop()
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        client_pool = ThreadPoolExecutor(max_workers=8,
+                                         thread_name_prefix="genchaos")
+
+        def _http(method, path, body=None, headers=None, timeout=30):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{api_port}{path}",
+                data=(_json.dumps(body).encode()
+                      if body is not None else None),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})}, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, _json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read() or b"{}")
+            except (urllib.error.URLError, ConnectionError, OSError):
+                return 0, {}
+
+        def http(method, path, body=None, headers=None, timeout=30):
+            return loop.run_in_executor(
+                client_pool,
+                lambda: _http(method, path, body, headers, timeout))
+
+        # (t_monotonic, sse_id_or_None, parsed_event) triples — the id line
+        # is the satellite's reconnect contract, so the reader keeps it
+        sse_events: list = []
+
+        async def sse_reader():
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", api_port)
+            writer.write(b"GET /api/events HTTP/1.1\r\n"
+                         b"Host: x\r\n\r\n")
+            await writer.drain()
+            pending_id = None
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    if line.startswith(b"id: "):
+                        pending_id = line[4:].strip().decode()
+                    elif line.startswith(b"data: "):
+                        try:
+                            sse_events.append((time.monotonic(), pending_id,
+                                               _json.loads(line[6:].strip())))
+                        except ValueError:
+                            pass
+                        pending_id = None
+            except (asyncio.CancelledError, ConnectionResetError):
+                pass
+            finally:
+                writer.close()
+
+        sse_task = None
+        try:
+            # ---- boot: gateway green, both LM workers heartbeating ------
+            t_boot = time.monotonic()
+            deadline = t_boot + 180
+            while time.monotonic() < deadline:
+                status, _ = await http("GET", "/readyz", timeout=2)
+                if status == 200:
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise RuntimeError(
+                    f"gateway /readyz never went green (see {log_path})")
+            for role in ("gen1", "gen2"):
+                await sup.wait_role_up(role, after=t_boot - 1, timeout_s=120)
+            results["load_mp_gen_boot_s"] = round(
+                time.monotonic() - t_boot, 2)
+            log(f"gen-chaos deployment up in "
+                f"{results['load_mp_gen_boot_s']}s (broker + gateway + "
+                f"2 journalled LM workers)")
+
+            sse_task = asyncio.create_task(sse_reader())
+            await asyncio.sleep(0.3)
+
+            # ---- three concurrent token streams -------------------------
+            tids = [f"mp-genchaos-{i}" for i in range(GEN_CHAOS_STREAMS)]
+            for i, tid in enumerate(tids):
+                status, _ = await http(
+                    "POST", "/api/generate-text",
+                    {"task_id": tid, "prompt": f"symbiont gen {i}",
+                     "max_length": GEN_CHAOS_MAX_NEW, "stream": True},
+                    {"X-Symbiont-Tenant": "gen"})
+                assert status == 200, status
+
+            # ---- pick the victim off the LIVE JOURNAL, then SIGKILL -----
+            # wait until every stream has journalled at least one chunk
+            # (first compile serializes them; after it, chunks flow) — a
+            # victim-owned stream with NO tail yet would have nothing to
+            # resume from and would stall out the tier on the parked
+            # 120s ack window
+            roles = ("gen1", "gen2")
+            live: dict = {}
+            deadline = time.monotonic() + 180
+            tail_seq: dict = {}
+            while time.monotonic() < deadline:
+                live = {}
+                for role in roles:
+                    tails = _read_tails(
+                        os.path.join(genlog_dir, f"{role}.genlog"))
+                    for tid, rec in tails.items():
+                        if tid in tids:
+                            live[tid] = role
+                            tail_seq[tid] = int(rec.get("seq") or 0)
+                if len(live) == len(tids):
+                    break
+                await asyncio.sleep(0.005)
+            else:
+                raise RuntimeError(
+                    f"streams never all journalled a chunk "
+                    f"(live {live}; see {log_path})")
+            owned = {r: [t for t, rr in live.items() if rr == r]
+                     for r in roles}
+            pool = [r for r in roles if owned[r]]
+            victim = str(np.random.default_rng(chaos_seed).choice(pool))
+            victim_tids = set(owned[victim])
+            t_kill = time.monotonic()
+            os.kill(sup.pid(victim), signal.SIGKILL)
+            log(f"gen-chaos kill plan (seed {chaos_seed}): SIGKILL {victim} "
+                f"mid-stream, owning {sorted(victim_tids)} "
+                f"(journal live: {live})")
+
+            # ---- every stream must finish exactly-once ------------------
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                finals = {tid for _, _, e in sse_events
+                          if e.get("original_task_id") in tids
+                          and e.get("generated_text") is not None
+                          for tid in [e["original_task_id"]]}
+                if finals >= set(tids):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                missing = set(tids) - finals
+                raise RuntimeError(
+                    f"streams never completed after the kill: {missing} "
+                    f"(resume plane dead? see {log_path})")
+            # a beat for trailing done-chunks racing the final event
+            await asyncio.sleep(0.5)
+
+            r_restart = await sup.wait_role_up(victim, after=t_kill + 1.0,
+                                               timeout_s=120) - t_kill
+            results["load_mp_gen_restart_s"] = round(r_restart, 2)
+
+            # ---- gates --------------------------------------------------
+            token_loss = 0
+            dupes = 0
+            chunks_total = 0
+            bad_ids = 0
+            for tid in tids:
+                evs = [(t, sid, e) for t, sid, e in sse_events
+                       if e.get("original_task_id") == tid]
+                deltas = [(int(e["seq"]), e.get("text_delta") or "", t, sid)
+                          for t, sid, e in evs
+                          if "text_delta" in e and not e.get("done")]
+                finals = [e for _, _, e in evs
+                          if e.get("generated_text") is not None]
+                seqs = [s for s, _, _, _ in deltas]
+                # exactly-once: no repeats, no holes, exactly one final
+                dupes += len(seqs) - len(set(seqs))
+                dupes += max(0, len(finals) - 1)
+                if sorted(set(seqs)) != list(range(len(set(seqs)))):
+                    token_loss += 1  # a hole IS lost tokens
+                text = "".join(d for _, d, _, _ in
+                               sorted(deltas, key=lambda x: x[0]))
+                if not finals or text != finals[0]["generated_text"]:
+                    token_loss += 1
+                bad_ids += sum(1 for s, _, _, sid in deltas
+                               if sid != f"{tid}:{s}")
+                chunks_total += len(deltas)
+            results["load_mp_gen_streams"] = float(len(tids))
+            results["load_mp_gen_chunks"] = float(chunks_total)
+            results["load_mp_gen_token_loss"] = float(token_loss)
+            results["load_mp_gen_dupes"] = float(dupes)
+            results["load_mp_gen_victim_" + victim] = 1.0
+            results["load_mp_gen_victim_tasks"] = float(len(victim_tids))
+
+            # the kill must have landed MID-STREAM and the resume plane
+            # must have finished the stream: some victim-owned task has
+            # token events AFTER the kill at seqs PAST its journal tail.
+            # The poll-time tail is stale within milliseconds (chunks keep
+            # flowing between the read and the SIGKILL), so the TRUE tail
+            # comes from the rotated orphan file — the dead worker's
+            # journal frozen at the kill, exactly what the adopter
+            # resumed from. Journal-before-yield means any seq beyond it
+            # is adopter-produced.
+            for tid, rec in _read_tails(os.path.join(
+                    genlog_dir, f"{victim}.genlog.orphaned")).items():
+                if tid in victim_tids:
+                    tail_seq[tid] = int(rec.get("seq") or 0)
+            post_kill = [t - t_kill for t, _, e in sse_events
+                         if e.get("original_task_id") in victim_tids
+                         and "text_delta" in e and t > t_kill
+                         and int(e.get("seq") or 0)
+                         > tail_seq[e["original_task_id"]]]
+            if not post_kill:
+                raise RuntimeError(
+                    f"no victim-owned stream emitted tokens after the "
+                    f"SIGKILL — the kill missed the stream window or the "
+                    f"resume plane never adopted (see {log_path})")
+            results["load_mp_gen_resume_s"] = round(min(post_kill), 2)
+
+            # the supervisor's rescue runs IN THIS PROCESS: its orphan
+            # counter is the direct proof recovery came from the journal
+            # plane, not from a lucky bus redelivery
+            orphans = float(_driver_metrics.get("gen.orphans", 0.0))
+            results["load_mp_gen_orphans"] = orphans
+            if orphans < 1:
+                raise RuntimeError(
+                    "supervisor rescued no journal tails — the kill was "
+                    "absorbed some other way; the tier proved nothing")
+            if token_loss:
+                raise RuntimeError(
+                    f"TOKENS LOST across the kill: {token_loss} stream(s) "
+                    f"reassembled != final text (see {log_path})")
+            if dupes:
+                raise RuntimeError(
+                    f"duplicate deliveries at the SSE edge: {dupes} "
+                    f"(exactly-once broken; see {log_path})")
+            if bad_ids:
+                raise RuntimeError(
+                    f"{bad_ids} SSE chunks arrived without the "
+                    f"task:seq id stamp (Last-Event-ID contract broken)")
+            log(f"gen-chaos: {len(tids)} streams x {GEN_CHAOS_MAX_NEW} "
+                f"tokens exactly-once across a mid-stream SIGKILL of "
+                f"{victim}; resume {results['load_mp_gen_resume_s']}s, "
+                f"restart {results['load_mp_gen_restart_s']}s, "
+                f"{chunks_total} chunks, 0 lost, 0 duped")
+        finally:
+            if sse_task is not None:
+                sse_task.cancel()
+            client_pool.shutdown(wait=False)
+            await sup.stop()
+            stdio.close()

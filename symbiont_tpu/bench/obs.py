@@ -30,6 +30,12 @@ seeded synthetic load:
   dispatch now pays inside the engine's `_time_first_call` wrapper; it
   sits on the per-token decode critical path, so it gates like the
   timeline record.
+- `obs_journal_record_per_s` (primary, higher is better): generation-
+  journal appends per second (resilience/genlog.py) — the durability tax
+  a journalled deployment pays at every stream chunk boundary (serialize
+  the resume snapshot + one buffered line write, fsync off). It rides
+  the same chunk-boundary host sync as the timeline record, so it gates
+  the same way.
 
 All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
@@ -136,11 +142,15 @@ def build_fleet_stream() -> list:
 TIMELINE_EVENTS = 4000   # timeline records per throughput sample
 
 
+JOURNAL_EVENTS = 2000    # journal appends per throughput sample
+
+
 @register("obs", primary_metrics=("obs_span_record_per_s",
                                   "obs_critical_path_512_ms",
                                   "obs_fleet_merge_per_s",
                                   "obs_timeline_record_per_s",
-                                  "obs_dispatch_record_per_s"), quick=True)
+                                  "obs_dispatch_record_per_s",
+                                  "obs_journal_record_per_s"), quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
     from symbiont_tpu.obs.engine_timeline import EngineTimeline
@@ -249,6 +259,38 @@ def tier_obs(results: dict, ctx) -> None:
     stats.record(results, "obs_dispatch_record_per_s",
                  [one_dispatch_sample() for _ in range(REPEATS)], digits=0)
 
+    # ---- generation-journal append throughput (resilience/genlog.py):
+    # the durability tax a journalled deployment pays at every stream
+    # chunk boundary. Eight interleaved "streams" with growing token
+    # tails (the realistic shape: each append re-serializes the full
+    # resume snapshot), fsync off — the default deployment posture.
+    import tempfile
+
+    from symbiont_tpu.resilience.genlog import GenJournal
+
+    def one_journal_sample() -> float:
+        with tempfile.TemporaryDirectory() as td:
+            j = GenJournal(f"{td}/bench.genlog", fsync=False)
+            prompt_ids = list(range(16))
+            t0 = time.perf_counter()
+            for i in range(JOURNAL_EVENTS):
+                stream_i = i % 8
+                n = (i // 8) % 64 + 1
+                j.append({"task_id": f"bench-{stream_i}", "tenant": "t",
+                          "stream": True, "prompt_ids": prompt_ids,
+                          "max_new": 64, "temperature": 0.0, "top_k": 0,
+                          "tokens": list(range(n)),
+                          "chunk_start": max(0, n - 1),
+                          "text": "x" * (n - 1), "seq": n - 1,
+                          "key": None, "key_splits": 0})
+            dt = time.perf_counter() - t0
+            assert len(j) == 8 and j.enabled
+            return JOURNAL_EVENTS / dt
+
+    one_journal_sample()  # warm
+    stats.record(results, "obs_journal_record_per_s",
+                 [one_journal_sample() for _ in range(REPEATS)], digits=0)
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -266,4 +308,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_timeline_record_per_s_max']:.0f}]; dispatch record "
         f"{results['obs_dispatch_record_per_s']:.0f}/s "
         f"[{results['obs_dispatch_record_per_s_min']:.0f}–"
-        f"{results['obs_dispatch_record_per_s_max']:.0f}]")
+        f"{results['obs_dispatch_record_per_s_max']:.0f}]; journal record "
+        f"{results['obs_journal_record_per_s']:.0f}/s "
+        f"[{results['obs_journal_record_per_s_min']:.0f}–"
+        f"{results['obs_journal_record_per_s_max']:.0f}]")

@@ -721,6 +721,49 @@ class AutoscaleConfig:
 
 
 @dataclass
+class GenJournalConfig:
+    """Generation-session durability plane (docs/RESILIENCE.md "Durable
+    generation sessions"): a per-role write-ahead journal of in-flight
+    decode state, appended at the stream's existing chunk-boundary host
+    syncs. When a generator worker dies mid-stream (SIGKILL, hang verdict,
+    drain deadline) the supervisor republishes the journal tails as
+    tasks.generation.resume, and a surviving replica continues the stream
+    token-identically (greedy; sampled streams restore the journaled PRNG
+    state). Off by default: journaling is a per-deployment durability
+    opt-in, not a hot-path tax."""
+
+    enabled: bool = False
+    # journal directory; each role writes `<dir>/<role>.genlog` (JSONL, one
+    # self-contained snapshot per chunk — the last record per task is the
+    # full resume state)
+    dir: str = "data/genlog"
+    # compaction threshold: past this many bytes the file is rewritten
+    # keeping only live tasks' tail records
+    max_bytes: int = 8 * 1024 * 1024
+    # live-task bound: oldest tasks are evicted (counted) past this — a
+    # leak in done-marking cannot grow the journal without limit
+    max_tasks: int = 512
+    # fsync every append. Durability vs throughput: the default rides the
+    # OS page cache (survives process SIGKILL, the failure mode this plane
+    # targets; not a host power cut)
+    fsync: bool = False
+    # resume-under-pressure: a resume refused by admission (PoolExhausted /
+    # can_admit false) re-queues with exponential backoff up to this many
+    # attempts before it is abandoned (counted gen.resume_abandoned)
+    resume_max_attempts: int = 5
+    resume_backoff_s: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.max_bytes < 4096:
+            raise ValueError("gen_journal.max_bytes must be >= 4096")
+        if self.max_tasks < 1:
+            raise ValueError("gen_journal.max_tasks must be >= 1")
+        if self.resume_max_attempts < 0 or self.resume_backoff_s < 0:
+            raise ValueError("gen_journal.resume_max_attempts and "
+                             "resume_backoff_s must be >= 0")
+
+
+@dataclass
 class RunnerConfig:
     """Which services this process hosts (SYMBIONT_RUNNER_SERVICES).
 
@@ -763,6 +806,7 @@ class SymbiontConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    gen_journal: GenJournalConfig = field(default_factory=GenJournalConfig)
 
     def __post_init__(self) -> None:
         # cross-section invariant: every top_k the gateway routes to the

@@ -553,6 +553,9 @@ class _PendingGen:
     cancel: Optional[object] = None
     # fairness lane (see _Pending.tenant)
     tenant: str = DEFAULT_TENANT
+    # originating task id: keys the row's durability snapshots in the
+    # generation journal (resilience/genlog.py); None = not journaled
+    task_id: Optional[str] = None
 
     def cancelled(self) -> bool:
         return self.cancel is not None and self.cancel.is_set()
@@ -594,11 +597,13 @@ class GenBatcher(_BatcherBase):
                        temperature: Optional[float] = None,
                        top_k: Optional[int] = None,
                        cancel: Optional[object] = None,
-                       tenant: Optional[str] = None) -> Optional[str]:
+                       tenant: Optional[str] = None,
+                       task_id: Optional[str] = None) -> Optional[str]:
         """Returns the generated text, or None when `cancel` (an object
         with .is_set(), e.g. asyncio.Event) was set mid-decode and the
         request's row was freed at a chunk boundary. `tenant` picks the
-        fairness lane (default lane otherwise)."""
+        fairness lane (default lane otherwise); `task_id` keys the row's
+        crash-resume snapshots in the generation journal."""
         cfg = self.lm.config
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
@@ -606,7 +611,8 @@ class GenBatcher(_BatcherBase):
         self._submit(_PendingGen(prompt, int(max_new_tokens),
                                  float(temperature), int(top_k), fut,
                                  cancel=cancel,
-                                 tenant=tenant or DEFAULT_TENANT))
+                                 tenant=tenant or DEFAULT_TENANT,
+                                 task_id=task_id))
         return await fut
 
     def _size(self, item: _PendingGen) -> int:
@@ -651,7 +657,8 @@ class GenBatcher(_BatcherBase):
                         [p.prompt for p in g], [p.max_new for p in g],
                         temperature=[p.temperature for p in g],
                         top_k=[p.top_k for p in g],
-                        tenants=[p.tenant for p in g]))
+                        tenants=[p.tenant for p in g],
+                        task_ids=[p.task_id for p in g]))
                 self.stats["sessions"] += 1
                 for tag, p in zip((r.tag for r in sess.rows if r is not None),
                                   group):
@@ -829,4 +836,5 @@ class GenBatcher(_BatcherBase):
                                   [p.max_new for p in take],
                                   temperature=[p.temperature for p in take],
                                   top_k=[p.top_k for p in take],
-                                  tenants=[p.tenant for p in take])
+                                  tenants=[p.tenant for p in take],
+                                  task_ids=[p.task_id for p in take])

@@ -252,6 +252,13 @@ class LmEngine:
         self._prefill_shapes: set = set()
         self.stats = {"generate_calls": 0, "tokens_generated": 0,
                       "decode_s": 0.0}
+        # generation-session durability (resilience/genlog.py): the runner
+        # attaches a GenJournal when SYMBIONT_GEN_JOURNAL_ENABLED=1. The
+        # engine only APPENDS chunk-boundary snapshots (at the existing
+        # device→host syncs — journaling adds none); terminal mark_done is
+        # owned by the service layer, AFTER the result is published, so a
+        # crash in the publish window still resumes.
+        self.journal = None
         # live continuous-batching sessions (BatchSession registers itself);
         # weak so a finished session vanishes from the KV gauges without an
         # explicit close hook. Own lock: sessions register from executor
@@ -488,12 +495,16 @@ class LmEngine:
     # ------------------------------------------------------------------ gen
 
     def _prepare_prompts(self, prompts: Sequence[str], max_new: int,
-                         min_rows: int = 1):
+                         min_rows: int = 1, encoded=None):
         """Shared decode preamble: pick the new-token bucket, validate it
         fits, encode prompts (tail-trim to the largest usable prompt bucket,
         BOS fallback for empty), pad to a power-of-two batch bucket so the
         executable count stays log-bounded (≥ min_rows — sessions reserve
-        headroom rows for mid-decode admission). Returns
+        headroom rows for mid-decode admission). `encoded` bypasses
+        tokenization with pre-tokenized id lists (resume re-prefills the
+        exact journaled prompt+generated prefix — resilience/genlog.py;
+        the same tail-trim applies so a resumed request obeys the same
+        bucket cap as a fresh one). Returns
         (prompt_ids [bb, P], prompt_mask [bb, P], new_bucket)."""
         cfg = self.config
         new_bucket = _round_up(max_new, cfg.new_token_buckets)
@@ -505,13 +516,16 @@ class LmEngine:
                 f"max_new_tokens {max_new} (bucket {new_bucket}) leaves no "
                 f"room in {self.model_cfg.max_position_embeddings} positions")
         avail = [b for b in cfg.prompt_buckets if b <= cap] or [cap]
-        encoded = []
-        for prompt in prompts:
-            ids = self.tokenizer.encode(prompt or "", 1 << 30)
-            ids = ids[-avail[-1]:]  # keep the tail: recent context wins
+        if encoded is None:
+            encoded = [self.tokenizer.encode(p or "", 1 << 30)
+                       for p in prompts]
+        trimmed = []
+        for ids in encoded:
+            ids = list(ids)[-avail[-1]:]  # keep the tail: recent context wins
             if not ids:
                 ids = [getattr(self.tokenizer, "bos_id", 0)]
-            encoded.append(ids)
+            trimmed.append(ids)
+        encoded = trimmed
         B = len(encoded)
         bb = 1 << (B - 1).bit_length() if B > 1 else 1
         if min_rows > 1:
@@ -602,7 +616,10 @@ class LmEngine:
     def generate_stream(self, prompt: str, max_new_tokens: int,
                         temperature: Optional[float] = None,
                         top_k: Optional[int] = None,
-                        tenant: Optional[str] = None):
+                        tenant: Optional[str] = None,
+                        task_id: Optional[str] = None,
+                        stream: bool = True,
+                        resume: Optional[dict] = None):
         """Streaming decode: yields text deltas as chunks of tokens finish
         (SURVEY.md §7 hard part #5: "streaming tokens back out through
         NATS→SSE"). Prefill + one compiled chunk-scan executable per
@@ -612,24 +629,61 @@ class LmEngine:
         output in float32 (asserted in tests); under bfloat16 the chunked
         and full-scan executables may round differently, so greedy outputs
         can diverge at argmax near-ties (pronounced with random weights,
-        whose logits are nearly uniform — real checkpoints have margins)."""
+        whose logits are nearly uniform — real checkpoints have margins).
+
+        Durability (resilience/genlog.py): with `task_id` set and a journal
+        attached, every chunk appends a resume snapshot BEFORE its delta is
+        yielded — a crash anywhere leaves a tail whose replay re-emits at
+        most one already-delivered chunk (deduped by seq at the SSE hub),
+        never loses one. `resume` is such a tail: the prompt + generated
+        prefix is re-prefilled (content-relative positions make greedy
+        decode continue token-identically — models/gpt.py _align_prompt),
+        the journaled last chunk's delta is replayed at its original seq,
+        and the PRNG chain is restored (base key + split count) so sampled
+        decode continues on the same chain when the resumed chunk size
+        matches. `stream` is recorded so a second crash re-resumes with the
+        originating task's delivery mode."""
         import jax
         import jax.numpy as jnp
 
         cfg = self.config
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
-
-        prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
-            [prompt], max_new_tokens)
-        # largest bucket caps the request (same clamp generate() applies via
-        # its scan length) — the cache has exactly new_bucket decode slots
-        max_new_tokens = min(max_new_tokens, new_bucket)
-        # usage ledger (obs/usage.py): prompt tokens are known exactly here,
-        # host-side, before any device work
         tenant = tenant or DEFAULT_TENANT
-        usage.note(tenant, tokens_in=int(prompt_mask[0].sum()))
         eos_id = getattr(self.tokenizer, "eos_id", -1)
+        jr = self.journal
+        journaling = jr is not None and jr.enabled and bool(task_id)
+        sampled = float(temperature) > 0.0
+
+        all_tokens: list = []
+        seq = 0
+        chunk_start = 0
+        decoder = IncrementalDecoder(self.tokenizer)
+        if resume is not None:
+            all_tokens = [int(t) for t in (resume.get("tokens") or [])]
+            chunk_start = int(resume.get("chunk_start") or 0)
+            seq = int(resume.get("seq") or 0)
+            decoder._emitted = resume.get("text") or ""
+            my_prompt_ids = [int(t) for t in resume["prompt_ids"]]
+            # re-prefill the EXACT journaled prefix (prompt + generated so
+            # far) — no re-tokenization, so byte-level/BPE boundary effects
+            # can't shift the prefix the dead worker actually decoded
+            remaining = max(1, max_new_tokens - len(all_tokens))
+            prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
+                [""], remaining, encoded=[my_prompt_ids + all_tokens])
+            max_new_tokens = min(max_new_tokens,
+                                 len(all_tokens) + new_bucket)
+        else:
+            prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
+                [prompt], max_new_tokens)
+            # largest bucket caps the request (same clamp generate() applies
+            # via its scan length) — the cache has new_bucket decode slots
+            max_new_tokens = min(max_new_tokens, new_bucket)
+            mask0 = prompt_mask[0].astype(bool)
+            my_prompt_ids = [int(t) for t in prompt_ids[0][mask0]]
+        # usage ledger (obs/usage.py): prefilled tokens are known exactly
+        # here, host-side, before any device work
+        usage.note(tenant, tokens_in=int(prompt_mask[0].sum()))
         chunk = min(cfg.stream_chunk, new_bucket)
 
         # Lock discipline: the engine lock is held only around device work
@@ -643,11 +697,28 @@ class LmEngine:
         # stays consumer-paced: nothing decodes while the consumer is
         # parked between deltas.
         decode_s = 0.0
+        key_base = None  # uint32 key_data the journal stores (sampled only)
+        n_splits = 0     # chunk-splits consumed on that base so far
         with self._lock:
             # timers start inside the lock: decode_s counts this stream's own
             # device work, not time spent waiting on other callers
             t0 = time.perf_counter()
             self._key, sub = jax.random.split(self._key)
+            if resume is not None and resume.get("key") is not None:
+                # restore the dead worker's PRNG chain: its journaled base
+                # key, advanced by the number of chunk-splits it consumed
+                key_base = [int(x) for x in resume["key"]]
+                n_splits = int(resume.get("key_splits") or 0)
+                sub = jax.random.wrap_key_data(
+                    jnp.asarray(np.asarray(key_base, np.uint32)))
+                for _ in range(n_splits):
+                    sub, _adv = jax.random.split(sub)
+            elif journaling and sampled:
+                # ONE key_data transfer per stream, outside the chunk loop:
+                # the journal records (base, split count), never a fresh
+                # device value per chunk — no host sync rides the loop
+                key_base = [int(x) for x in np.asarray(
+                    jax.random.key_data(sub)).reshape(-1)]
             cache, logits, kv_valid, prompt_len = gpt_mod.prefill(
                 self.params, jnp.asarray(prompt_ids), jnp.asarray(prompt_mask),
                 self.model_cfg, new_bucket)
@@ -658,12 +729,44 @@ class LmEngine:
             f"new={new_bucket}]", dt)
         done = jnp.zeros((prompt_ids.shape[0],), bool)
         pos = prompt_len
-        all_tokens: list = []
-        decoder = IncrementalDecoder(self.tokenizer)
         stop = False
+
+        def _snapshot(text_before: str) -> dict:
+            return {"task_id": task_id, "tenant": tenant, "stream": stream,
+                    "prompt_ids": my_prompt_ids,
+                    "max_new": int(max_new_tokens),
+                    "temperature": float(temperature), "top_k": int(top_k),
+                    "tokens": list(all_tokens), "chunk_start": chunk_start,
+                    "text": text_before, "seq": seq,
+                    "key": key_base, "key_splits": n_splits}
+
         try:
+            if resume is not None:
+                # adopt the orphan in OUR journal before emitting anything:
+                # a crash between this yield and the next chunk must leave a
+                # resumable tail here, not only in the rotated-aside file
+                if journaling:
+                    jr.append(_snapshot(decoder._emitted))
+                # warm-vs-cold attribution: how many prefix tokens were
+                # still radix-resident in THIS replica (kv/radix.py peek —
+                # side-effect-free; the dense resume prefill does not use
+                # them yet, but the probe quantifies the paged-resume win)
+                warm = 0
+                if self.radix is not None:
+                    ids_r, pads = _right_aligned_rows(prompt_ids,
+                                                      prompt_mask)
+                    warm = self.radix.peek(prompt_ids.shape[1],
+                                           int(pads[0]), ids_r[0])
+                engine_timeline.note_resume(
+                    tokens=len(all_tokens), prefill_ms=dt * 1000.0,
+                    warm_tokens=warm)
+                delta = decoder.push(all_tokens)
+                if delta:  # replay of the journaled last chunk, same seq
+                    yield delta
+                    seq += 1
             while len(all_tokens) < max_new_tokens and not stop:
                 sub, use = jax.random.split(sub)
+                n_splits += 1
                 keys = jax.random.split(use, chunk)
                 with self._lock:
                     t1 = time.perf_counter()
@@ -682,6 +785,7 @@ class LmEngine:
                 # the chunk-boundary toks/counted materialization above is
                 # the stream's one allowlisted device->host sync
                 dispatch_ledger.note_host_sync("LmEngine.generate_stream")
+                chunk_start = len(all_tokens)
                 for t, c in zip(toks, counted):
                     if not c:  # EOS (or a post-EOS slot): stream ends here
                         stop = True
@@ -689,9 +793,16 @@ class LmEngine:
                     all_tokens.append(int(t))
                     if len(all_tokens) >= max_new_tokens:
                         break
+                # journal BEFORE yield (host values already in hand): the
+                # snapshot's replay re-emits this chunk at this seq, so a
+                # kill in the yield window duplicates (hub-deduped), never
+                # drops
+                if journaling:
+                    jr.append(_snapshot(decoder._emitted))
                 delta = decoder.push(all_tokens)
                 if delta:
                     yield delta
+                    seq += 1
             final_delta = decoder.flush(all_tokens)
             if final_delta:
                 yield final_delta
@@ -709,15 +820,16 @@ class LmEngine:
     def start_session(self, prompts: Sequence[str],
                       max_new_tokens: Sequence[int],
                       temperature=None, top_k=None,
-                      tenants=None) -> "BatchSession":
+                      tenants=None, task_ids=None) -> "BatchSession":
         """Open a chunked batch decode that new requests can JOIN at chunk
         boundaries (continuous batching — the GenBatcher upgrade over
         flush-window-only batching; VERDICT r3 item 3). Drive it with
         session.step(); admit newcomers with session.admit(). `tenants`
         (one per prompt; default lane otherwise) routes the usage ledger
-        — obs/usage.py."""
+        — obs/usage.py. `task_ids` (one per prompt) keys each row's
+        durability snapshots in the generation journal."""
         return BatchSession(self, prompts, max_new_tokens, temperature,
-                            top_k, tenants=tenants)
+                            top_k, tenants=tenants, task_ids=task_ids)
 
     def kv_rows_allocated(self) -> int:
         """Batch rows allocated across live decode sessions — the number
@@ -877,13 +989,20 @@ def _right_aligned_rows(prompt_ids, prompt_mask) -> tuple:
 
 class _SessionRow:
     __slots__ = ("tag", "want", "tokens", "tenant", "created", "first_tok",
-                 "radix_hit")
+                 "radix_hit", "task_id", "prompt_ids")
 
     def __init__(self, tag: int, want: int, tenant: str = DEFAULT_TENANT,
-                 created: Optional[float] = None, radix_hit: bool = False):
+                 created: Optional[float] = None, radix_hit: bool = False,
+                 task_id: Optional[str] = None, prompt_ids=None):
         self.tag = tag
         self.want = want
         self.tokens: list = []
+        # durability plane (resilience/genlog.py): the originating task id
+        # keys this row's journal snapshots, and the EXACT post-trim prompt
+        # ids are what a resume re-prefills — rows without a task_id (bench
+        # direct callers, padding) are simply not journaled
+        self.task_id = task_id
+        self.prompt_ids = prompt_ids
         # FULL radix hit: the row's prefill was skipped outright (its
         # whole prompt was committed pages + stored logits) — feeds the
         # hit-vs-cold TTFT split in the engine timeline
@@ -918,7 +1037,7 @@ class BatchSession:
 
     def __init__(self, lm: LmEngine, prompts: Sequence[str],
                  max_new_tokens: Sequence[int], temperature=None,
-                 top_k=None, tenants=None):
+                 top_k=None, tenants=None, task_ids=None):
         import jax
         import jax.numpy as jnp
 
@@ -937,11 +1056,14 @@ class BatchSession:
         self._eos = int(getattr(lm.tokenizer, "eos_id", -1))
         self._next_tag = 0
         row_tenants = _norm_tenants(tenants, n)
+        row_task_ids = list(task_ids) if task_ids else [None] * n
         self.rows: list = []
         for i, w in enumerate(max_new_tokens):
-            self.rows.append(_SessionRow(self._next_tag,
-                                         min(int(w), self.new_bucket),
-                                         tenant=row_tenants[i]))
+            mrow = prompt_mask[i].astype(bool)
+            self.rows.append(_SessionRow(
+                self._next_tag, min(int(w), self.new_bucket),
+                tenant=row_tenants[i], task_id=row_task_ids[i],
+                prompt_ids=[int(t) for t in prompt_ids[i][mrow]]))
             self._next_tag += 1
         self.rows += [None] * (self.bb - n)  # free slots from the row bucket
         self.steps_done = 0
@@ -1241,7 +1363,8 @@ class BatchSession:
 
     def prepare_admit(self, prompts: Sequence[str],
                       max_new_tokens: Sequence[int],
-                      temperature=None, top_k=None, tenants=None) -> dict:
+                      temperature=None, top_k=None, tenants=None,
+                      task_ids=None) -> dict:
         """Phase 1 of admission: tokenize + device prefill, WITHOUT the
         engine lock — so a newcomer's prefill (which may compile a fresh
         (batch, P) shape, seconds of host time) cannot stall the in-flight
@@ -1308,6 +1431,9 @@ class BatchSession:
                 "ks": self.lm._norm_sampling_rows(
                     top_k, cfg.top_k, bb2, k, int),
                 "tenants": _norm_tenants(tenants, k),
+                "task_ids": (list(task_ids) if task_ids else [None] * k),
+                "prompt_row_ids": [[int(t) for t in ids[j, :n_tokens[j]]]
+                                   for j in range(k)],
                 "n_tokens": n_tokens,
                 "prefix_share": share,
                 "t_enter": t_enter,
@@ -1382,7 +1508,11 @@ class BatchSession:
                     tenant=prep.get("tenants",
                                     [DEFAULT_TENANT] * prep["k"])[j],
                     created=prep.get("t_enter"),
-                    radix_hit=(self._paged and prep["cache"] is None))
+                    radix_hit=(self._paged and prep["cache"] is None),
+                    task_id=prep.get("task_ids",
+                                     [None] * prep["k"])[j],
+                    prompt_ids=prep.get("prompt_row_ids",
+                                        [None] * prep["k"])[j])
                 usage.note(self.rows[i].tenant,
                            tokens_in=prep.get("n_tokens",
                                               [0] * prep["k"])[j])
@@ -1481,14 +1611,15 @@ class BatchSession:
         return tags
 
     def admit(self, prompts: Sequence[str], max_new_tokens: Sequence[int],
-              temperature=None, top_k=None, tenants=None) -> list:
+              temperature=None, top_k=None, tenants=None,
+              task_ids=None) -> list:
         """One-shot admission (prepare + splice back-to-back, no chunks in
         between so nothing can be rejected). Caller pre-filters with
         can_admit. Returns the tags identifying each admitted request in
         step() results."""
         tags = self.splice(self.prepare_admit(
             prompts, max_new_tokens, temperature=temperature, top_k=top_k,
-            tenants=tenants))
+            tenants=tenants, task_ids=task_ids))
         assert None not in tags, "admit() beyond capacity()"
         return tags
 
@@ -1510,6 +1641,10 @@ class BatchSession:
                 self._release_row_pages(i)
                 usage.note(row.tenant, tokens_out=len(row.tokens))
                 engine_timeline.note_cancel()
+                if self.lm.journal is not None and row.task_id:
+                    # a cancelled row is terminal — it must never resurrect
+                    # as a resume task after a later worker death
+                    self.lm.journal.mark_done(row.task_id)
                 with self.lm._lock:
                     self.lm.stats["cancelled"] = (
                         self.lm.stats.get("cancelled", 0) + 1)
@@ -1595,6 +1730,8 @@ class BatchSession:
             usage.note(tenant, kv_row_seconds=step_s * n_rows)
         now = time.perf_counter()
         finished = []
+        jr = self.lm.journal
+        journaling = jr is not None and jr.enabled
         for i, row in enumerate(self.rows):
             if row is None:
                 continue
@@ -1607,6 +1744,24 @@ class BatchSession:
                 row.tokens.append(int(t))
                 if len(row.tokens) >= row.want:
                     break
+            if journaling and row.task_id and row.prompt_ids is not None:
+                # durability snapshot at this EXISTING chunk-boundary host
+                # sync (toks/counted are already np arrays above — no new
+                # device syncs). Batch rows carry no stream seq and no PRNG
+                # key: a session's sample chain is shared across its rows,
+                # so a different replica cannot restore it per-row — greedy
+                # resume is token-identical, sampled resume continues on a
+                # fresh chain (docs/RESILIENCE.md).
+                jr.append({"task_id": row.task_id, "tenant": row.tenant,
+                           "stream": False, "prompt_ids": row.prompt_ids,
+                           "max_new": int(row.want),
+                           # _temps/_ks are host lists (normalized by
+                           # _norm_sampling_rows) — no device value here
+                           "temperature": self._temps[i],
+                           "top_k": self._ks[i],
+                           "tokens": list(row.tokens),
+                           "chunk_start": len(row.tokens), "text": "",
+                           "seq": 0, "key": None, "key_splits": 0})
             if not had_tokens and row.tokens and row.first_tok is None:
                 # engine-side TTFT: row creation (its prefill started) →
                 # its first token materialized on host

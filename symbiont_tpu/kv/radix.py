@@ -109,6 +109,23 @@ class RadixCache:
                 self.stats["full_hits"] += 1
             return Match(pages, node.logits if full else None)
 
+    def peek(self, P: int, pad: int, row_ids: np.ndarray) -> int:
+        """Side-effect-free prefix probe: how many TOKENS of one
+        right-aligned prompt row [P] are currently radix-resident. Unlike
+        match(), nothing is LRU-touched and no stats move — this is the
+        resume path's warm-vs-cold attribution (a dead worker's committed
+        pages may still be live in a surviving replica's trie; the
+        `gen.resume_warm` counter reads this probe), not an admission."""
+        with self._lock:
+            node = self._roots.get((P, pad))
+            blocks = 0
+            for key in self._blocks(row_ids):
+                node = node.children.get(key) if node is not None else None
+                if node is None:
+                    break
+                blocks += 1
+            return max(0, blocks * self.page - int(pad))
+
     # ----------------------------------------------------------- committing
 
     def commit(self, P: int, pad: int, row_ids: np.ndarray,

@@ -52,8 +52,11 @@ from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
 #   queue  — a batcher queue-depth sample at a flush boundary
 #   flush  — one dispatched embed/rerank batch: bucket, rows, real vs
 #            padded token slots (fed from TpuEngine._note_padding)
-STEP, ADMIT, FINISH, CANCEL, QUEUE, FLUSH = (
-    "step", "admit", "finish", "cancel", "queue", "flush")
+#   resume — an orphaned generation session adopted from a dead worker's
+#            journal tail (resilience/genlog.py): prefix tokens
+#            re-prefilled, prefill ms
+STEP, ADMIT, FINISH, CANCEL, QUEUE, FLUSH, RESUME = (
+    "step", "admit", "finish", "cancel", "queue", "flush", "resume")
 
 # prompt tokens kept per registry entry for the prefix probe: overlap past
 # this depth is counted as full-depth (the radix cache would share at least
@@ -201,6 +204,26 @@ class EngineTimeline:
         if not self._enabled:
             return
         self._append({"kind": CANCEL, "t": time.time()})
+
+    def note_resume(self, tokens: int, prefill_ms: float,
+                    warm_tokens: Optional[int] = None) -> None:
+        """One orphaned generation session adopted on THIS engine
+        (resilience/genlog.py tail replay): ``tokens`` already generated
+        by the dead worker, ``prefill_ms`` spent re-prefilling the
+        prompt+generated prefix, ``warm_tokens`` of that prefix still
+        radix-resident here (kv/radix.py peek). Counts ``gen.resumes`` —
+        the durability plane's survival counter, paired with
+        ``gen.orphans`` on the supervisor side."""
+        self.registry.inc("gen.resumes")
+        if warm_tokens:
+            self.registry.inc("gen.resume_warm_tokens", int(warm_tokens))
+        if not self._enabled:
+            return
+        ev = {"kind": RESUME, "t": time.time(),
+              "tokens": int(tokens), "prefill_ms": float(prefill_ms)}
+        if warm_tokens is not None:
+            ev["warm_tokens"] = int(warm_tokens)
+        self._append(ev)
 
     def note_queue_depth(self, queue: str, depth: int) -> None:
         if not self._enabled:

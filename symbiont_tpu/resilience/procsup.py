@@ -462,6 +462,10 @@ class ProcessSupervisor:
                         "(durable redelivery covers its in-flight work)",
                         role, deadline_s)
             self._terminate(w, sig=signal.SIGKILL)
+            # durable redelivery only covers un-acked QUEUE work; a
+            # mid-stream generation is past its ack — the journal is its
+            # only recovery. Republish its tails to a surviving replica.
+            await self._rescue_gen_orphans(w)
         w.stopping = True
         if w.task is not None:
             w.task.cancel()
@@ -480,6 +484,57 @@ class ProcessSupervisor:
         self.drain_events.append((time.monotonic(), role, w.drain_clean,
                                   round(time.monotonic() - t_drain, 3)))
         self.workers.pop(role, None)
+
+    # ------------------------------------------------ gen-session rescue
+
+    async def _rescue_gen_orphans(self, w: _Worker) -> None:
+        """Durable-generation recovery (docs/RESILIENCE.md): when a worker
+        with the gen journal enabled dies mid-stream, scan its journal for
+        live session tails, rotate the file aside, and republish each tail
+        as a `tasks.generation.resume` task — the text-generator queue
+        group picks exactly one surviving replica to adopt each stream.
+        No-op for workers without SYMBIONT_GEN_JOURNAL_ENABLED in env.
+        Requires the supervisor's bus: with the broker down, the file is
+        left IN PLACE (unrotated) so a later death verdict — or the
+        restarted role's own survivor reload — still covers it."""
+        from symbiont_tpu import subjects
+        from symbiont_tpu.config import GenJournalConfig
+        from symbiont_tpu.resilience.genlog import GenJournal
+
+        env = w.spec.env
+        if env.get("SYMBIONT_GEN_JOURNAL_ENABLED", "").lower() not in (
+                "1", "true", "yes", "on"):
+            return
+        if self._bus is None:
+            log.warning("procsup: %s died with a gen journal but the bus "
+                        "is down; deferring the orphan scan", w.spec.role)
+            return
+        role = env.get("SYMBIONT_RUNNER_ROLE", w.spec.role)
+        jdir = env.get("SYMBIONT_GEN_JOURNAL_DIR", GenJournalConfig().dir)
+        path = os.path.join(jdir, f"{role}.genlog")
+        # blocking file I/O (scan + rotate) off the supervisor loop — the
+        # sibling monitors and the broker probe keep their 0.25s cadence
+        try:
+            tails = await asyncio.get_running_loop().run_in_executor(
+                None, GenJournal.take_orphans, path)
+        except Exception:
+            log.warning("procsup: gen journal scan for %s failed",
+                        w.spec.role, exc_info=True)
+            return
+        if not tails:
+            return
+        metrics.inc("gen.orphans", len(tails))
+        log.warning("procsup: %s left %d orphaned generation session(s); "
+                    "republishing for adoption", w.spec.role, len(tails))
+        for task_id, rec in tails.items():
+            body = json.dumps({"task_id": task_id, "record": rec,
+                               "attempt": 0}).encode()
+            try:
+                await self._bus.publish(subjects.TASKS_GENERATION_RESUME,
+                                        body)
+            except Exception:
+                log.warning("procsup: resume publish for %s failed",
+                            task_id, exc_info=True)
 
     # ----------------------------------------------------------- liveness
 
@@ -543,6 +598,11 @@ class ProcessSupervisor:
             else:
                 log.warning("procsup: %s exited rc=%s", w.spec.role, rc)
             metrics.gauge_set("procsup.up", 0, labels={"role": w.spec.role})
+            # the worker is CONFIRMED dead (exit or hang SIGKILL): rescue
+            # any generation sessions its journal left mid-stream before
+            # the restart — the restarted process must start from a fresh
+            # journal, and a surviving replica adopts the streams
+            await self._rescue_gen_orphans(w)
             if self._stopping or w.stopping:
                 return
             if not await self._respect_storm_budget(w):

@@ -294,6 +294,21 @@ class SymbiontStack:
             # a mesh with tensor>1 shards the LM megatron-style for TP
             # decode (models larger than one chip); else single-device
             self.lm = LmEngine(cfg.lm, mesh=self._mesh)
+            if cfg.gen_journal.enabled:
+                # durable generation sessions (docs/RESILIENCE.md): the
+                # engine snapshots every stream at its chunk boundaries to
+                # <dir>/<role>.genlog; the process supervisor republishes
+                # the tails if this process dies mid-stream
+                from pathlib import Path
+
+                from symbiont_tpu.resilience.genlog import GenJournal
+
+                jrole = cfg.runner.role or "local"
+                self.lm.journal = GenJournal(
+                    Path(cfg.gen_journal.dir) / f"{jrole}.genlog",
+                    max_bytes=cfg.gen_journal.max_bytes,
+                    max_tasks=cfg.gen_journal.max_tasks,
+                    fsync=cfg.gen_journal.fsync)
             # one generation micro-batcher shared by the bus surface and the
             # engine plane: concurrent requests decode as one batch. Stored
             # on self BEFORE anything else can raise, so stop() always
@@ -359,7 +374,22 @@ class SymbiontStack:
                                      lm_trainer=lm_trainer,
                                      lm_train_min_chars=(
                                          cfg.lm.ingest_train_min_chars),
-                                     lm_train_steps=cfg.lm.ingest_train_steps))
+                                     lm_train_steps=cfg.lm.ingest_train_steps,
+                                     # durability plane: the service owns
+                                     # mark_done (journal entries survive
+                                     # until the result is PUBLISHED) and
+                                     # adopts orphaned streams republished
+                                     # by the supervisor
+                                     journal=(self.lm.journal
+                                              if self.lm is not None
+                                              else None),
+                                     lm_resume=(self.lm.generate_stream
+                                                if self.lm is not None
+                                                else None),
+                                     resume_max_attempts=(
+                                         cfg.gen_journal.resume_max_attempts),
+                                     resume_backoff_s=(
+                                         cfg.gen_journal.resume_backoff_s)))
         if on("engine"):
             from symbiont_tpu.services.engine_service import EngineService
 

@@ -31,6 +31,7 @@ import asyncio
 import contextlib
 import json
 import logging
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from symbiont_tpu import subjects
@@ -73,7 +74,7 @@ import re
 # exact host (+optional port): http://localhost.evil.com must NOT match
 _ORIGIN_RE = re.compile(r"^https?://(localhost|127\.0\.0\.1)(:\d+)?$")
 
-_UNPARSED = object()  # broadcast(): payload task_id not yet extracted
+_LAGGED = object()  # queue sentinel: this client fell behind → terminal close
 
 
 class _HttpError(Exception):
@@ -116,27 +117,72 @@ async def _fair_slot(admission, tenant: str):
             admission.fair_queue.release(tenant)
 
 
+class _SseClient:
+    __slots__ = ("q", "want", "lagged")
+
+    def __init__(self, capacity: int, want: Optional[str]):
+        self.q: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self.want = want      # task_id filter (None = receive everything)
+        self.lagged = False   # fell behind; terminal close pending
+
+
 class _SseHub:
-    """Bounded broadcast: capacity-32 queues, drop-on-lag with a warning
-    (reference: broadcast channel cap 32, main.rs:537; lag drop :201-209).
+    """Bounded broadcast: capacity-32 queues (reference: broadcast channel
+    cap 32, main.rs:537) — but a lagged client gets an explicit terminal
+    `retry:` + error close instead of the reference's silent message drop
+    (main.rs:201-209), so a slow reader KNOWS its stream has a gap and can
+    reconnect with Last-Event-ID instead of serving truncated text.
 
     Clients may register with a task_id filter (?task_id= on /api/events):
     the reference broadcasts every generation event to every SSE client
     (main.rs:215-270 — its UI correlates by original_task_id client-side);
     unfiltered clients keep that behavior, filtered ones receive only their
-    task's events."""
+    task's events.
 
-    def __init__(self, capacity: int = 32):
+    Exactly-once edge (docs/RESILIENCE.md "Durable generation sessions"):
+    an adopted resume replays its last journaled chunk under the chunk's
+    ORIGINAL seq, so a crash between journal-append and delivery loses
+    nothing — and the hub drops anything at-or-below the highest seq already
+    delivered for that task, so the overlap case duplicates nothing either.
+    Delivered chunks are stamped `id: <task_id>:<seq>` on the wire and kept
+    in a bounded per-task history; a reconnecting client's Last-Event-ID
+    replays the tail it missed. Both maps are bounded, oldest task out."""
+
+    def __init__(self, capacity: int = 32, history_tasks: int = 256,
+                 history_events: int = 128):
         self.capacity = capacity
-        self._clients: List[Tuple[asyncio.Queue, Optional[str]]] = []
+        self._clients: List[_SseClient] = []
+        self._last_seq: "OrderedDict[str, int]" = OrderedDict()
+        # task_id → deque[(seq, payload, done)] of delivered chunks
+        self._history: "OrderedDict[str, deque]" = OrderedDict()
+        self._history_tasks = history_tasks
+        self._history_events = history_events
 
-    def register(self, task_id: Optional[str] = None) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue(maxsize=self.capacity)
-        self._clients.append((q, task_id))
-        return q
+    def register(self, task_id: Optional[str] = None,
+                 last_event_id: Optional[str] = None) -> _SseClient:
+        c = _SseClient(self.capacity, task_id)
+        if last_event_id:
+            # Last-Event-ID: "<task_id>:<seq>" → replay the missed tail
+            # from history before any live event (queue is empty here, so
+            # ordering holds; replay is capped at queue capacity — a gap
+            # larger than that closes-with-retry like any other lag).
+            tid, _, seq_s = last_event_id.rpartition(":")
+            try:
+                after = int(seq_s)
+            except ValueError:
+                tid = None
+            if tid and (task_id is None or tid == task_id):
+                tail = [e for e in self._history.get(tid, ())
+                        if e[0] > after][-self.capacity:]
+                for seq, payload, done in tail:
+                    c.q.put_nowait((payload, f"{tid}:{seq}", done))
+                if tail:
+                    metrics.inc("api.sse_replayed", len(tail))
+        self._clients.append(c)
+        return c
 
-    def unregister(self, q: asyncio.Queue) -> None:
-        self._clients = [(c, t) for (c, t) in self._clients if c is not q]
+    def unregister(self, client: _SseClient) -> None:
+        self._clients = [c for c in self._clients if c is not client]
 
     def has_follower(self, task_id: str) -> bool:
         """Any remaining client that would receive this task's events — a
@@ -144,40 +190,79 @@ class _SseHub:
         reference-style client. Consulted before cancelling a generation
         on disconnect: one of several readers leaving must not kill the
         stream for the rest."""
-        return any(want is None or want == task_id
-                   for _, want in self._clients)
+        return any(c.want is None or c.want == task_id
+                   for c in self._clients)
 
     def broadcast(self, payload: str) -> None:
-        event_tid = _UNPARSED
-        for q, want in list(self._clients):
-            if want is not None:
-                if event_tid is _UNPARSED:  # parse once, only if needed
-                    try:
-                        event_tid = json.loads(payload).get("original_task_id")
-                    except (ValueError, AttributeError):
-                        event_tid = None
-                if event_tid != want:
-                    continue  # not this client's task
+        tid = seq = None
+        done = False
+        try:
+            obj = json.loads(payload)
+            tid = obj.get("original_task_id")
+            seq = obj.get("seq")
+            done = obj.get("done") is True or "generated_text" in obj
+        except (ValueError, AttributeError):
+            obj = None
+        sse_id = None
+        if tid is not None and seq is not None:
+            seq = int(seq)
+            last = self._last_seq.get(tid)
+            if last is not None and seq <= last:
+                # resume replay of an already-delivered chunk (or the
+                # requeue race after a pressure-refused adoption): the
+                # exactly-once guarantee lives HERE
+                metrics.inc("api.sse_deduped")
+                return
+            self._last_seq[tid] = seq
+            self._last_seq.move_to_end(tid)
+            while len(self._last_seq) > self._history_tasks:
+                self._last_seq.popitem(last=False)
+            hist = self._history.get(tid)
+            if hist is None:
+                hist = self._history[tid] = deque(
+                    maxlen=self._history_events)
+            self._history.move_to_end(tid)
+            while len(self._history) > self._history_tasks:
+                self._history.popitem(last=False)
+            hist.append((seq, payload, done))
+            sse_id = f"{tid}:{seq}"
+        item = (payload, sse_id, done)
+        for c in list(self._clients):
+            if c.want is not None and tid != c.want:
+                continue  # not this client's task
+            if c.lagged:
+                continue  # terminal close already pending
             try:
-                q.put_nowait(payload)
+                c.q.put_nowait(item)
             except asyncio.QueueFull:
                 metrics.inc("api.sse_dropped")
-                log.warning("SSE client lagged; dropping message")
+                log.warning("SSE client lagged; closing with retry hint")
+                c.lagged = True
+                # make room, then wake the handler with the lag verdict
+                # (same pop-one-put trick as close_all)
+                try:
+                    c.q.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+                try:
+                    c.q.put_nowait(_LAGGED)
+                except asyncio.QueueFull:
+                    pass
 
     def close_all(self) -> None:
         """Wake every SSE handler with a close sentinel (None) so graceful
         shutdown doesn't deadlock in Server.wait_closed() behind permanently
         connected clients."""
-        for q, _tid in list(self._clients):
+        for c in list(self._clients):
             try:
-                q.put_nowait(None)
+                c.q.put_nowait(None)
             except asyncio.QueueFull:
                 try:
-                    q.get_nowait()
+                    c.q.get_nowait()
                 except asyncio.QueueEmpty:
                     pass
                 try:
-                    q.put_nowait(None)
+                    c.q.put_nowait(None)
                 except asyncio.QueueFull:
                     pass
 
@@ -1190,7 +1275,9 @@ class ApiService:
         writer.write(head.encode("latin-1"))
         await writer.drain()
         task_filter = (parse_qs(query).get("task_id") or [None])[0] or None
-        q = self.hub.register(task_filter)
+        client = self.hub.register(task_filter,
+                                   headers.get("last-event-id"))
+        q = client.q
         # live-connection GAUGE (decremented on disconnect below) plus a
         # cumulative counter — the pre-obs `api.sse_clients` counter only
         # ever incremented, so it silently read as "clients currently
@@ -1202,18 +1289,31 @@ class ApiService:
         try:
             while True:
                 try:
-                    payload = await asyncio.wait_for(
+                    item = await asyncio.wait_for(
                         q.get(), timeout=self.config.sse_keepalive_s)
-                    if payload is None:  # close sentinel from stop()
+                    if item is None:  # close sentinel from stop()
                         shutdown = True
                         return
-                    if task_filter and not completed:
-                        try:
-                            obj = json.loads(payload)
-                            completed = (obj.get("done") is True
-                                         or "generated_text" in obj)
-                        except (ValueError, AttributeError):
-                            pass
+                    if item is _LAGGED:
+                        # this client fell behind the broadcast and has a
+                        # gap: close EXPLICITLY with a retry hint so it
+                        # reconnects (Last-Event-ID replays what history
+                        # still holds) instead of silently serving
+                        # truncated text
+                        metrics.inc("api.sse_lagged_closed")
+                        writer.write(b"retry: 1000\n"
+                                     b"event: error\n"
+                                     b'data: {"error": "client lagged; '
+                                     b'reconnect to resume"}\n\n')
+                        await writer.drain()
+                        return
+                    payload, sse_id, done = item
+                    if task_filter and done:
+                        completed = True
+                    if sse_id:
+                        # SSE event id → browsers echo it back as
+                        # Last-Event-ID on auto-reconnect
+                        writer.write(f"id: {sse_id}\n".encode("utf-8"))
                     for line in payload.splitlines() or [""]:
                         writer.write(f"data: {line}\n".encode("utf-8"))
                     writer.write(b"\n")
@@ -1223,7 +1323,7 @@ class ApiService:
         except (ConnectionResetError, BrokenPipeError, ConnectionAbortedError):
             pass
         finally:
-            self.hub.unregister(q)
+            self.hub.unregister(client)
             metrics.gauge_add("api.sse_clients", -1)
             if (task_filter and not shutdown and not completed
                     and task_filter in self._gen_submitted

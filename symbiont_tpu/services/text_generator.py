@@ -18,6 +18,7 @@ import logging
 
 from symbiont_tpu import subjects
 from symbiont_tpu.bus.core import Msg
+from symbiont_tpu.kv.pool import PoolExhausted
 from symbiont_tpu.models.markov import MarkovModel
 from symbiont_tpu.schema import (
     GeneratedTextChunk,
@@ -47,7 +48,10 @@ class TextGeneratorService(Service):
     def __init__(self, bus, lm_generate=None, lm_batcher=None, lm_stream=None,
                  train_on_ingest: bool = True, state_path=None,
                  lm_trainer=None, lm_train_min_chars: int = 512,
-                 lm_train_steps: int = 2, lm_buffer_max_chars: int = 1 << 20):
+                 lm_train_steps: int = 2, lm_buffer_max_chars: int = 1 << 20,
+                 journal=None, lm_resume=None,
+                 resume_max_attempts: int = 5,
+                 resume_backoff_s: float = 0.25):
         super().__init__(bus)
         # persistence (SURVEY.md §5.4): restore the learned chain; the
         # reference rebuilds from one constant at every boot (main.rs:169-173)
@@ -69,18 +73,22 @@ class TextGeneratorService(Service):
         self.lm_stream = lm_stream  # Callable[..., Iterator[str]] | None —
         # when set, deltas stream out on events.text.generated.partial while
         # decoding; the final full message still rides events.text.generated
-        # usage metering (obs/usage.py): pass the tenant through to the
-        # engine when the stream callable takes it (LmEngine.generate_stream
+        # generation-session durability (resilience/genlog.py): the engine
+        # APPENDS chunk snapshots; this service owns terminal mark_done —
+        # recorded only AFTER the result is published, so a crash anywhere
+        # in the publish window still leaves a resumable tail. lm_resume is
+        # the adoption callable (LmEngine.generate_stream's signature with
+        # task_id/stream/resume) driven by _handle_resume.
+        self.journal = journal
+        self.lm_resume = lm_resume
+        self._resume_max_attempts = int(resume_max_attempts)
+        self._resume_backoff_s = float(resume_backoff_s)
+        self._resume_tasks: set = set()  # pending backoff republishes
+        # usage metering / durability: pass tenant + task_id through to the
+        # engine when the stream callable takes them (LmEngine.generate_stream
         # does; duck-typed test stubs may not — probed once here)
-        self._stream_takes_tenant = False
-        if lm_stream is not None:
-            import inspect
-
-            try:
-                self._stream_takes_tenant = (
-                    "tenant" in inspect.signature(lm_stream).parameters)
-            except (TypeError, ValueError):
-                self._stream_takes_tenant = False
+        self._stream_params = self._probe_params(lm_stream)
+        self._resume_params = self._probe_params(lm_resume)
         self.train_on_ingest = train_on_ingest
         # online LM fine-tune (train/online.OnlineLmTrainer | None): the LM
         # analog of Markov's continuous learning — ingested text buffers
@@ -116,6 +124,27 @@ class TextGeneratorService(Service):
         # tombstone (it would silently kill a resubmission reusing the id)
         self._completed_recent: dict = {}
 
+    @staticmethod
+    def _probe_params(fn) -> frozenset:
+        """Keyword params a duck-typed engine callable accepts — real
+        engines take tenant/task_id/stream/resume, minimal test stubs may
+        take none; probed once so per-request calls stay reflection-free."""
+        if fn is None:
+            return frozenset()
+        import inspect
+
+        try:
+            return frozenset(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            return frozenset()
+
+    def _journal_done(self, task_id: str) -> None:
+        """Terminal journal marker — called after the task's outcome is
+        PUBLISHED (or it was cancelled), never earlier: a crash between
+        decode finishing and the publish must still resume on a survivor."""
+        if self.journal is not None and task_id:
+            self.journal.mark_done(task_id)
+
     async def _setup(self) -> None:
         await self._subscribe_loop(subjects.TASKS_GENERATION_TEXT,
                                    self._handle_generate,
@@ -124,6 +153,12 @@ class TextGeneratorService(Service):
         # decoding the task acts; everyone else ignores the unknown id
         await self._subscribe_loop(subjects.TASKS_GENERATION_CANCEL,
                                    self._handle_cancel)
+        # orphaned-session adoption (resilience/genlog.py): the supervisor
+        # republishes a dead worker's journal tails here; the queue group
+        # makes exactly one survivor adopt each
+        await self._subscribe_loop(subjects.TASKS_GENERATION_RESUME,
+                                   self._handle_resume,
+                                   queue=subjects.QUEUE_TEXT_GENERATOR)
         if self.train_on_ingest or self.lm_trainer is not None:
             # continuous learning from the pipeline (no queue group: every
             # generator replica learns the full stream)
@@ -292,11 +327,17 @@ class TextGeneratorService(Service):
         tombstone = self._cancelled_early.pop(task.task_id, None)
         if (tombstone is not None
                 and _time.monotonic() - tombstone
-                <= self._cancelled_early_ttl_s):
+                <= self._cancelled_early_ttl_s
+                and task.task_id not in self._completed_recent):
             # the cancel raced ahead of the task across the two subjects:
             # honor it now or the decode runs its full budget for a reader
             # that is already gone (stale tombstones are ignored — see
-            # _cancelled_early above)
+            # _cancelled_early above). The recently-completed guard covers
+            # the RETRY path too: a cancel landing during a failed
+            # delivery's backoff tombstones, but if the task meanwhile
+            # completed (this replica published its text), the redelivery
+            # must be a no-op-ish rerun, not a poisoned cancel — same rule
+            # _handle_cancel already applies to live tombstoning.
             cancel.set()
             metrics.inc("text_generator.cancelled")
         self._inflight[task.task_id] = cancel
@@ -315,12 +356,14 @@ class TextGeneratorService(Service):
                 elif self.lm_batcher is not None:
                     # cancel frees the request's decode row at the next
                     # chunk boundary (GenBatcher → BatchSession.cancel_tag);
-                    # the tenant header picks the fairness lane
+                    # the tenant header picks the fairness lane; task_id
+                    # keys the row's crash-resume journal snapshots
                     text = await self.lm_batcher.generate(
                         task.prompt or "", task.max_length,
                         temperature=task.temperature, top_k=task.top_k,
                         cancel=cancel,
-                        tenant=admission.tenant_of(msg.headers))
+                        tenant=admission.tenant_of(msg.headers),
+                        task_id=task.task_id)
                 elif self.lm_generate is not None:
                     text = await asyncio.get_running_loop().run_in_executor(
                         None, lambda: self.lm_generate(
@@ -340,7 +383,10 @@ class TextGeneratorService(Service):
         while len(self._completed_recent) > 256:
             self._completed_recent.pop(next(iter(self._completed_recent)))
         if text is None or cancel.is_set():
-            # cancelled mid-decode: nobody is listening — no final event
+            # cancelled mid-decode: nobody is listening — no final event,
+            # and the journal tail is terminal (a cancelled task must never
+            # resurrect as a resume after a later worker death)
+            self._journal_done(task.task_id)
             return
         out = GeneratedTextMessage(original_task_id=task.task_id,
                                    generated_text=text,
@@ -349,27 +395,48 @@ class TextGeneratorService(Service):
                                to_json_bytes(out),
                                headers=child_headers(msg.headers))
         metrics.inc("text_generator.generated")
+        # mark the journal tail done only now — the result is on the bus
+        self._journal_done(task.task_id)
 
     async def _stream_generate(self, task: GenerateTextTask, headers,
-                               cancel=None):
+                               cancel=None, resume=None):
         """Drive the decode generator in an executor thread; every text delta
         crossing back is published as a GeneratedTextChunk before the next
         chunk even starts decoding. Returns the accumulated full text — or
         None when `cancel` was set mid-stream (the generator is CLOSED at
         the next chunk boundary, which runs its finally block and releases
         its decode state; the terminal done-chunk still goes out so any
-        remaining consumer sees a clean close)."""
+        remaining consumer sees a clean close).
+
+        `resume` (a journal tail record — resilience/genlog.py) switches the
+        call into orphan adoption: the engine re-prefills the dead worker's
+        prompt+generated prefix and replays its last journaled chunk, so
+        seq numbering CONTINUES from the record (the SSE hub dedupes the
+        replayed chunk by seq — exactly-once at the edge) and the returned
+        full text prepends the text the dead worker already emitted.
+        Partials are only published when the originating task streamed."""
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
 
+        if resume is not None:
+            fn, params = self.lm_resume, self._resume_params
+        else:
+            fn, params = self.lm_stream, self._stream_params
         kw = {}
-        if self._stream_takes_tenant:
-            kw["tenant"] = admission.tenant_of(headers)
+        if "tenant" in params:
+            kw["tenant"] = (resume.get("tenant") if resume is not None
+                            else admission.tenant_of(headers))
+        if "task_id" in params:
+            kw["task_id"] = task.task_id
+        if resume is not None:
+            if "stream" in params:
+                kw["stream"] = bool(resume.get("stream"))
+            kw["resume"] = resume
 
         def produce() -> None:
-            gen = self.lm_stream(task.prompt or "", task.max_length,
-                                 temperature=task.temperature,
-                                 top_k=task.top_k, **kw)
+            gen = fn(task.prompt or "", task.max_length,
+                     temperature=task.temperature,
+                     top_k=task.top_k, **kw)
             try:
                 for delta in gen:
                     if cancel is not None and cancel.is_set():
@@ -387,37 +454,159 @@ class TextGeneratorService(Service):
 
         producer = loop.run_in_executor(None, produce)
         parts: list = []
-        seq = 0
+        seq = int(resume.get("seq") or 0) if resume is not None else 0
+        publish_partials = (resume is None) or bool(resume.get("stream"))
         cancelled = False
+        suppress_close = False
         try:
             while True:
                 kind, payload = await queue.get()
                 if kind == "delta":
                     parts.append(payload)
-                    await self.bus.publish(
-                        subjects.EVENTS_TEXT_GENERATED_PARTIAL,
-                        to_json_bytes(GeneratedTextChunk(
-                            original_task_id=task.task_id, text_delta=payload,
-                            seq=seq, done=False,
-                            timestamp_ms=current_timestamp_ms())),
-                        headers=child_headers(headers))
+                    if publish_partials:
+                        await self.bus.publish(
+                            subjects.EVENTS_TEXT_GENERATED_PARTIAL,
+                            to_json_bytes(GeneratedTextChunk(
+                                original_task_id=task.task_id,
+                                text_delta=payload,
+                                seq=seq, done=False,
+                                timestamp_ms=current_timestamp_ms())),
+                            headers=child_headers(headers))
+                        metrics.inc("text_generator.stream_chunks")
                     seq += 1
-                    metrics.inc("text_generator.stream_chunks")
                 elif kind == "end":
                     break
                 elif kind == "cancelled":
                     cancelled = True
                     break
                 else:
+                    if resume is not None and isinstance(payload,
+                                                        PoolExhausted):
+                        # transient admission refusal: the stream is NOT
+                        # over — the requeued resume continues it; a done
+                        # chunk here would close the waiting client early
+                        suppress_close = True
                     raise payload
         finally:
             await producer
-            # terminal chunk ALWAYS goes out — on a decode error too, so
-            # stream consumers get a close signal instead of hanging forever
-            await self.bus.publish(
-                subjects.EVENTS_TEXT_GENERATED_PARTIAL,
-                to_json_bytes(GeneratedTextChunk(
-                    original_task_id=task.task_id, text_delta="", seq=seq,
-                    done=True, timestamp_ms=current_timestamp_ms())),
-                headers=child_headers(headers))
-        return None if cancelled else "".join(parts)
+            if publish_partials and not suppress_close:
+                # terminal chunk ALWAYS goes out — on a decode error too, so
+                # stream consumers get a close signal instead of hanging
+                await self.bus.publish(
+                    subjects.EVENTS_TEXT_GENERATED_PARTIAL,
+                    to_json_bytes(GeneratedTextChunk(
+                        original_task_id=task.task_id, text_delta="", seq=seq,
+                        done=True, timestamp_ms=current_timestamp_ms())),
+                    headers=child_headers(headers))
+        if cancelled:
+            return None
+        prefix = (resume.get("text") or "") if resume is not None else ""
+        return prefix + "".join(parts)
+
+    # ------------------------------------------ orphaned-session adoption
+
+    async def _handle_resume(self, msg: Msg) -> None:
+        """Adopt one orphaned generation session (docs/RESILIENCE.md
+        "Durable generation sessions"): the supervisor republished a dead
+        worker's journal tail as {"task_id", "record", "attempt"}. The
+        engine re-prefills the journaled prompt+generated prefix and
+        continues the stream with monotonic seq; the SSE hub dedupes the
+        one replayed chunk — the client-observed token sequence stays
+        exactly-once and (greedy) token-identical to an unkilled run."""
+        import json as _json
+        import time as _time
+
+        try:
+            payload = _json.loads(msg.data)
+        except (ValueError, AttributeError):
+            return
+        rec = payload.get("record") or {}
+        task_id = payload.get("task_id") or rec.get("task_id")
+        attempt = int(payload.get("attempt") or 0)
+        if not task_id:
+            return
+        if self.lm_resume is None or not rec.get("prompt_ids"):
+            # no adoption-capable engine in this replica / torn record:
+            # counted loudly — this is the stream staying lost
+            metrics.inc("gen.resume_abandoned")
+            log.warning("cannot adopt orphaned generation %s "
+                        "(engine=%s, record ok=%s)", task_id,
+                        self.lm_resume is not None,
+                        bool(rec.get("prompt_ids")))
+            return
+        # resume-races-cancel: the client hung up before the worker died —
+        # its cancel fanned out to every replica and tombstoned the id here.
+        # Honor the tombstone: drop the resume instead of decoding for a
+        # reader that is gone.
+        tombstone = self._cancelled_early.pop(task_id, None)
+        if (tombstone is not None
+                and _time.monotonic() - tombstone
+                <= self._cancelled_early_ttl_s):
+            metrics.inc("gen.resume_dropped_cancelled")
+            log.info("dropping resume for cancelled generation %s", task_id)
+            return
+        if task_id in self._completed_recent:
+            # this replica already published the task's text (the orphan
+            # was a journal tail whose done-marker died with the worker)
+            metrics.inc("gen.resume_dropped_completed")
+            return
+        task = GenerateTextTask(
+            task_id=task_id, prompt="",
+            max_length=int(rec.get("max_new") or 1),
+            stream=bool(rec.get("stream")),
+            temperature=rec.get("temperature"), top_k=rec.get("top_k"))
+        cancel = asyncio.Event()
+        self._inflight[task_id] = cancel
+        try:
+            with span("text_generator.resume", msg.headers,
+                      attempt=attempt, tokens=len(rec.get("tokens") or ())):
+                text = await self._stream_generate(task, msg.headers,
+                                                   cancel, resume=rec)
+        except PoolExhausted:
+            # resume-under-pressure: the adopting engine refused admission
+            # (no KV headroom). Re-queue bounded-with-backoff — the orphan
+            # outlives a pressure spike instead of dying to it.
+            await self._requeue_resume(task_id, rec, attempt)
+            return
+        finally:
+            self._inflight.pop(task_id, None)
+        self._completed_recent[task_id] = True
+        while len(self._completed_recent) > 256:
+            self._completed_recent.pop(next(iter(self._completed_recent)))
+        if text is None or cancel.is_set():
+            self._journal_done(task_id)
+            return
+        await self.bus.publish(
+            subjects.EVENTS_TEXT_GENERATED,
+            to_json_bytes(GeneratedTextMessage(
+                original_task_id=task_id, generated_text=text,
+                timestamp_ms=current_timestamp_ms())),
+            headers=child_headers(msg.headers))
+        metrics.inc("text_generator.generated")
+        self._journal_done(task_id)
+
+    async def _requeue_resume(self, task_id: str, rec: dict,
+                              attempt: int) -> None:
+        """Bounded exponential-backoff republish of a pressure-refused
+        resume. Fire-and-forget sleep task: parking the handler itself
+        would eat a handler-semaphore slot for the whole backoff."""
+        import json as _json
+
+        if attempt + 1 >= self._resume_max_attempts:
+            metrics.inc("gen.resume_abandoned")
+            log.warning("orphaned generation %s abandoned after %d "
+                        "pressure-refused resume attempts", task_id,
+                        attempt + 1)
+            return
+        metrics.inc("gen.resume_requeued")
+        delay = self._resume_backoff_s * (2 ** attempt)
+        body = _json.dumps({"task_id": task_id, "record": rec,
+                            "attempt": attempt + 1}).encode()
+
+        async def later() -> None:
+            await asyncio.sleep(delay)
+            await self.bus.publish(subjects.TASKS_GENERATION_RESUME, body)
+
+        t = asyncio.create_task(later(), name=f"gen-resume-requeue-{task_id}")
+        self._resume_tasks.add(t)
+        t.add_done_callback(self._resume_tasks.discard)

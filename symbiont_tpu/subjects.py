@@ -31,6 +31,14 @@ EVENTS_TEXT_GENERATED_PARTIAL = "events.text.generated.partial"
 # mid-generation; the text generator frees the task's decode row / closes
 # its stream so a vanished reader can never pin a KV slot
 TASKS_GENERATION_CANCEL = "tasks.generation.cancel"
+# generation-session durability plane (resilience/genlog.py, docs/RESILIENCE.md
+# "Durable generation sessions"): when a generator worker dies mid-stream
+# (heartbeat verdict, exit, or drain-deadline SIGKILL), the process supervisor
+# republishes its journal tails here as plain-JSON resume tasks
+# {"task_id", "record", "attempt"}; generator replicas consume under the
+# text-generator queue group, so exactly one survivor adopts each orphaned
+# session and continues its token stream from `record`'s snapshot
+TASKS_GENERATION_RESUME = "tasks.generation.resume"
 
 # process-failure plane (resilience/procsup.py): every supervised runner
 # process publishes a liveness heartbeat under `_sys.heartbeat.<role>`; the
@@ -83,6 +91,7 @@ ALL_SUBJECTS = [
     EVENTS_TEXT_GENERATED,
     EVENTS_TEXT_GENERATED_PARTIAL,
     TASKS_GENERATION_CANCEL,
+    TASKS_GENERATION_RESUME,
     TASKS_EMBEDDING_FOR_QUERY,
     TASKS_SEARCH_SEMANTIC_REQUEST,
     TASKS_SEARCH_GRAPH_REQUEST,

@@ -1,0 +1,626 @@
+"""Durable generation sessions (resilience/genlog.py + engine resume +
+supervisor rescue + exactly-once SSE edge — docs/RESILIENCE.md "Durable
+generation sessions").
+
+Fast tier: journal WAL semantics, orphan scan/rotation, SSE hub dedupe and
+Last-Event-ID replay, service-level adoption (stub engine), the resume-
+races-cancel and resume-under-pressure paths, and the supervisor's rescue
+hooks. Slow tier (jax): token-identical greedy resume across dense/paged ×
+kv_quant, and PRNG-state restore for sampled streams."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from symbiont_tpu import subjects
+from symbiont_tpu.resilience.genlog import GenJournal
+from symbiont_tpu.utils.telemetry import metrics
+
+
+def _rec(task_id, tokens, seq=0, **kw):
+    base = dict(task_id=task_id, tenant="t", stream=True,
+                prompt_ids=[1, 2, 3], max_new=16, temperature=0.0,
+                top_k=0, tokens=list(tokens),
+                chunk_start=max(0, len(tokens) - 4), text="", seq=seq,
+                key=None, key_splits=0)
+    base.update(kw)
+    return base
+
+
+# ------------------------------------------------------------ journal WAL
+
+
+def test_journal_round_trip(tmp_path):
+    path = tmp_path / "gen.genlog"
+    j = GenJournal(path)
+    j.append(_rec("a", [5, 6]))
+    j.append(_rec("a", [5, 6, 7, 8], seq=1))
+    j.append(_rec("b", [9]))
+    assert len(j) == 2
+    tails = j.live_tails()
+    assert tails["a"]["tokens"] == [5, 6, 7, 8]  # last record wins
+    assert tails["a"]["seq"] == 1
+    j.mark_done("a")
+    assert "a" not in j.live_tails()
+    j.mark_done("a")  # idempotent no-op
+    j.mark_done("never-seen")
+
+    # survivor reload: a new incarnation of the same role sees b, not a
+    j2 = GenJournal(path)
+    assert set(j2.live_tails()) == {"b"}
+
+
+def test_journal_append_without_task_id_is_dropped(tmp_path):
+    j = GenJournal(tmp_path / "g.genlog")
+    j.append({"tokens": [1]})
+    assert len(j) == 0
+
+
+def test_journal_max_tasks_eviction(tmp_path):
+    j = GenJournal(tmp_path / "g.genlog", max_tasks=3)
+    for i in range(5):
+        j.append(_rec(f"t{i}", [i]))
+    assert len(j) == 3
+    assert set(j.live_tails()) == {"t2", "t3", "t4"}  # oldest evicted
+
+
+def test_journal_compaction_bounds_bytes(tmp_path):
+    path = tmp_path / "g.genlog"
+    j = GenJournal(path, max_bytes=2000)
+    for i in range(100):
+        j.append(_rec("hot", list(range(i % 8))))
+    # the file was rewritten to live tails only — far below 100 appends
+    assert path.stat().st_size < 2000
+    assert set(j.live_tails()) == {"hot"}
+    # the compacted file still resumes correctly
+    assert set(GenJournal.take_orphans(path)) == {"hot"}
+
+
+def test_journal_corrupt_line_skipped(tmp_path):
+    path = tmp_path / "g.genlog"
+    j = GenJournal(path)
+    j.append(_rec("ok", [1, 2]))
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"task_id": "torn", "tok')  # the SIGKILL's torn append
+    tails = GenJournal.take_orphans(path)
+    assert set(tails) == {"ok"}
+
+
+def test_journal_degrades_on_write_error(tmp_path):
+    # point the journal at a path whose parent is a FILE → open() raises
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    j = GenJournal(blocker / "g.genlog")
+    before = metrics.get("gen.journal_errors", 0)
+    j.append(_rec("a", [1]))
+    assert j.enabled is False  # store down ⇒ durability off, decode lives
+    assert metrics.get("gen.journal_errors", 0) == before + 1
+    j.append(_rec("b", [2]))  # silently a no-op now
+    assert len(j) == 0
+
+
+def test_take_orphans_rotates_aside(tmp_path):
+    path = tmp_path / "g.genlog"
+    j = GenJournal(path)
+    j.append(_rec("live", [1]))
+    j.append(_rec("finished", [2]))
+    j.mark_done("finished")
+    tails = GenJournal.take_orphans(path)
+    assert set(tails) == {"live"}
+    assert not path.exists()  # rotated aside: restarted role starts fresh
+    assert path.with_suffix(".genlog.orphaned").exists()
+    # a second scan (double verdict) finds nothing — no double-republish
+    assert GenJournal.take_orphans(path) == {}
+
+
+# ------------------------------------------------------ exactly-once edge
+
+
+def _chunk(task_id, seq, delta="x", done=False):
+    return json.dumps({"original_task_id": task_id, "text_delta": delta,
+                       "seq": seq, "done": done, "timestamp_ms": 0})
+
+
+def _drain(client):
+    out = []
+    while not client.q.empty():
+        out.append(client.q.get_nowait())
+    return out
+
+
+def test_sse_hub_dedupes_replayed_seq():
+    from symbiont_tpu.services.api import _SseHub
+
+    hub = _SseHub(capacity=32)
+    c = hub.register("t1")
+    hub.broadcast(_chunk("t1", 0))
+    hub.broadcast(_chunk("t1", 1))
+    hub.broadcast(_chunk("t1", 1))  # the resume's replayed chunk
+    hub.broadcast(_chunk("t1", 0))  # stale requeue race
+    hub.broadcast(_chunk("t1", 2, done=True))
+    items = _drain(c)
+    assert [json.loads(p)["seq"] for p, _, _ in items] == [0, 1, 2]
+    # wire ids stamp task:seq so browsers echo Last-Event-ID back
+    assert [i for _, i, _ in items] == ["t1:0", "t1:1", "t1:2"]
+    assert [d for _, _, d in items] == [False, False, True]
+
+
+def test_sse_hub_last_event_id_replay():
+    from symbiont_tpu.services.api import _SseHub
+
+    hub = _SseHub(capacity=32)
+    for s in range(4):
+        hub.broadcast(_chunk("t2", s, delta=f"d{s}"))
+    # reconnect claiming it saw up to seq 1 → history replays 2, 3
+    c = hub.register("t2", last_event_id="t2:1")
+    replayed = _drain(c)
+    assert [json.loads(p)["seq"] for p, _, _ in replayed] == [2, 3]
+    # garbage Last-Event-ID replays nothing (and does not raise)
+    c2 = hub.register("t2", last_event_id="not-an-id")
+    assert _drain(c2) == []
+    # a filtered client never replays another task's history
+    c3 = hub.register("other", last_event_id="t2:1")
+    assert _drain(c3) == []
+
+
+def test_sse_hub_lagged_client_gets_terminal_close():
+    from symbiont_tpu.services.api import _LAGGED, _SseHub
+
+    hub = _SseHub(capacity=2)
+    c = hub.register("t3")
+    before = metrics.get("api.sse_lagged_closed", 0)
+    for s in range(5):  # capacity 2 → overflow on the 3rd
+        hub.broadcast(_chunk("t3", s))
+    items = _drain(c)
+    assert items[-1] is _LAGGED  # woken with the lag verdict, not silence
+    assert c.lagged is True
+    # no further events are queued behind the verdict
+    hub.broadcast(_chunk("t3", 9))
+    assert c.q.empty()
+    del before  # counter moves in _serve_sse, not the hub
+
+
+def test_sse_hub_unfiltered_client_and_non_json_payloads():
+    from symbiont_tpu.services.api import _SseHub
+
+    hub = _SseHub(capacity=8)
+    c = hub.register(None)  # reference-style receive-everything client
+    hub.broadcast(_chunk("tX", 0))
+    hub.broadcast("not json at all")
+    items = _drain(c)
+    assert len(items) == 2
+    assert items[1] == ("not json at all", None, False)
+
+
+# ------------------------------------------------- service-level adoption
+
+
+def _stub_resume(chunks, calls=None, raise_exc=None):
+    """A duck-typed LmEngine.generate_stream: records the resume record it
+    was handed, then yields the replay delta + continuation chunks."""
+
+    def fn(prompt, max_new_tokens, temperature=None, top_k=None,
+           tenant=None, task_id=None, stream=True, resume=None):
+        if calls is not None:
+            calls.append(dict(prompt=prompt, max_new=max_new_tokens,
+                              tenant=tenant, task_id=task_id,
+                              stream=stream, resume=resume))
+        if raise_exc is not None:
+            raise raise_exc
+        yield from chunks
+
+    return fn
+
+
+def _resume_body(task_id, attempt=0, **kw):
+    rec = _rec(task_id, [5, 6, 7, 8], seq=2, text="already-", stream=True,
+               **kw)
+    return json.dumps({"task_id": task_id, "record": rec,
+                       "attempt": attempt}).encode()
+
+
+def test_handle_resume_adopts_and_publishes():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.schema import GeneratedTextMessage, from_json
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        calls = []
+        svc = TextGeneratorService(
+            bus, lm_resume=_stub_resume(["emitted ", "rest"], calls))
+        await svc.start()
+        final = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+        partial = await bus.subscribe(
+            subjects.EVENTS_TEXT_GENERATED_PARTIAL)
+        await bus.publish(subjects.TASKS_GENERATION_RESUME,
+                          _resume_body("orph-1"))
+        msg = await asyncio.wait_for(final.__aiter__().__anext__(),
+                                     timeout=10)
+        out = from_json(GeneratedTextMessage, msg.data)
+        # journaled prefix text + replayed chunk + continuation
+        assert out.original_task_id == "orph-1"
+        assert out.generated_text == "already-emitted rest"
+        assert calls[0]["resume"]["tokens"] == [5, 6, 7, 8]
+        assert calls[0]["task_id"] == "orph-1"
+        # seq numbering CONTINUED from the record (2, 3, then done at 4)
+        seqs = []
+        for _ in range(3):
+            m = await asyncio.wait_for(partial.__aiter__().__anext__(),
+                                       timeout=10)
+            seqs.append(json.loads(m.data)["seq"])
+        assert seqs == [2, 3, 4]
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+def test_handle_resume_non_streaming_skips_partials():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        svc = TextGeneratorService(bus, lm_resume=_stub_resume(["batchy"]))
+        await svc.start()
+        final = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+        partial = await bus.subscribe(
+            subjects.EVENTS_TEXT_GENERATED_PARTIAL)
+        body = json.dumps({"task_id": "orph-b", "attempt": 0,
+                           "record": _rec("orph-b", [5], stream=False)})
+        await bus.publish(subjects.TASKS_GENERATION_RESUME, body.encode())
+        await asyncio.wait_for(final.__aiter__().__anext__(), timeout=10)
+        # a batch-row adoption publishes NO stream chunks (nobody follows)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(partial.__aiter__().__anext__(),
+                                   timeout=0.1)
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+def test_handle_resume_drops_cancelled_tombstone():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        calls = []
+        svc = TextGeneratorService(bus,
+                                   lm_resume=_stub_resume(["x"], calls))
+        await svc.start()
+        # the reader hung up before the worker died: its cancel fanned out
+        # and tombstoned here — the resume must be dropped, not decoded
+        await bus.publish(subjects.TASKS_GENERATION_CANCEL,
+                          json.dumps({"task_id": "orph-c"}).encode())
+        await asyncio.sleep(0.05)
+        before = metrics.get("gen.resume_dropped_cancelled", 0)
+        await bus.publish(subjects.TASKS_GENERATION_RESUME,
+                          _resume_body("orph-c"))
+        await asyncio.sleep(0.1)
+        assert calls == []
+        assert metrics.get("gen.resume_dropped_cancelled", 0) == before + 1
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+def test_handle_resume_requeues_on_pool_pressure():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.kv.pool import PoolExhausted
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        svc = TextGeneratorService(
+            bus, lm_resume=_stub_resume([], raise_exc=PoolExhausted("full")),
+            resume_max_attempts=3, resume_backoff_s=0.01)
+        await svc.start()
+        sub = await bus.subscribe(subjects.TASKS_GENERATION_RESUME)
+        before_rq = metrics.get("gen.resume_requeued", 0)
+        before_ab = metrics.get("gen.resume_abandoned", 0)
+        await bus.publish(subjects.TASKS_GENERATION_RESUME,
+                          _resume_body("orph-p", attempt=0))
+        # attempt 0 → requeued as attempt 1 (our own subscribe sees the
+        # republish alongside the service's queue-group delivery)
+        seen = []
+        async for m in sub:
+            body = json.loads(m.data)
+            seen.append(body["attempt"])
+            if body["attempt"] >= 2:
+                break
+        assert seen[:3] == [0, 1, 2]
+        await asyncio.sleep(0.1)  # attempt 2 is the last (max_attempts 3)
+        assert metrics.get("gen.resume_requeued", 0) == before_rq + 2
+        assert metrics.get("gen.resume_abandoned", 0) == before_ab + 1
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+def test_handle_resume_without_engine_abandons():
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        svc = TextGeneratorService(bus)  # markov-only replica: cannot adopt
+        await svc.start()
+        before = metrics.get("gen.resume_abandoned", 0)
+        await bus.publish(subjects.TASKS_GENERATION_RESUME,
+                          _resume_body("orph-n"))
+        await asyncio.sleep(0.05)
+        assert metrics.get("gen.resume_abandoned", 0) == before + 1
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+def test_completed_guard_covers_retry_path():
+    """PR-9 tombstone gap regression: a cancel lands while a COMPLETED
+    task's delivery is being retried — the tombstone must not poison the
+    rerun into a cancel (the task already published its text here)."""
+    from symbiont_tpu.bus.inproc import InprocBus
+    from symbiont_tpu.schema import (
+        GeneratedTextMessage,
+        GenerateTextTask,
+        from_json,
+        to_json_bytes,
+    )
+    from symbiont_tpu.services.text_generator import TextGeneratorService
+
+    async def run():
+        bus = InprocBus()
+        svc = TextGeneratorService(bus, train_on_ingest=False)
+        await svc.start()
+        sub = await bus.subscribe(subjects.EVENTS_TEXT_GENERATED)
+        task = GenerateTextTask(task_id="done-1", prompt="", max_length=5)
+        await bus.publish(subjects.TASKS_GENERATION_TEXT,
+                          to_json_bytes(task))
+        await asyncio.wait_for(sub.__aiter__().__anext__(), timeout=10)
+        assert "done-1" in svc._completed_recent
+        # stale cancel arrives post-completion: must NOT tombstone...
+        await bus.publish(subjects.TASKS_GENERATION_CANCEL,
+                          json.dumps({"task_id": "done-1"}).encode())
+        await asyncio.sleep(0.05)
+        assert "done-1" not in svc._cancelled_early
+        # ...and even a tombstone that slipped in (cancel raced the
+        # completion bookkeeping) must not cancel the retry of a task
+        # recorded as completed
+        svc._cancelled_early["done-1"] = time.monotonic()
+        await bus.publish(subjects.TASKS_GENERATION_TEXT,
+                          to_json_bytes(task))
+        msg = await asyncio.wait_for(sub.__aiter__().__anext__(),
+                                     timeout=10)
+        out = from_json(GeneratedTextMessage, msg.data)
+        assert out.original_task_id == "done-1"
+        assert isinstance(out.generated_text, str)  # rerun, not a cancel
+        await svc.stop()
+
+    asyncio.run(run())
+
+
+# -------------------------------------------------- supervisor-side rescue
+
+
+class _StubBus:
+    def __init__(self):
+        self.published = []
+
+    async def publish(self, subject, data, headers=None):
+        self.published.append((subject, data))
+
+
+def _gen_worker(tmp_path, role="genw"):
+    from symbiont_tpu.resilience.procsup import _Worker, WorkerSpec
+
+    return _Worker(WorkerSpec(
+        role=role, argv=["true"],
+        env={"SYMBIONT_GEN_JOURNAL_ENABLED": "1",
+             "SYMBIONT_GEN_JOURNAL_DIR": str(tmp_path),
+             "SYMBIONT_RUNNER_ROLE": role}))
+
+
+def test_rescue_gen_orphans_republishes_tails(tmp_path):
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+
+    async def run():
+        path = tmp_path / "genw.genlog"
+        j = GenJournal(path)
+        j.append(_rec("o1", [1, 2]))
+        j.append(_rec("o2", [3]))
+        j.append(_rec("fin", [4]))
+        j.mark_done("fin")
+        sup = ProcessSupervisor()
+        sup._bus = _StubBus()
+        before = metrics.get("gen.orphans", 0)
+        await sup._rescue_gen_orphans(_gen_worker(tmp_path))
+        assert metrics.get("gen.orphans", 0) == before + 2
+        assert not path.exists()  # rotated: restart starts a fresh journal
+        bodies = {json.loads(d)["task_id"]: json.loads(d)
+                  for s, d in sup._bus.published
+                  if s == subjects.TASKS_GENERATION_RESUME}
+        assert set(bodies) == {"o1", "o2"}
+        assert bodies["o1"]["attempt"] == 0
+        assert bodies["o1"]["record"]["tokens"] == [1, 2]
+        # double verdict on the same death republishes nothing
+        await sup._rescue_gen_orphans(_gen_worker(tmp_path))
+        assert len(sup._bus.published) == 2
+
+    asyncio.run(run())
+
+
+def test_rescue_skips_without_journal_env_or_bus(tmp_path):
+    from symbiont_tpu.resilience.procsup import (
+        ProcessSupervisor,
+        WorkerSpec,
+        _Worker,
+    )
+
+    async def run():
+        path = tmp_path / "genw.genlog"
+        GenJournal(path).append(_rec("o1", [1]))
+        sup = ProcessSupervisor()
+        # no journal env → no scan even with a bus
+        sup._bus = _StubBus()
+        await sup._rescue_gen_orphans(
+            _Worker(WorkerSpec(role="plain", argv=["true"])))
+        assert sup._bus.published == []
+        assert path.exists()
+        # journal env but bus down → scan DEFERRED, file left in place so a
+        # later verdict (or the restarted role's reload) still covers it
+        sup._bus = None
+        await sup._rescue_gen_orphans(_gen_worker(tmp_path))
+        assert path.exists()
+
+    asyncio.run(run())
+
+
+def test_drain_deadline_sigkill_rescues_orphans(tmp_path):
+    """Drain-deadline resume: a worker that ignores the drain past the
+    deadline is SIGKILLed — and its journal tails republish, because a
+    mid-stream generation is past its bus ack (durable redelivery alone
+    cannot recover it)."""
+    from symbiont_tpu.resilience.procsup import ProcessSupervisor
+
+    async def run():
+        path = tmp_path / "genw.genlog"
+        GenJournal(path).append(_rec("drainee", [7, 8]))
+        sup = ProcessSupervisor(drain_deadline_s=1.0)
+        sup._bus = _StubBus()
+        w = _gen_worker(tmp_path)
+        sup.workers[w.spec.role] = w
+        w.proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import signal, time; "
+             "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+             "time.sleep(60)"],
+            start_new_session=True)
+        try:
+            await sup._drain_worker(w, deadline_s=1.5)
+        finally:
+            if w.proc.poll() is None:
+                os.kill(w.proc.pid, signal.SIGKILL)
+                w.proc.wait(timeout=5)
+        assert w.drain_clean is False  # the deadline SIGKILL fired
+        resumed = [json.loads(d)["task_id"]
+                   for s, d in sup._bus.published
+                   if s == subjects.TASKS_GENERATION_RESUME]
+        assert resumed == ["drainee"]
+        assert not path.exists()
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------- engine resume (slow)
+
+TINY = dict(enabled=True, arch="llama", hidden_size=32, num_layers=2,
+            num_heads=4, intermediate_size=64, max_positions=256,
+            dtype="float32", prompt_buckets=[8, 16, 64],
+            new_token_buckets=[8, 16], temperature=0.0, stream_chunk=4)
+
+
+def _run_with_kill(eng, journal, prompt, max_new, kill_after, **kw):
+    """Stream until `kill_after` chunks arrived, then abandon the
+    generator mid-flight — the SIGKILL stand-in (nothing downstream of
+    the journal append runs for the killed chunk's successor)."""
+    eng.journal = journal
+    got = []
+    gen = eng.generate_stream(prompt, max_new, task_id="kill-me", **kw)
+    for delta in gen:
+        got.append(delta)
+        if len(got) >= kill_after:
+            gen.close()
+            break
+    return "".join(got)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout,kv_quant", [("dense", "none"),
+                                             ("paged", "none"),
+                                             ("paged", "int8")])
+def test_resume_token_identical_greedy(tmp_path, layout, kv_quant):
+    """The durability gate: kill a greedy stream at a chunk boundary,
+    adopt its journal tail on a FRESH engine, and the reassembled text is
+    byte-identical to an unkilled run (position-invariant re-prefill)."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    cfg = LmConfig(**dict(TINY, kv_layout=layout, kv_quant=kv_quant,
+                          kv_page_tokens=8))
+    prompt = "the quick brown fox jumps"
+    ref = "".join(LmEngine(cfg).generate_stream(prompt, 16))
+
+    eng = LmEngine(cfg)
+    journal = GenJournal(tmp_path / "a.genlog")
+    _run_with_kill(eng, journal, prompt, 16, kill_after=2)
+    rec = journal.live_tails()["kill-me"]
+    assert rec["key"] is None  # greedy journals no PRNG state
+
+    adopter = LmEngine(cfg)  # fresh process: cold KV, no radix state
+    deltas = list(adopter.generate_stream(
+        "", rec["max_new"], temperature=rec["temperature"],
+        top_k=rec["top_k"], task_id="kill-me", stream=True, resume=rec))
+    assert rec["text"] + "".join(deltas) == ref
+
+
+@pytest.mark.slow
+def test_resume_restores_prng_for_sampled(tmp_path):
+    """Sampled streams resume token-identically on a DIFFERENT-seed
+    adopting engine: the journal carries the stream's base key + splits
+    consumed, and resume re-derives the live key host-side."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    cfg = LmConfig(**dict(TINY, temperature=0.8, seed=7))
+    prompt = "sampling is stochastic"
+    ref = "".join(LmEngine(cfg).generate_stream(prompt, 16,
+                                                temperature=0.8, top_k=8))
+
+    eng = LmEngine(cfg)
+    journal = GenJournal(tmp_path / "s.genlog")
+    _run_with_kill(eng, journal, prompt, 16, kill_after=2,
+                   temperature=0.8, top_k=8)
+    rec = journal.live_tails()["kill-me"]
+    assert rec["key"] is not None and rec["key_splits"] >= 1
+
+    other = LmEngine(LmConfig(**dict(TINY, temperature=0.8, seed=99)))
+    deltas = list(other.generate_stream(
+        "", rec["max_new"], temperature=rec["temperature"],
+        top_k=rec["top_k"], task_id="kill-me", stream=True, resume=rec))
+    assert rec["text"] + "".join(deltas) == ref
+
+
+@pytest.mark.slow
+def test_batch_session_rows_journal_and_cancel_marks_done(tmp_path):
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+
+    eng = LmEngine(LmConfig(**dict(TINY, session_min_rows=2,
+                                   gen_max_batch=2)))
+    journal = eng.journal = GenJournal(tmp_path / "b.genlog")
+    s = eng.start_session(["hello", "world"], [8, 8], temperature=0.0,
+                          task_ids=["row-a", "row-b"])
+    s.step()
+    tails = journal.live_tails()
+    assert set(tails) == {"row-a", "row-b"}
+    assert tails["row-a"]["stream"] is False
+    assert tails["row-a"]["prompt_ids"]  # post-trim prompt captured
+    assert len(tails["row-a"]["tokens"]) >= 1
+    # cancel is terminal ENGINE-side (no service publish will follow):
+    # the row's journal tail must never resurrect as a resume
+    assert s.cancel_tag(s.rows[1].tag)
+    assert set(journal.live_tails()) == {"row-a"}
+    # drive to completion; the finished row STAYS journaled — only the
+    # service's post-publish mark_done retires it (crash-in-publish-window
+    # coverage)
+    while not s.done():
+        s.step()
+    s._drain_all()
+    assert "row-a" in journal.live_tails()
