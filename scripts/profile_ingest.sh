@@ -91,6 +91,15 @@ if s.get("decode_host_gap_pct") is not None:
           + f"{s['decode_dispatches_per_token']}")
     print("  " + "host gap (chunk wall)".ljust(28)
           + f"{s['decode_host_gap_pct']}% host-side between dispatches")
+# speculative-decode rows (engine/lm.py draft plane) appear only when the
+# window recorded spec rounds — spec-off deployments print unchanged
+if s.get("decode_spec_accept_pct") is not None:
+    print("  " + "spec accept rate".ljust(28)
+          + f"{s['decode_spec_accept_pct']}% over "
+            f"{s.get('decode_spec_rounds', 0)} rounds")
+    print("  " + "spec draft / verify wall".ljust(28)
+          + f"{s.get('decode_spec_draft_ms_total', 0)} / "
+            f"{s.get('decode_spec_verify_ms_total', 0)} ms")
 print("dominant stall:", s["dominant_stall"])
 print(f"(Perfetto view: curl http://{api}"
       "'/api/engine/timeline?fmt=chrome' > tl.json, open in "

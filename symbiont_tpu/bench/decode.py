@@ -182,7 +182,9 @@ def tier_streaming(results: dict, ctx) -> None:
           primary_metrics=("decode_sessions_per_gib",
                            "decode_radix_hit_pct",
                            "decode_dispatches_per_token",
-                           "decode_host_gap_pct"))
+                           "decode_host_gap_pct",
+                           "decode_spec_accept_pct",
+                           "decode_spec_speedup_x"))
 def tier_decode_timeline(results: dict, ctx) -> None:
     """Decode-plane flight recorder under a REAL continuous-batching
     session mix (obs/engine_timeline.py), run TWICE: once on the dense
@@ -195,7 +197,15 @@ def tier_decode_timeline(results: dict, ctx) -> None:
     sharing, and the full-hit skip-prefill path. Primaries:
     `decode_sessions_per_gib` (live sessions one GiB of KV holds at the
     measured occupancy — the paged capacity win) and
-    `decode_radix_hit_pct` (prompt tokens served from shared pages)."""
+    `decode_radix_hit_pct` (prompt tokens served from shared pages).
+
+    A third pass benchmarks speculative decoding (engine/lm.py draft
+    plane + models/gpt.py verify_chunk) on a scaled llama-geometry
+    target with an in-tier-distilled gpt2-geometry drafter: primaries
+    `decode_spec_accept_pct` and `decode_spec_speedup_x` (>= 1.2 gated
+    in-tier vs the same-run spec-off wall), with greedy token identity
+    and the dispatches-per-emitted-token collapse asserted, not just
+    archived."""
     import asyncio
 
     from symbiont_tpu.config import LmConfig
@@ -220,17 +230,19 @@ def tier_decode_timeline(results: dict, ctx) -> None:
             session_min_rows=8, temperature=0.0, kv_layout=layout,
             kv_page_tokens=16))
 
-    def drive(eng, repeat: bool) -> None:
+    def drive(eng, repeat: bool) -> dict:
+        texts: dict = {}
+
         async def scenario() -> None:
             batcher = GenBatcher(eng)
             await batcher.start()
             try:
                 # mixed LENGTHS on purpose: long rows decode most of the
-                # 64-token bucket while short rows finish after 8 — dense
-                # keeps every row's full (32+64)-slot slab allocated until
-                # the session ends, paged returns a finished row's pages
-                # at the next chunk boundary and long rows grow page by
-                # page instead of starting slab-sized
+                # new-token bucket while short rows finish after 8 — dense
+                # keeps every row's full slab allocated until the session
+                # ends, paged returns a finished row's pages at the next
+                # chunk boundary and long rows grow page by page instead
+                # of starting slab-sized
                 wave1 = [asyncio.ensure_future(batcher.generate(
                     shared + f"query {i}", 48, tenant=f"t{i % 2}"))
                     for i in range(4)]
@@ -240,6 +252,10 @@ def tier_decode_timeline(results: dict, ctx) -> None:
                     for i in range(3)]
                 done = await asyncio.gather(*wave1, *wave2)
                 assert all(isinstance(t, str) for t in done), done
+                for i in range(4):
+                    texts[shared + f"query {i}"] = done[i]
+                for i in range(3):
+                    texts[shared + f"late {i}"] = done[4 + i]
                 if repeat:
                     # the RAG-template case: identical prompts re-admitted
                     # after their prefix pages are committed — full radix
@@ -252,6 +268,7 @@ def tier_decode_timeline(results: dict, ctx) -> None:
                 await batcher.close()
 
         asyncio.run(scenario())
+        return texts
 
     def sessions_per_gib(eng, events) -> float:
         """Mean live rows per KV byte actually HELD, scaled to one GiB —
@@ -331,3 +348,126 @@ def tier_decode_timeline(results: dict, ctx) -> None:
         f"{results['decode_dispatches_per_token']} dispatches/token, host "
         f"gap {results['decode_host_gap_pct']}% of chunk wall; dominant "
         f"stall: {s['dominant_stall']}")
+
+    # ---- speculative-decode pass (ROADMAP item 1: draft + verify) ------
+    # Scaled stand-in for the GPT-2-124M -> TinyLlama-1.1B pair the
+    # roadmap names: the TARGET is a TinyLlama-shaped llama geometry
+    # (RMSNorm/RoPE/SwiGLU) and the DRAFTER a GPT-2-shaped one at ~2% of
+    # the FLOPs, distilled IN-TIER (train/trainer.py lm_train_step) on the
+    # target's own greedy rollouts of this tier's exact prompt mix.
+    # Distillation uses TRUE token ids from the one-shot scan
+    # (gpt_mod.generate) — re-encoding decoded text is lossy for byte
+    # streams that decode to U+FFFD, and a drafter trained on re-encoded
+    # text proposes the wrong ids (accept ~0%).
+    # Three hard gates ride the tier, not just the archive:
+    #   1. spec-on output == spec-off output (greedy identity),
+    #   2. decode_spec_speedup_x >= 1.2 (same workload, same target),
+    #   3. spec-on dispatches/emitted-token < the spec-off baseline
+    #      (0.125 at stream_chunk=8).
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from symbiont_tpu.models import gpt as gpt_mod
+    from symbiont_tpu.train import trainer
+
+    def mk_spec(draft_of=None) -> "LmEngine":
+        cfg = LmConfig(
+            enabled=True, arch="llama", hidden_size=256, num_layers=4,
+            num_heads=4, intermediate_size=512, max_positions=256,
+            dtype="float32", prompt_buckets=[32], new_token_buckets=[128],
+            stream_chunk=8, gen_max_batch=8, gen_flush_deadline_ms=5.0,
+            session_min_rows=8, temperature=0.0, kv_layout="paged",
+            kv_page_tokens=16, spec_k=24)
+        if draft_of is None:
+            return LmEngine(cfg)
+        return LmEngine(cfg, draft_params=draft_of[0],
+                        draft_model_cfg=draft_of[1])
+
+    spec_off = mk_spec()
+    drafter = LmEngine(LmConfig(
+        enabled=True, arch="gpt2", hidden_size=64, num_layers=1,
+        num_heads=2, intermediate_size=128, max_positions=256,
+        dtype="float32", prompt_buckets=[32], new_token_buckets=[128],
+        temperature=0.0))
+
+    # greedy rollouts of the tier's own prompts, straight from the target
+    prompts = [shared + f"query {i}" for i in range(4)] + \
+              [shared + f"late {i}" for i in range(3)]
+    p_ids, p_mask, _nb = spec_off._prepare_prompts(prompts, 48)
+    toks, _counted = gpt_mod.generate(
+        spec_off.params, jnp.asarray(p_ids), jnp.asarray(p_mask),
+        jax.random.key(0), spec_off.model_cfg, max_new_tokens=48,
+        temperature=0.0)
+    toks = np.asarray(toks)
+    p_ids, p_mask = np.asarray(p_ids), np.asarray(p_mask)
+    B, P = p_ids.shape
+    ids = np.zeros((B, P + 48), np.int32)
+    mask = np.zeros((B, P + 48), np.int32)
+    for i in range(B):
+        row = np.concatenate([p_ids[i][p_mask[i].astype(bool)], toks[i]])
+        ids[i, :len(row)] = row
+        mask[i, :len(row)] = 1
+    batch = {"ids": jnp.asarray(ids), "mask": jnp.asarray(mask)}
+    t0 = time.time()
+    state, tx = trainer.make_lm_train_state(drafter.params,
+                                            learning_rate=3e-3)
+    for _ in range(400):
+        state, aux = trainer.lm_train_step(state, batch,
+                                           drafter.model_cfg, tx)
+    results["decode_spec_distill_s"] = round(time.time() - t0, 1)
+    results["decode_spec_distill_loss"] = round(float(aux["loss"]), 4)
+
+    spec_on = mk_spec(draft_of=(state.params, drafter.model_cfg))
+    assert spec_on._draft is not None, "drafter failed compat validation"
+
+    REPS = 3
+
+    def timed(eng) -> tuple:
+        ref = drive(eng, repeat=True)  # warm: compiles every executable
+        engine_timeline.clear()
+        walls = []
+        for _ in range(REPS):
+            t0 = time.time()
+            texts = drive(eng, repeat=True)
+            walls.append(time.time() - t0)
+            assert texts == ref, "greedy run not reproducible"
+        return ref, sorted(walls)[REPS // 2], engine_timeline.summary()
+
+    ref_off, wall_off, s_off = timed(spec_off)
+    ref_on, wall_on, s_on = timed(spec_on)
+    # hard gate 1: speculation must not change greedy output
+    assert ref_on == ref_off, "spec-on output diverged from spec-off"
+    speedup = round(wall_off / wall_on, 2)
+    disp_off = s_off.get("decode_dispatches_per_token", 0.0)
+    disp_on = s_on.get("decode_dispatches_per_token", 0.0)
+    # hard gates 2 + 3: the wall win and the dispatch collapse
+    assert speedup >= 1.2, \
+        f"spec speedup {speedup}x below the 1.2x gate"
+    assert 0.0 < disp_on < disp_off, \
+        f"spec-on dispatches/token {disp_on} not below baseline {disp_off}"
+    results["decode_spec_accept_pct"] = s_on.get("decode_spec_accept_pct",
+                                                 0.0)
+    results["decode_spec_speedup_x"] = speedup
+    results["decode_spec_rounds"] = s_on.get("decode_spec_rounds", 0)
+    results["decode_spec_dispatches_per_token"] = disp_on
+    results["decode_spec_dispatches_per_token_off"] = disp_off
+    results["decode_spec_draft_ms_total"] = s_on.get(
+        "decode_spec_draft_ms_total", 0.0)
+    results["decode_spec_verify_ms_total"] = s_on.get(
+        "decode_spec_verify_ms_total", 0.0)
+    results["decode_spec_tpot_ms_p50"] = s_on.get("decode_tpot_ms_p50",
+                                                  0.0)
+    results["decode_spec_tpot_ms_p50_off"] = s_off.get(
+        "decode_tpot_ms_p50", 0.0)
+    log(f"speculative decode (llama-geom target, distilled gpt2-geom "
+        f"drafter, k=24, paged+radix): {speedup}x wall vs spec-off "
+        f"(greedy outputs identical), accept "
+        f"{results['decode_spec_accept_pct']}% over "
+        f"{results['decode_spec_rounds']} rounds, {disp_on} "
+        f"dispatches/emitted-token (spec-off {disp_off}), draft "
+        f"{results['decode_spec_draft_ms_total']}ms / verify "
+        f"{results['decode_spec_verify_ms_total']}ms, TPOT p50 "
+        f"{results['decode_spec_tpot_ms_p50']}ms vs "
+        f"{results['decode_spec_tpot_ms_p50_off']}ms; dominant stall: "
+        f"{s_on['dominant_stall']}")

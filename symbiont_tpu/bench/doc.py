@@ -810,7 +810,7 @@ primaries.
             "appear from the next full `python bench.py` run.\n\n")
     dense = f.get("decode_sessions_per_gib_dense", 0) or 0
     ratio = (f["decode_sessions_per_gib"] / dense) if dense else 0.0
-    return header + measured + (
+    paged = (
         f"Paged KV + radix prefix cache (`symbiont_tpu/kv/`): "
         f"**{_fmt(f['decode_sessions_per_gib'])} sessions/GiB** vs "
         f"{_fmt(dense)} for the dense layout on the same mix "
@@ -820,6 +820,31 @@ primaries.
         f"**{f.get('decode_ttft_hit_ms_p50', '—')} ms** (one decode "
         f"chunk) vs {f.get('decode_ttft_cold_ms_p50', '—')} ms for a "
         f"cold prefill.\n\n")
+    if "decode_spec_accept_pct" not in f:
+        # the speculative-decode pass (engine/lm.py draft plane +
+        # models/gpt.py verify_chunk) lands in the archive once the tier
+        # runs against that subsystem
+        return header + measured + paged + (
+            "This archive predates the speculative-decode pass, so its "
+            "fields (`decode_spec_accept_pct`, `decode_spec_speedup_x`, "
+            "`decode_spec_dispatches_per_token`) will appear from the "
+            "next full `python bench.py` run. The tier itself hard-gates "
+            "them: greedy spec-on output must be token-identical to "
+            "spec-off, the wall speedup must reach 1.2×, and "
+            "dispatches-per-emitted-token must drop below the 0.125 "
+            "spec-off baseline.\n\n")
+    return header + measured + paged + (
+        f"Speculative decoding (`engine/lm.py` draft plane + "
+        f"`models/gpt.py` verify_chunk, drafter distilled in-tier on the "
+        f"target's own greedy rollouts): **"
+        f"{f['decode_spec_speedup_x']}× wall** vs the same-run spec-off "
+        f"baseline with greedy outputs token-identical (gated in-tier), "
+        f"draft acceptance **{f['decode_spec_accept_pct']} %**, "
+        f"**{f.get('decode_spec_dispatches_per_token', '—')} "
+        f"dispatches per emitted token** vs "
+        f"{f.get('decode_spec_dispatches_per_token_off', '—')} spec-off, "
+        f"TPOT p50 {f.get('decode_spec_tpot_ms_p50', '—')} ms vs "
+        f"{f.get('decode_spec_tpot_ms_p50_off', '—')} ms.\n\n")
 
 
 def _render_autoscale(f: dict) -> str:

@@ -36,6 +36,12 @@ seeded synthetic load:
   the resume snapshot + one buffered line write, fsync off). It rides
   the same chunk-boundary host sync as the timeline record, so it gates
   the same way.
+- `obs_spec_bookkeeping_per_s` (primary, higher is better): speculative-
+  decode accept/rollback rounds per second — the HOST side of one
+  `_step_spec` chunk boundary (engine/lm.py): walk every row's verified
+  window for the accepted prefix, stop at the correction/EOS, tally
+  accept counters and the divergence EMA. Pays per spec round on the
+  decode critical path, so it gates like the timeline record.
 
 All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
@@ -46,6 +52,8 @@ from __future__ import annotations
 
 import logging
 import time
+
+import numpy as np
 
 from symbiont_tpu.bench import stats
 from symbiont_tpu.bench.tiers import register
@@ -145,12 +153,17 @@ TIMELINE_EVENTS = 4000   # timeline records per throughput sample
 JOURNAL_EVENTS = 2000    # journal appends per throughput sample
 
 
+SPEC_ROUNDS = 2000       # spec accept/rollback rounds per throughput sample
+
+
 @register("obs", primary_metrics=("obs_span_record_per_s",
                                   "obs_critical_path_512_ms",
                                   "obs_fleet_merge_per_s",
                                   "obs_timeline_record_per_s",
                                   "obs_dispatch_record_per_s",
-                                  "obs_journal_record_per_s"), quick=True)
+                                  "obs_journal_record_per_s",
+                                  "obs_spec_bookkeeping_per_s"),
+          quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
     from symbiont_tpu.obs.engine_timeline import EngineTimeline
@@ -291,6 +304,43 @@ def tier_obs(results: dict, ctx) -> None:
     stats.record(results, "obs_journal_record_per_s",
                  [one_journal_sample() for _ in range(REPEATS)], digits=0)
 
+    # ---- speculative-decode accept/rollback bookkeeping (the host side
+    # of one engine/lm.py _step_spec chunk boundary): deterministic
+    # synthetic verified windows over a realistic row/draft geometry —
+    # per round, walk each live row's window for the accepted prefix
+    # (stop at the correction or EOS), tally accept counters and the
+    # divergence EMA. Pure numpy-indexed host arithmetic, no device.
+    B, K = 8, 8
+    S = K + 1
+    out_w = ((31 * np.arange(B)[:, None] + np.arange(S)[None, :])
+             % 257).astype(np.int32)
+    counted_w = np.ones((B, S), bool)
+    counted_w[:, -1] = False  # one EOS-ish tail slot per row
+    em_w = (np.arange(B) % S + 1).astype(np.int32)  # heterogeneous accepts
+
+    def one_spec_sample() -> float:
+        ema = None
+        t0 = time.perf_counter()
+        for _ in range(SPEC_ROUNDS):
+            proposed = K * B
+            accepted = 0
+            emitted = []
+            for i in range(B):
+                n = int(em_w[i])
+                accepted += max(0, n - 1)
+                for j in range(n):
+                    if not counted_w[i, j]:
+                        break
+                    emitted.append(int(out_w[i, j]))
+            rate = accepted / proposed
+            ema = rate if ema is None else 0.5 * ema + 0.5 * rate
+            assert emitted
+        return SPEC_ROUNDS / (time.perf_counter() - t0)
+
+    one_spec_sample()  # warm
+    stats.record(results, "obs_spec_bookkeeping_per_s",
+                 [one_spec_sample() for _ in range(REPEATS)], digits=0)
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -311,4 +361,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_dispatch_record_per_s_max']:.0f}]; journal record "
         f"{results['obs_journal_record_per_s']:.0f}/s "
         f"[{results['obs_journal_record_per_s_min']:.0f}–"
-        f"{results['obs_journal_record_per_s_max']:.0f}]")
+        f"{results['obs_journal_record_per_s_max']:.0f}]; spec bookkeeping "
+        f"{results['obs_spec_bookkeeping_per_s']:.0f}/s "
+        f"[{results['obs_spec_bookkeeping_per_s_min']:.0f}–"
+        f"{results['obs_spec_bookkeeping_per_s_max']:.0f}]")

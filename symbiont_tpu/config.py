@@ -212,6 +212,18 @@ class LmConfig:
     # decode chunk). Refcount-0 pages are retained and evicted LRU under
     # pool pressure. Only meaningful with kv_layout="paged".
     kv_radix: bool = True
+    # Speculative decoding (docs/SPECULATIVE.md): a small draft model
+    # proposes spec_k greedy tokens per round on its own dense KV, the
+    # target scores all k+1 positions in ONE verify dispatch, and the
+    # longest exact-match prefix plus the target's corrected token is
+    # emitted — greedy output is token-identical to plain decode by
+    # construction; sampled output rides the same journalled PRNG chain.
+    # spec_draft_model points at a local HF checkpoint dir for the
+    # drafter (tokenizer + vocab must match the target — validated at
+    # boot, jax-free, by validate_spec_draft below). None disables; a
+    # missing dir degrades to spec-disabled with one warning.
+    spec_draft_model: Optional[str] = None
+    spec_k: int = 8  # draft tokens proposed per verification round
     # online fine-tune over ingested text (train/online.py): the LM analog of
     # the Markov backend's continuous learning. Off by default — training
     # shares the device with serving.
@@ -255,6 +267,8 @@ class LmConfig:
                 raise ValueError("lm.kv_pool_pages must be >= 0 (0 = auto)")
         if self.gen_tenant_lane_depth < 0:
             raise ValueError("lm.gen_tenant_lane_depth must be >= 0")
+        if self.spec_k < 1:
+            raise ValueError(f"lm.spec_k must be >= 1, got {self.spec_k}")
         # the streaming decode loop runs whole chunks against a KV cache with
         # exactly new_bucket decode slots — a non-dividing chunk would scan
         # past the cache and rely on dynamic_update_slice clamp semantics
@@ -265,6 +279,56 @@ class LmConfig:
                 raise ValueError(
                     f"stream_chunk={self.stream_chunk} must divide every "
                     f"new_token_bucket larger than it; offending buckets: {bad}")
+
+
+def validate_spec_draft(target_dir: str, draft_dir: str) -> None:
+    """Boot-time drafter/target compatibility check (jax-free).
+
+    Speculative decoding only works when the draft and target models
+    speak the SAME token ids: the verify dispatch scores the drafter's
+    token ids directly against the target's logits. Enforced here so an
+    incompatible pair fails at engine init with a clear error instead of
+    emitting garbage mid-stream. Checks, from the HF checkpoint dirs:
+
+    - `config.json` vocab_size parity (hard requirement), and
+    - tokenizer parity by content fingerprint (`tokenizer.json`, else
+      `vocab.json`) when BOTH dirs carry one — same vocab_size with a
+      different id->string mapping is still wrong.
+
+    Raises ValueError on mismatch. Existence of draft_dir is the
+    CALLER's concern (engine init warns + disables on a missing dir).
+    """
+    import hashlib
+
+    def _vocab(d: str) -> int:
+        p = Path(d) / "config.json"
+        try:
+            return int(json.loads(p.read_text()).get("vocab_size", -1))
+        except (OSError, ValueError) as e:
+            raise ValueError(f"spec_draft_model compat: cannot read {p}: {e}")
+
+    tv, dv = _vocab(target_dir), _vocab(draft_dir)
+    if tv != dv:
+        raise ValueError(
+            f"spec_draft_model vocab mismatch: target {target_dir!r} has "
+            f"vocab_size={tv} but draft {draft_dir!r} has vocab_size={dv} "
+            f"— speculative verification compares token ids directly, so "
+            f"drafter and target must share one tokenizer/vocab")
+
+    def _tok_fp(d: str) -> Optional[str]:
+        for name in ("tokenizer.json", "vocab.json"):
+            p = Path(d) / name
+            if p.is_file():
+                return name + ":" + hashlib.sha256(p.read_bytes()).hexdigest()
+        return None
+
+    tf, df = _tok_fp(target_dir), _tok_fp(draft_dir)
+    if tf is not None and df is not None and tf != df:
+        raise ValueError(
+            f"spec_draft_model tokenizer mismatch: target {target_dir!r} "
+            f"and draft {draft_dir!r} carry different tokenizer files "
+            f"({tf.split(':')[0]} fingerprints differ) — draft token ids "
+            f"would not mean the same strings under the target")
 
 
 @dataclass

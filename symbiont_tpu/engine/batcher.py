@@ -812,7 +812,10 @@ class GenBatcher(_BatcherBase):
             # reserve up to 8 chunks for the compile, but never so much that
             # admission becomes impossible in principle — cap at half the
             # session's remaining chunks
-            margin = min(8, max(1, sess.remaining_steps() // (2 * sess.chunk)))
+            # round_slots: a speculative round burns spec_k+1 slots, so the
+            # compile reserve is counted in the session's ACTUAL round size
+            margin = min(8, max(1, sess.remaining_steps()
+                                // (2 * sess.round_slots())))
         take: List = []
         retry: List = []   # transient rejection: no free row RIGHT NOW
         defer: List = []   # permanent for this session: budget/prompt

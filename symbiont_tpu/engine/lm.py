@@ -154,7 +154,7 @@ class LmEngine:
 
     def __init__(self, config: Optional[LmConfig] = None, params=None,
                  model_cfg: Optional[GPTConfig] = None, tokenizer=None,
-                 mesh=None):
+                 mesh=None, draft_params=None, draft_model_cfg=None):
         import dataclasses
 
         import jax
@@ -290,7 +290,74 @@ class LmEngine:
                      n_pages, cfg.kv_page_tokens,
                      self.pool.device_bytes / (1 << 20),
                      ", radix on" if self.radix is not None else "")
+        # speculative-decoding draft plane (docs/SPECULATIVE.md, ROADMAP
+        # item 1): a small second model proposes spec_k greedy tokens per
+        # round on its own dense cache and the target scores all k+1
+        # positions in ONE verify_chunk dispatch. The drafter stays dense
+        # and unquantized whatever the target's kv layout/quant —
+        # acceptance reads only the PROPOSED token ids, so target-side
+        # paging/int8 cannot break token identity (greedy spec-on ==
+        # plain decode by construction; tests/test_spec_decode.py).
+        self._draft = None
+        self.spec_k = int(cfg.spec_k)
+        self._spec_proposed = 0   # draft tokens offered to verify_chunk
+        self._spec_accepted = 0   # ... of which the target accepted
+        if draft_params is not None or draft_model_cfg is not None:
+            if draft_params is None or draft_model_cfg is None:
+                raise ValueError(
+                    "draft_params and draft_model_cfg must be passed together")
+            self._adopt_draft(draft_params, draft_model_cfg)
+        elif cfg.spec_draft_model:
+            from pathlib import Path
+
+            if not Path(cfg.spec_draft_model).is_dir():
+                # degrade, don't crash: a missing drafter only costs speed
+                log.warning(
+                    "spec_draft_model %r not found — speculative decoding "
+                    "disabled, plain decode unaffected", cfg.spec_draft_model)
+            else:
+                from symbiont_tpu.models.convert import load_gpt_model as _lg
+
+                if cfg.model_dir:
+                    # jax-free fail-fast: tokenizer fingerprint + vocab
+                    # parity straight from checkpoint metadata, before any
+                    # weight load (config.validate_spec_draft)
+                    from symbiont_tpu.config import validate_spec_draft
+
+                    validate_spec_draft(cfg.model_dir, cfg.spec_draft_model)
+                d_params, d_cfg = _lg(cfg.spec_draft_model)
+                self._adopt_draft(d_params, d_cfg)
         self._register_gauges()
+
+    def _adopt_draft(self, d_params, d_cfg) -> None:
+        """Validate + place the drafter. Vocab parity is the one hard
+        compatibility requirement (token ids must mean the same thing to
+        both models); attention impl follows the target's resolved choice
+        so both planes trace under one policy. Plain device_put — no TP
+        shard (the drafter is small by construction) and no quantization
+        (its cache is a rounding error next to the target's, and its
+        proposals only need to be cheap, not byte-stable across layouts)."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        if d_cfg.vocab_size != self.model_cfg.vocab_size:
+            raise ValueError(
+                f"spec draft vocab_size {d_cfg.vocab_size} != target "
+                f"{self.model_cfg.vocab_size}: drafter and target must "
+                "share a tokenizer")
+        if d_cfg.attn_impl != self.model_cfg.attn_impl:
+            d_cfg = dataclasses.replace(
+                d_cfg, attn_impl=self.model_cfg.attn_impl)
+        dtype = jnp.dtype(d_cfg.dtype)
+        d_params = jax.tree.map(
+            lambda a: a.astype(dtype)
+            if (hasattr(a, "dtype")
+                and jnp.issubdtype(a.dtype, jnp.floating)) else a, d_params)
+        self._draft = (jax.device_put(d_params), d_cfg)
+        log.info("speculative decoding on: drafter %d layers x %d hidden, "
+                 "k=%d", d_cfg.num_layers, d_cfg.hidden_size, self.spec_k)
 
     def _auto_pool_pages(self) -> int:
         """kv_pool_pages=0 sizing: the dense-equivalent capacity of ONE
@@ -427,6 +494,16 @@ class LmEngine:
             metrics.register_weakref_gauge("kv.page_fragmentation_pct",
                                            self, page_fragmentation,
                                            labels=labels)
+        if self._draft is not None:
+            def spec_accept(lm):
+                # cumulative draft-acceptance rate across every spec round
+                # this engine ran (stream + batch planes) — THE knob-tuning
+                # signal for spec_k / drafter choice (docs/SPECULATIVE.md)
+                p = lm._spec_proposed
+                return round(lm._spec_accepted / p, 4) if p else 0.0
+
+            metrics.register_weakref_gauge("lm.spec_accept_rate", self,
+                                           spec_accept, labels=labels)
 
     def _note_param_bytes(self, params, storage) -> None:
         """Dtype-labeled at-rest parameter bytes (docs/OBSERVABILITY.md) —
@@ -655,6 +732,17 @@ class LmEngine:
         journaling = jr is not None and jr.enabled and bool(task_id)
         sampled = float(temperature) > 0.0
 
+        # speculative decoding (docs/SPECULATIVE.md): with a drafter
+        # attached, the loop below runs draft+verify rounds instead of
+        # plain chunks while the decode-slot margin allows a worst-case
+        # round PLUS a plain finish — spec can only waste SLOTS (rejected
+        # draft positions become kv_valid holes), never truncate output.
+        # The bucket request gets spec_k headroom so typical requests keep
+        # that margin; spec-off requests are byte-identical to before.
+        spec_on = self._draft is not None
+        spec_cap = spec_on  # capability at stream start; spec_on may fall back
+        headroom = self.spec_k if spec_on else 0
+
         all_tokens: list = []
         seq = 0
         chunk_start = 0
@@ -667,15 +755,33 @@ class LmEngine:
             my_prompt_ids = [int(t) for t in resume["prompt_ids"]]
             # re-prefill the EXACT journaled prefix (prompt + generated so
             # far) — no re-tokenization, so byte-level/BPE boundary effects
-            # can't shift the prefix the dead worker actually decoded
-            remaining = max(1, max_new_tokens - len(all_tokens))
+            # can't shift the prefix the dead worker actually decoded. A
+            # snapshot taken in SPEC state journalled its LAST token as the
+            # un-ingested `pending` — it was NOT in the dead worker's cache,
+            # so it stays out of the re-prefill too (and its would-be cache
+            # slot reserves one decode slot: the +cut below).
+            cut = 1 if (spec_on and resume.get("spec")
+                        and all_tokens) else 0
+            body = all_tokens[:len(all_tokens) - cut] if cut else all_tokens
+            remaining = max(1, max_new_tokens - len(all_tokens) + cut)
+            # Exact-replay slot restore: the spec/plain mode decision and the
+            # plain-chunk clamp below are functions of the remaining-slot
+            # margin (new_bucket - slots_used), and jax.random.split(key, n)
+            # is NOT prefix-stable across n — so a sampled resume must
+            # reproduce the dead worker's margin EXACTLY, not approximately.
+            # The journalled margin fits a bucket (the original bucket held
+            # it), so a big-enough bucket always exists.
+            spec_slots = resume.get("spec_slots") if spec_on else None
+            want_slots = (max(remaining, int(spec_slots))
+                          if spec_slots is not None else remaining + headroom)
             prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
-                [""], remaining, encoded=[my_prompt_ids + all_tokens])
+                [""], want_slots, encoded=[my_prompt_ids + body])
             max_new_tokens = min(max_new_tokens,
-                                 len(all_tokens) + new_bucket)
+                                 len(all_tokens) + new_bucket - cut)
         else:
+            cut = 0
             prompt_ids, prompt_mask, new_bucket = self._prepare_prompts(
-                [prompt], max_new_tokens)
+                [prompt], max_new_tokens + headroom)
             # largest bucket caps the request (same clamp generate() applies
             # via its scan length) — the cache has new_bucket decode slots
             max_new_tokens = min(max_new_tokens, new_bucket)
@@ -724,12 +830,45 @@ class LmEngine:
                 self.model_cfg, new_bucket)
             dt = time.perf_counter() - t0
             decode_s += dt
+            dt_dp = 0.0
+            if spec_on:
+                # drafter plane: its own small DENSE cache at the same
+                # (prompt, new) geometry — slot-symmetric with the target's,
+                # so the two share one kv_valid/pos/done (models/gpt.py
+                # spec state contract)
+                t_dp = time.perf_counter()
+                draft_params, dcfg = self._draft
+                d_cache = gpt_mod.prefill(
+                    draft_params, jnp.asarray(prompt_ids),
+                    jnp.asarray(prompt_mask), dcfg, new_bucket)[0]
+                dt_dp = time.perf_counter() - t_dp
+                decode_s += dt_dp
         dispatch_ledger.note_dispatch(
             f"lm.prefill[P={prompt_ids.shape[1]},B={prompt_ids.shape[0]},"
             f"new={new_bucket}]", dt)
+        if spec_on:
+            dispatch_ledger.note_dispatch(
+                f"lm.draft_prefill[P={prompt_ids.shape[1]},"
+                f"B={prompt_ids.shape[0]},new={new_bucket}]", dt_dp)
         done = jnp.zeros((prompt_ids.shape[0],), bool)
         pos = prompt_len
         stop = False
+        # spec state: `pending` is the last emitted token, kept OUT of both
+        # caches until the next round writes it (or ingest_pending folds it
+        # in on fallback). slots_used counts decode slots consumed — in spec
+        # state that runs AHEAD of emitted tokens by the rejected holes.
+        pending = None
+        slots_used = 0
+        if (resume is not None and spec_on
+                and resume.get("spec_slots") is not None):
+            # restore the dead worker's slot accounting so every subsequent
+            # margin/clamp decision (and thus PRNG key consumption) replays
+            # exactly; new_bucket >= spec_slots by the request above
+            slots_used = max(0, new_bucket - int(resume["spec_slots"]))
+        if spec_on and cut:
+            # spec-state resume: the journalled tail's last token IS the
+            # pending — restore it host→device and skip spec_first
+            pending = jnp.asarray([all_tokens[-1]], jnp.int32)
 
         def _snapshot(text_before: str) -> dict:
             return {"task_id": task_id, "tenant": tenant, "stream": stream,
@@ -738,7 +877,16 @@ class LmEngine:
                     "temperature": float(temperature), "top_k": int(top_k),
                     "tokens": list(all_tokens), "chunk_start": chunk_start,
                     "text": text_before, "seq": seq,
-                    "key": key_base, "key_splits": n_splits}
+                    "key": key_base, "key_splits": n_splits,
+                    # spec state marker: tokens[-1] is the un-ingested
+                    # pending (not in the cache) — a resume must reserve
+                    # its slot and skip spec_first (docs/SPECULATIVE.md)
+                    "spec": bool(spec_on and pending is not None
+                                 and not stop),
+                    # remaining-slot margin: a resume replays mode/clamp
+                    # decisions from this, so sampled key chains line up
+                    "spec_slots": (new_bucket - slots_used) if spec_cap
+                                  else None}
 
         try:
             if resume is not None:
@@ -767,32 +915,124 @@ class LmEngine:
             while len(all_tokens) < max_new_tokens and not stop:
                 sub, use = jax.random.split(sub)
                 n_splits += 1
-                keys = jax.random.split(use, chunk)
-                with self._lock:
-                    t1 = time.perf_counter()
-                    (cache, logits, pos, done, toks,
-                     counted) = gpt_mod.decode_chunk(
-                        self.params, cache, logits, pos, done, kv_valid, keys,
-                        self.model_cfg, temperature=float(temperature),
-                        top_k=int(top_k), eos_id=int(eos_id))
-                    toks = np.asarray(toks)[0]
-                    counted = np.asarray(counted)[0]
-                    dt1 = time.perf_counter() - t1
-                    decode_s += dt1
-                dispatch_ledger.note_dispatch(
-                    f"lm.decode_chunk[P={prompt_ids.shape[1]},B=1,"
-                    f"chunk={chunk}]", dt1)
-                # the chunk-boundary toks/counted materialization above is
-                # the stream's one allowlisted device->host sync
-                dispatch_ledger.note_host_sync("LmEngine.generate_stream")
-                chunk_start = len(all_tokens)
-                for t, c in zip(toks, counted):
-                    if not c:  # EOS (or a post-EOS slot): stream ends here
-                        stop = True
+                S = self.spec_k + 1
+                if spec_on and (new_bucket - slots_used
+                                < S + (max_new_tokens - len(all_tokens))
+                                - (1 if pending is None else 0)):
+                    # not enough decode slots for a worst-case round (one
+                    # accepted token, S slots burned) PLUS a plain finish:
+                    # leave speculation FOR GOOD (B=1 — the margin only
+                    # shrinks) after folding pending back into the cache
+                    if pending is not None:
+                        with self._lock:
+                            t1 = time.perf_counter()
+                            cache, logits, pos = gpt_mod.ingest_pending(
+                                self.params, cache, pending, pos, done,
+                                kv_valid, self.model_cfg)
+                            dt1 = time.perf_counter() - t1
+                            decode_s += dt1
+                        dispatch_ledger.note_dispatch(
+                            "lm.ingest_pending[B=1]", dt1)
+                        slots_used += 1
+                        pending = None
+                    spec_on = False
+                if spec_on:
+                    first = None
+                    with self._lock:
+                        t1 = time.perf_counter()
+                        if pending is None:
+                            # plain → spec: the first token comes off the
+                            # carried logits — exactly what the next plain
+                            # step would sample. Device refs only; the ONE
+                            # host materialization for the whole round is
+                            # below, at the same chunk-boundary sync plain
+                            # decode already pays.
+                            use, k0 = jax.random.split(use)
+                            pending, c0, done = gpt_mod.spec_first(
+                                logits, done, k0, self.model_cfg,
+                                temperature=float(temperature),
+                                top_k=int(top_k), eos_id=int(eos_id))
+                            first = (pending, c0)
+                        t_d = time.perf_counter()
+                        d_cache, drafts = gpt_mod.draft_chunk(
+                            draft_params, d_cache, pending, pos, done,
+                            kv_valid, dcfg, self.spec_k)
+                        t_v = time.perf_counter()
+                        (cache, pending, pos, done, kv_valid, out, counted,
+                         emitted) = gpt_mod.verify_chunk(
+                            self.params, cache, pending, drafts, pos, done,
+                            kv_valid, use, self.model_cfg,
+                            temperature=float(temperature),
+                            top_k=int(top_k), eos_id=int(eos_id))
+                        out = np.asarray(out)[0]
+                        counted = np.asarray(counted)[0]
+                        n_emit = int(np.asarray(emitted)[0])
+                        f_tok = f_cnt = None
+                        if first is not None:
+                            f_tok = int(np.asarray(first[0])[0])
+                            f_cnt = bool(np.asarray(first[1])[0])
+                        t_end = time.perf_counter()
+                        decode_s += t_end - t1
+                    dispatch_ledger.note_dispatch(
+                        f"lm.draft_chunk[P={prompt_ids.shape[1]},B=1,"
+                        f"k={self.spec_k}]", t_v - t_d)
+                    dispatch_ledger.note_dispatch(
+                        f"lm.verify_chunk[P={prompt_ids.shape[1]},B=1,"
+                        f"k={self.spec_k}]", t_end - t_v)
+                    if first is not None:
+                        dispatch_ledger.note_dispatch(
+                            "lm.spec_first[B=1]", t_d - t1)
+                    # the round's out/counted/emitted materialization above
+                    # is the stream's one allowlisted device->host sync
+                    dispatch_ledger.note_host_sync("LmEngine.generate_stream")
+                    slots_used += S
+                    self._spec_proposed += self.spec_k
+                    self._spec_accepted += max(0, n_emit - 1)
+                    chunk_start = len(all_tokens)
+                    emit_pairs = [] if first is None else [(f_tok, f_cnt)]
+                    emit_pairs += list(zip(out[:n_emit].tolist(),
+                                           counted[:n_emit].tolist()))
+                    for t, c in emit_pairs:
+                        if not c:  # EOS: stream ends here, exactly as plain
+                            stop = True
+                            break
+                        all_tokens.append(int(t))
+                        if len(all_tokens) >= max_new_tokens:
+                            break
+                else:
+                    c_n = min(chunk, new_bucket - slots_used)
+                    if c_n <= 0:
+                        # slot accounting exhausted — unreachable while the
+                        # margin invariant holds; fuse against a wedged loop
                         break
-                    all_tokens.append(int(t))
-                    if len(all_tokens) >= max_new_tokens:
-                        break
+                    keys = jax.random.split(use, c_n)
+                    with self._lock:
+                        t1 = time.perf_counter()
+                        (cache, logits, pos, done, toks,
+                         counted) = gpt_mod.decode_chunk(
+                            self.params, cache, logits, pos, done, kv_valid,
+                            keys, self.model_cfg,
+                            temperature=float(temperature),
+                            top_k=int(top_k), eos_id=int(eos_id))
+                        toks = np.asarray(toks)[0]
+                        counted = np.asarray(counted)[0]
+                        dt1 = time.perf_counter() - t1
+                        decode_s += dt1
+                    dispatch_ledger.note_dispatch(
+                        f"lm.decode_chunk[P={prompt_ids.shape[1]},B=1,"
+                        f"chunk={c_n}]", dt1)
+                    # the chunk-boundary toks/counted materialization above
+                    # is the stream's one allowlisted device->host sync
+                    dispatch_ledger.note_host_sync("LmEngine.generate_stream")
+                    slots_used += c_n
+                    chunk_start = len(all_tokens)
+                    for t, c in zip(toks, counted):
+                        if not c:  # EOS (or post-EOS slot): stream ends here
+                            stop = True
+                            break
+                        all_tokens.append(int(t))
+                        if len(all_tokens) >= max_new_tokens:
+                            break
                 # journal BEFORE yield (host values already in hand): the
                 # snapshot's replay re-emits this chunk at this seq, so a
                 # kill in the yield window duplicates (hub-deduped), never
@@ -1046,8 +1286,15 @@ class BatchSession:
         n = len(prompts)
         if n != len(max_new_tokens):
             raise ValueError("prompts and max_new_tokens length mismatch")
+        # speculative decoding (docs/SPECULATIVE.md): with a drafter on the
+        # engine, ask for spec_k slots of bucket headroom — spec rounds may
+        # burn up to spec_k+1 slots to emit one token (rejected drafts), and
+        # the margin guard only lets rounds run while a worst-case round
+        # plus a plain finish still fits. Spec-off sessions are unchanged.
+        spec_headroom = lm.spec_k if lm._draft is not None else 0
         prompt_ids, prompt_mask, self.new_bucket = lm._prepare_prompts(
-            prompts, max(max_new_tokens), min_rows=cfg.session_min_rows)
+            prompts, max(max_new_tokens) + spec_headroom,
+            min_rows=cfg.session_min_rows)
         self.bb, self.P = prompt_ids.shape
         self.chunk = max(1, min(cfg.stream_chunk, self.new_bucket))
         self._temps = lm._norm_sampling_rows(temperature, cfg.temperature,
@@ -1193,6 +1440,34 @@ class BatchSession:
                         self.P, int(pads[i]), ids_r_host[i],
                         [int(p) for p in self._pt[i, :self._prompt_blocks]],
                         logits_host[i])
+        # drafter plane: a dense prefill at the same (prompt, new) geometry
+        # — even for radix-hit sessions (the drafter has no radix; its
+        # prefill is cheap by construction). Any failure degrades to plain
+        # decode: speculation is a speed feature, never a correctness
+        # dependency.
+        self._d_cache = None
+        self._pending = None   # [bb] device array; set ⇔ spec state
+        self._spec_on = lm._draft is not None
+        self._spec_rounds = 0
+        self._spec_ema = None  # EMA of per-round acceptance, fallback gate
+        if self._spec_on:
+            try:
+                draft_params, dcfg = lm._draft
+                with lm._lock:
+                    t_dp = time.perf_counter()
+                    self._d_cache = gpt_mod.prefill(
+                        draft_params, jnp.asarray(prompt_ids),
+                        jnp.asarray(prompt_mask), dcfg, self.new_bucket)[0]
+                    dp_s = time.perf_counter() - t_dp
+                    self.decode_s += dp_s
+                dispatch_ledger.note_dispatch(
+                    f"lm.draft_prefill[P={self.P},B={self.bb},"
+                    f"new={self.new_bucket}]", dp_s)
+            except Exception:
+                log.warning("draft prefill failed — session decodes plain",
+                            exc_info=True)
+                self._spec_on = False
+                self._d_cache = None
         engine_timeline.note_admit(
             rows=n, prefill_ms=prefill_s * 1000.0, prefix_share=share,
             kind="start",
@@ -1307,6 +1582,14 @@ class BatchSession:
     def remaining_steps(self) -> int:
         return self.new_bucket - self.steps_done
 
+    def round_slots(self) -> int:
+        """Decode slots the next step() may consume — the admission
+        lookahead unit. A spec round burns spec_k+1 slots (accepted or
+        not); plain chunks burn `chunk`."""
+        if self._spec_on:
+            return max(self.chunk, self.lm.spec_k + 1)
+        return self.chunk
+
     def done(self) -> bool:
         return all(r is None for r in self.rows) or self.remaining_steps() <= 0
 
@@ -1319,9 +1602,12 @@ class BatchSession:
         `lookahead_chunks` reserves budget for chunks that will decode
         between this check and the actual splice (the prepare/splice split
         runs the newcomer's prefill concurrently with one in-flight chunk)."""
+        # spec debt: splicing while a pending token is riding host-side
+        # costs one ingest slot (_to_plain) before the merge can happen
+        debt = 1 if self._pending is not None else 0
         if (self.capacity() == 0
-                or int(max_new) > self.remaining_steps()
-                - lookahead_chunks * self.chunk):
+                or int(max_new) > self.remaining_steps() - debt
+                - lookahead_chunks * self.round_slots()):
             return False
         if len(self.lm.tokenizer.encode(prompt or "", self.P + 1)) > self.P:
             return False
@@ -1423,7 +1709,22 @@ class BatchSession:
             dispatch_ledger.note_dispatch(
                 f"lm.prefill[P={self.P},B={bb2},new={self.new_bucket}]",
                 time.perf_counter() - t0)
+        d_cache_b = None
+        if self._spec_on and self._d_cache is not None:
+            # drafter rows for the newcomers (merge_cache_rows splices them
+            # at the same chunk boundary as the target merge). Runs even on
+            # a full radix hit — the drafter has no radix. Same lock-free
+            # contract as the target prefill above.
+            draft_params, dcfg = self.lm._draft
+            t_dd = time.perf_counter()
+            d_cache_b = gpt_mod.prefill(
+                draft_params, jnp.asarray(ids), jnp.asarray(mask),
+                dcfg, self.new_bucket)[0]
+            dispatch_ledger.note_dispatch(
+                f"lm.draft_prefill[P={self.P},B={bb2},"
+                f"new={self.new_bucket}]", time.perf_counter() - t_dd)
         return {"k": k, "bb2": bb2, "cache": cache_b, "logits": logits_b,
+                "d_cache": d_cache_b,
                 "kv_valid": kv_valid_b, "pos": pos_b, "paged": paged_prep,
                 "max_new": [int(w) for w in max_new_tokens],
                 "temps": self.lm._norm_sampling_rows(
@@ -1459,6 +1760,11 @@ class BatchSession:
 
         import jax.numpy as jnp
 
+        if prep["k"] and self._pending is not None:
+            # splice merges PLAIN state (newcomer rows carry no pending
+            # token): fold ours into both caches first — one slot — and let
+            # the next step re-enter speculation over the merged batch
+            self._to_plain()
         pg = prep.get("paged")
         pool = self.lm.pool
         free = [i for i, r in enumerate(self.rows) if r is None]
@@ -1586,6 +1892,25 @@ class BatchSession:
                 dispatch_ledger.note_dispatch(
                     f"lm.merge_rows[P={self.P},B={self.bb}]",
                     time.perf_counter() - t_mr)
+            if self._d_cache is not None:
+                if prep.get("d_cache") is not None:
+                    # drafter-side row splice: same row_map, field-wise pick
+                    # (gap validity rides the SHARED kv_valid merge_rows
+                    # just masked — models/gpt.py merge_cache_rows)
+                    t_dm = time.perf_counter()
+                    self._d_cache = gpt_mod.merge_cache_rows(
+                        self._d_cache, prep["d_cache"],
+                        jnp.asarray(row_map))
+                    dispatch_ledger.note_dispatch(
+                        f"lm.draft_merge_rows[P={self.P},B={self.bb}]",
+                        time.perf_counter() - t_dm)
+                else:
+                    # an admission prepared without drafter rows (prepared
+                    # before the drafter failed, or its draft prefill was
+                    # skipped): speculating over rows with no drafter
+                    # content would propose garbage — decode plain instead
+                    self._spec_on = False
+                    self._d_cache = None
             self.decode_s += time.perf_counter() - t0 + prep["prefill_s"]
             self.lm.stats["admitted"] = (self.lm.stats.get("admitted", 0)
                                          + taken)
@@ -1662,12 +1987,214 @@ class BatchSession:
     # --------------------------------------------------------------- decode
 
     def step(self) -> list:
-        """Decode one chunk; returns [(tag, text), ...] for every request
-        that finished in it (eos, its own budget, or the session cap)."""
-        import jax
-
+        """Decode one chunk — or one speculative draft+verify round when a
+        drafter is attached and the slot margin allows it; returns
+        [(tag, text), ...] for every request that finished in it (eos, its
+        own budget, or the session cap). The spec/plain choice is re-made
+        every chunk boundary, so a session degrades AND re-enters
+        speculation as margins, splices, and drafter quality dictate."""
         if self.done():
             return self._drain_all()
+        if (self._spec_on and self._d_cache is not None
+                and self._spec_margin_ok()):
+            return self._step_spec()
+        if self._pending is not None:
+            self._to_plain()
+            if self.done():  # the ingest slot was the session's last one
+                return self._drain_all()
+        return self._step_plain()
+
+    def _spec_margin_ok(self) -> bool:
+        """Slot-margin guard: a spec round may only run while the WORST
+        case (one emitted token for S=spec_k+1 slots burned) still leaves
+        room to finish every live row's budget with plain decode — so
+        speculation can waste slots, never truncate a row."""
+        S = self.lm.spec_k + 1
+        r_max = max((r.want - len(r.tokens)
+                     for r in self.rows if r is not None), default=0)
+        return (self.remaining_steps()
+                >= S + r_max - (1 if self._pending is None else 0))
+
+    def _to_plain(self) -> None:
+        """spec → plain at a chunk boundary: forward `pending` into BOTH
+        caches (one slot each, one fused dispatch per plane) and recover
+        carried logits, after which decode_chunk / merge_rows apply
+        unchanged. Greedy output is token-identical across the mode switch
+        (gpt.ingest_pending computes exactly the logits a plain step at
+        that position would have carried)."""
+        if self._pending is None:
+            return
+        lm = self.lm
+        if self._paged:
+            self._ensure_decode_blocks(1)
+        with lm._lock:
+            t0 = time.perf_counter()
+            cache_in = self._build_cache() if self._paged else self._cache
+            cache_out, self._logits, self._pos = gpt_mod.ingest_pending(
+                lm.params, cache_in, self._pending, self._pos, self._done,
+                self._kv_valid, lm.model_cfg)
+            if self._paged:
+                lm.pool.adopt_arrays(cache_out.k, cache_out.v,
+                                     cache_out.k_scale, cache_out.v_scale)
+                self._pt_dev = cache_out.page_table
+            else:
+                self._cache = cache_out
+            if self._d_cache is not None:
+                # drafter lockstep: the same token lands in the drafter's
+                # matching slot so speculation can re-enter later
+                draft_params, dcfg = lm._draft
+                self._d_cache = gpt_mod.track_chunk(
+                    draft_params, self._d_cache, self._pending[:, None],
+                    self._pos - 1, self._kv_valid, dcfg)
+            dt = time.perf_counter() - t0
+            self.decode_s += dt
+            self._last_step_end = time.perf_counter()
+        dispatch_ledger.note_dispatch(f"lm.ingest_pending[B={self.bb}]", dt)
+        self._pending = None
+        self.steps_done += 1
+
+    def _step_spec(self) -> list:
+        """One speculative round: the drafter proposes spec_k greedy tokens
+        (its own chunk-scan dispatch), the target scores all k+1 window
+        positions in ONE verify_chunk dispatch, and each row advances by
+        its own accepted count — the per-row variable advance every piece
+        of chunk-boundary bookkeeping below is keyed on. Rejected draft
+        slots become kv_valid holes (never rewritten); drafter divergence
+        and page-pool pressure both degrade to plain decode, never error."""
+        import jax
+
+        lm = self.lm
+        S = lm.spec_k + 1
+        if self._paged:
+            try:
+                self._ensure_decode_blocks(S)
+            except Exception:
+                # spec-window page pressure (PoolExhausted): degrade FOR
+                # GOOD — speculation must never turn pool pressure into a
+                # caller-visible error
+                log.warning("page alloc for spec window failed — session "
+                            "falls back to plain decode", exc_info=True)
+                self._spec_on = False
+                return self.step()
+        draft_params, dcfg = lm._draft
+        first_t = first_c = None
+        with lm._lock:
+            t0 = time.perf_counter()
+            host_gap_s = max(0.0, t0 - self._last_step_end)
+            self._sub, use = jax.random.split(self._sub)
+            if self._pending is None:
+                # plain → spec: the first token comes off the carried
+                # logits — exactly what the next plain step would sample
+                use, k0 = jax.random.split(use)
+                self._pending, c0, self._done = gpt_mod.spec_first(
+                    self._logits, self._done, k0, lm.model_cfg,
+                    temperature=self._temps, top_k=self._ks,
+                    eos_id=self._eos)
+                first = (self._pending, c0)
+            else:
+                first = None
+            t_d = time.perf_counter()
+            self._d_cache, drafts = gpt_mod.draft_chunk(
+                draft_params, self._d_cache, self._pending, self._pos,
+                self._done, self._kv_valid, dcfg, lm.spec_k)
+            # the draft/verify ms split the timeline archives: one device
+            # wait (no host transfer), at a boundary that syncs anyway
+            jax.block_until_ready(drafts)
+            t_v = time.perf_counter()
+            cache_in = self._build_cache() if self._paged else self._cache
+            (cache_out, self._pending, self._pos, self._done,
+             self._kv_valid, out, counted, emitted) = gpt_mod.verify_chunk(
+                lm.params, cache_in, self._pending, drafts, self._pos,
+                self._done, self._kv_valid, use, lm.model_cfg,
+                temperature=self._temps, top_k=self._ks, eos_id=self._eos)
+            if self._paged:
+                lm.pool.adopt_arrays(cache_out.k, cache_out.v,
+                                     cache_out.k_scale, cache_out.v_scale)
+                self._pt_dev = cache_out.page_table
+            else:
+                self._cache = cache_out
+            out = np.asarray(out)
+            counted = np.asarray(counted)
+            em = np.asarray(emitted)
+            if first is not None:
+                first_t = np.asarray(first[0])
+                first_c = np.asarray(first[1])
+            t_end = time.perf_counter()
+            step_s = t_end - t0
+            draft_s = t_v - t_d
+            verify_s = t_end - t_v
+            self.decode_s += step_s
+            self._last_step_end = time.perf_counter()
+        dispatch_ledger.note_dispatch(
+            f"lm.draft_chunk[P={self.P},B={self.bb},k={lm.spec_k}]", draft_s)
+        dispatch_ledger.note_dispatch(
+            f"lm.verify_chunk[P={self.P},B={self.bb},k={lm.spec_k}]",
+            verify_s)
+        if first_t is not None:
+            dispatch_ledger.note_dispatch(
+                f"lm.spec_first[B={self.bb}]", t_d - t0)
+        self.steps_done += S
+        live_rows = [r for r in self.rows if r is not None]
+        live_idx = [i for i, r in enumerate(self.rows) if r is not None]
+        n_live = max(1, len(live_rows))
+        proposed = lm.spec_k * len(live_rows)
+        accepted = sum(max(0, int(em[i]) - 1) for i in live_idx)
+        emitted_total = (sum(int(em[i]) for i in live_idx)
+                         + (len(live_rows) if first_t is not None else 0))
+        lm._spec_proposed += proposed
+        lm._spec_accepted += accepted
+        kv_live, kv_alloc = lm.kv_row_counts()
+        pool = lm.pool
+        engine_timeline.note_decode_step(
+            wall_ms=step_s * 1000.0, rows_live=len(live_rows),
+            rows_capacity=self.bb, kv_rows_live=kv_live,
+            kv_rows_allocated=kv_alloc,
+            steps=emitted_total / n_live,
+            pages_free=pool.pages_free if self._paged else None,
+            pages_live=pool.pages_live if self._paged else None,
+            pages_total=pool.n_pages - 1 if self._paged else None,
+            dispatches=2 + (1 if first_t is not None else 0),
+            host_gap_ms=host_gap_s * 1000.0,
+            spec_draft_ms=draft_s * 1000.0,
+            spec_verify_ms=verify_s * 1000.0,
+            spec_proposed=proposed, spec_accepted=accepted)
+        mean_emitted = emitted_total / n_live
+        if mean_emitted > 0:
+            metrics.observe("lm.tpot_ms", step_s * 1000.0 / mean_emitted,
+                            labels={"service": "lm"})
+        by_tenant: dict = {}
+        for row in live_rows:
+            by_tenant[row.tenant] = by_tenant.get(row.tenant, 0) + 1
+        for tenant, n_rows in by_tenant.items():
+            usage.note(tenant, kv_row_seconds=step_s * n_rows)
+        # drafter-divergence fallback: an EMA of per-round acceptance that
+        # stays near zero means rounds burn S slots to emit ~1 token —
+        # strictly worse than plain decode. Off for good, this session.
+        rate = accepted / proposed if proposed else 0.0
+        self._spec_rounds += 1
+        self._spec_ema = (rate if self._spec_ema is None
+                          else 0.5 * self._spec_ema + 0.5 * rate)
+        if self._spec_rounds >= 3 and self._spec_ema < 0.1:
+            log.info("spec accept EMA %.2f after %d rounds — session "
+                     "falls back to plain decode", self._spec_ema,
+                     self._spec_rounds)
+            self._spec_on = False
+
+        def pairs(i):
+            if first_t is not None:
+                yield first_t[i], first_c[i]
+            for j in range(int(em[i])):
+                yield out[i, j], counted[i, j]
+
+        return self._emit_and_finish(pairs)
+
+    def _step_plain(self) -> list:
+        """Plain chunk decode (the spec-off path, byte-identical to the
+        pre-spec engine); with a live drafter the chunk's tokens are also
+        teacher-forced into the drafter cache (ONE extra small dispatch)
+        so speculation can re-enter at a later boundary."""
+        import jax
+
         chunk = min(self.chunk, self.remaining_steps())
         if self._paged:
             # lazy page growth happens at the chunk boundary, off the
@@ -1696,6 +2223,16 @@ class BatchSession:
                 self._pt_dev = cache_out.page_table
             else:
                 self._cache = cache_out
+            if self._spec_on and self._d_cache is not None:
+                # drafter lockstep: teacher-force the chunk's tokens into
+                # the drafter cache (decode_chunk's returned toks are
+                # exactly what it wrote — done-row zeros included), so
+                # speculation can re-enter at a later boundary. pos was
+                # donated through decode_chunk; start = new pos - chunk.
+                draft_params, dcfg = self.lm._draft
+                self._d_cache = gpt_mod.track_chunk(
+                    draft_params, self._d_cache, toks,
+                    self._pos - chunk, self._kv_valid, dcfg)
             toks = np.asarray(toks)
             counted = np.asarray(counted)
             step_s = time.perf_counter() - t0
@@ -1728,6 +2265,15 @@ class BatchSession:
             by_tenant[row.tenant] = by_tenant.get(row.tenant, 0) + 1
         for tenant, n_rows in by_tenant.items():
             usage.note(tenant, kv_row_seconds=step_s * n_rows)
+        return self._emit_and_finish(lambda i: zip(toks[i], counted[i]))
+
+    def _emit_and_finish(self, pairs) -> list:
+        """Per-row chunk-boundary bookkeeping shared by the plain and spec
+        paths — journal snapshot, TTFT, finish detection — over host
+        values already materialized (`pairs(i)` iterates row i's
+        (token, counted) run for this boundary; under speculation rows
+        yield DIFFERENT run lengths, which is the per-row variable
+        advance)."""
         now = time.perf_counter()
         finished = []
         jr = self.lm.journal
@@ -1737,7 +2283,7 @@ class BatchSession:
                 continue
             hit_eos = False
             had_tokens = bool(row.tokens)
-            for t, c in zip(toks[i], counted[i]):
+            for t, c in pairs(i):
                 if not c:  # EOS (or a post-EOS slot)
                     hit_eos = True
                     break
@@ -1761,7 +2307,11 @@ class BatchSession:
                            "top_k": self._ks[i],
                            "tokens": list(row.tokens),
                            "chunk_start": len(row.tokens), "text": "",
-                           "seq": 0, "key": None, "key_splits": 0})
+                           "seq": 0, "key": None, "key_splits": 0,
+                           # mid-spec snapshots: tokens[-1] is the pending
+                           # token (emitted but not yet in-cache) — resume
+                           # re-ingests it before continuing
+                           "spec": self._pending is not None})
             if not had_tokens and row.tokens and row.first_tok is None:
                 # engine-side TTFT: row creation (its prefill started) →
                 # its first token materialized on host

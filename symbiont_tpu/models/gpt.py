@@ -657,6 +657,254 @@ def _merge_rows_jit(cache_a, logits_a, pos_a, done_a, kv_valid_a,
             pick(done_a, done_b), pick(kv_valid_a, kv_b))
 
 
+# ---------------------------------------------------------------------------
+# Speculative decoding (docs/SPECULATIVE.md): draft k greedy tokens on a
+# small model's own cache, score all k+1 positions with ONE target forward,
+# emit the longest accepted prefix plus the target's correction.
+#
+# State contract ("spec state", vs the "plain state" decode_chunk carries):
+# the cache holds every emitted token EXCEPT the last one, which rides
+# host-side as `pending` [B]; `cur_pos` is pending's logical position. Each
+# round writes the S = k+1 window [pending, d_1..d_k] into BOTH caches
+# (drafter via its scan + one extra forward of d_k, target via the verify
+# forward), so the two planes share ONE kv_valid / cur_pos / done and one
+# scalar length advance of S per round. Raggedness lives ONLY in kv_valid:
+# slot j of a row's window stays valid iff j <= accepted(row) — rejected
+# draft slots become permanent holes the attention mask already excludes
+# (the same mechanism that masks left-padding), so plain decode_chunk keeps
+# working against a hole-y cache and no attention code changes at all.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("top_k_bucket", "eos_id"))
+def _spec_first_jit(cur_logits, done, key, temperature, top_k,
+                    top_k_bucket: int, eos_id: int):
+    """plain → spec transition: sample ONE token from carried logits (exactly
+    what the next plain step would emit) without forwarding it — it becomes
+    `pending`. Returns (tok, counted, new_done)."""
+    tok = _sample(cur_logits, key, temperature, top_k, top_k_bucket)
+    tok = jnp.where(done, 0, tok)
+    if eos_id >= 0:
+        counted = ~done & (tok != eos_id)
+        new_done = done | (tok == eos_id)
+    else:
+        counted = ~done
+        new_done = done
+    return tok, counted, new_done
+
+
+def spec_first(cur_logits, done, key, cfg: GPTConfig, temperature=0.8,
+               top_k=40, eos_id: int = -1):
+    t, k, bucket = _norm_sampling(temperature, top_k,
+                                  cur_logits.shape[0], cfg.vocab_size)
+    return _spec_first_jit(cur_logits, done, key, t, k,
+                           top_k_bucket=bucket, eos_id=eos_id)
+
+
+@partial(jax.jit, static_argnames=("dcfg", "spec_k"),
+         donate_argnames=("d_cache",))
+def _draft_chunk_jit(draft_params, d_cache, pending, cur_pos, done, kv_valid,
+                     dcfg: GPTConfig, spec_k: int):
+    """Drafter plane: scan k GREEDY steps from `pending` on the drafter's own
+    dense cache — one dispatch, same shape discipline as decode_chunk. The
+    drafter always proposes greedily (a point-mass proposal), which keeps
+    sampled-row acceptance a bare p_target(draft) coin flip in verify. After
+    the scan, d_k itself is forwarded once more (logits discarded) so the
+    drafter consumes exactly the same k+1 window slots the target's verify
+    writes — slot symmetry is what lets both planes share one kv_valid."""
+
+    def step(carry, _):
+        cache, tok, pos = carry
+        tok = jnp.where(done, 0, tok)
+        logits, cache = forward(draft_params, tok[:, None], cache,
+                                pos[:, None], dcfg, kv_valid)
+        cache = cache._replace(length=cache.length + 1)
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        return (cache, nxt, pos + 1), nxt
+
+    (cache, tok, pos), drafts = jax.lax.scan(
+        step, (d_cache, pending, cur_pos), None, length=spec_k)
+    tok = jnp.where(done, 0, tok)
+    _, cache = forward(draft_params, tok[:, None], cache, pos[:, None],
+                       dcfg, kv_valid)
+    cache = cache._replace(length=cache.length + 1)
+    return cache, drafts.T  # [B, k]
+
+
+def draft_chunk(draft_params, d_cache, pending, cur_pos, done, kv_valid,
+                dcfg: GPTConfig, spec_k: int):
+    return _draft_chunk_jit(draft_params, d_cache, pending, cur_pos, done,
+                            kv_valid, dcfg=dcfg, spec_k=spec_k)
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k_bucket", "eos_id"),
+         donate_argnames=("cache", "cur_pos", "done", "kv_valid"))
+def _verify_chunk_jit(params, cache, pending, drafts, cur_pos, done, kv_valid,
+                      key_u, key_c, temperature, top_k, cfg: GPTConfig,
+                      top_k_bucket: int, eos_id: int):
+    B, k = drafts.shape
+    S = k + 1
+    # One forward scores every draft position: logits[:, j] is the target's
+    # next-token distribution AFTER seq[:, :j+1], i.e. slot j scores d_{j+1}
+    # (and slot k is the bonus position past the last draft).
+    seq = jnp.concatenate([pending[:, None], drafts], axis=1)  # [B, S]
+    seq = jnp.where(done[:, None], 0, seq)
+    positions = cur_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    logits, new_cache = forward(params, seq, cache, positions, cfg, kv_valid)
+    new_cache = new_cache._replace(length=cache.length + S)
+
+    # the SAME transformed distribution _sample draws from (temperature
+    # scale + exact-k top-k cutoff inside the static bucket), per row
+    t = jnp.asarray(temperature, jnp.float32)
+    greedy_row = t <= 0.0
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    scaled = logits / jnp.maximum(t, 1e-6)[:, None, None]
+    tk = jnp.asarray(top_k, jnp.int32)
+    if top_k_bucket > 0:
+        vals = jax.lax.top_k(scaled, top_k_bucket)[0]  # [B, S, bucket] desc
+        idx = jnp.clip(tk, 1, top_k_bucket) - 1
+        kth = jnp.take_along_axis(
+            vals, jnp.broadcast_to(idx[:, None, None], (B, S, 1)), axis=-1)
+        cut = (tk > 0) & (tk < cfg.vocab_size)
+        scaled = jnp.where(cut[:, None, None] & (scaled < kth),
+                           -jnp.inf, scaled)
+
+    # Acceptance. Greedy rows: longest exact-match prefix against the
+    # target's own argmax — token-identical to plain decode by construction.
+    # Sampled rows: the drafter's proposal is a point mass (greedy drafts),
+    # so min(1, p/q) collapses to p_target(draft) — one uniform per slot.
+    probs = jax.nn.softmax(scaled, axis=-1)
+    p_d = jnp.take_along_axis(probs[:, :k, :], drafts[:, :, None],
+                              axis=-1)[..., 0]            # [B, k]
+    u = jax.random.uniform(key_u, (B, k))
+    acc = jnp.where(greedy_row[:, None], drafts == tgt[:, :k], u < p_d)
+    m = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)  # [B] 0..k
+
+    # Correction token at output slot m. Sampled rows draw from the
+    # rejection residual — p with the rejected draft token masked out
+    # (point-mass q makes norm(max(p-q,0)) exactly that), or the untouched
+    # slot-k distribution when every draft was accepted (the bonus token).
+    drafts_pad = jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], 1)
+    scaled_m = jnp.take_along_axis(scaled, m[:, None, None], axis=1)[:, 0, :]
+    d_rej = jnp.take_along_axis(drafts_pad, m[:, None], axis=1)[:, 0]
+    rej_mask = jax.nn.one_hot(d_rej, cfg.vocab_size, dtype=bool)
+    do_mask = (~greedy_row) & (m < k)
+    scaled_m = jnp.where(do_mask[:, None] & rej_mask, -jnp.inf, scaled_m)
+    sampled_c = jax.random.categorical(key_c, scaled_m, axis=-1)
+    tgt_m = jnp.take_along_axis(tgt, m[:, None], axis=1)[:, 0]
+    corr = jnp.where(greedy_row, tgt_m, sampled_c.astype(jnp.int32))
+
+    # Emission: slots 0..m-1 are the accepted drafts, slot m the correction.
+    # EOS bookkeeping mirrors _decode_step: the eos token itself is emitted
+    # but not counted, nothing after it counts, the row goes done.
+    jj = jnp.arange(S, dtype=jnp.int32)[None, :]
+    out = jnp.where(jj < m[:, None], drafts_pad,
+                    jnp.where(jj == m[:, None], corr[:, None], 0))
+    emit = (jj <= m[:, None]) & ~done[:, None]
+    out = jnp.where(emit, out, 0)
+    if eos_id >= 0:
+        hit = emit & (out == eos_id)
+        before = jnp.cumsum(hit, axis=1) - hit  # exclusive: any eos earlier?
+        counted = emit & (before == 0) & (out != eos_id)
+        new_done = done | hit.any(axis=1)
+    else:
+        counted = emit
+        new_done = done
+
+    # Window validity + advances: rejected slots j > m become permanent
+    # kv_valid holes; rows already done mark the whole window "valid" junk,
+    # exactly like plain decode writing forced-0 tokens for done rows.
+    m_adv = jnp.where(done, k, m)
+    window = jnp.arange(S, dtype=jnp.int32)[None, :] <= m_adv[:, None]
+    new_kvv = jax.lax.dynamic_update_slice(kv_valid, window, (0, cache.length))
+    new_pos = cur_pos + jnp.where(done, S, m + 1)
+    new_pending = jnp.where(new_done, 0, corr)
+    emitted = jnp.where(done, 0, m + 1)
+    return (new_cache, new_pending, new_pos, new_done, new_kvv,
+            out, counted, emitted)
+
+
+def verify_chunk(params, cache, pending, drafts, cur_pos, done, kv_valid,
+                 key, cfg: GPTConfig, temperature=0.8, top_k=40,
+                 eos_id: int = -1):
+    """Score k drafts + emit in ONE target dispatch. The carry (cache,
+    cur_pos, done, kv_valid) is donated like decode_chunk's — callers
+    reassign from the return. Returns (cache, pending, cur_pos, done,
+    kv_valid, out [B, k+1], counted [B, k+1], emitted [B]); a row's emitted
+    tokens are out[i, :emitted[i]] filtered through counted (eos cut)."""
+    t, tk, bucket = _norm_sampling(temperature, top_k,
+                                   pending.shape[0], cfg.vocab_size)
+    key_u, key_c = jax.random.split(key)
+    return _verify_chunk_jit(params, cache, pending, drafts, cur_pos, done,
+                             kv_valid, key_u, key_c, t, tk, cfg,
+                             top_k_bucket=bucket, eos_id=eos_id)
+
+
+@partial(jax.jit, static_argnames=("cfg",),
+         donate_argnames=("cache", "cur_pos"))
+def _ingest_pending_jit(params, cache, pending, cur_pos, done, kv_valid,
+                        cfg: GPTConfig):
+    tok = jnp.where(done, 0, pending)
+    logits, new_cache = forward(params, tok[:, None], cache,
+                                cur_pos[:, None], cfg, kv_valid)
+    new_cache = new_cache._replace(length=cache.length + 1)
+    return new_cache, logits[:, 0, :], cur_pos + 1
+
+
+def ingest_pending(params, cache, pending, cur_pos, done, kv_valid,
+                   cfg: GPTConfig):
+    """spec → plain transition: forward `pending` into the cache (one slot)
+    and recover carried logits, after which decode_chunk / merge_rows apply.
+    The logits are what an identically-positioned plain step would compute,
+    so a greedy stream stays token-identical across the mode switch."""
+    return _ingest_pending_jit(params, cache, pending, cur_pos, done,
+                               kv_valid, cfg=cfg)
+
+
+@partial(jax.jit, donate_argnames=("cache_a",))
+def merge_cache_rows(cache_a, cache_b, row_map):
+    """Drafter-side half of a continuous-batching splice: field-wise row
+    pick (batch axis 1 on every slab, scalar length keeps a's) mirroring
+    _merge_rows_jit, minus the logits/gap handling — gap validity for the
+    drafter is governed by the SHARED kv_valid the target-side merge_rows
+    already masks. cache_b rows come from a drafter prefill at the same
+    prompt bucket, so slabs line up slot for slot."""
+    B = cache_a.k.shape[1]
+    sel = row_map >= 0
+    j = jnp.clip(row_map, 0, cache_b.k.shape[1] - 1)
+
+    def pick(a, b):
+        take = jnp.take(b, j, axis=1)
+        shape = [1] * a.ndim
+        shape[1] = B
+        return jnp.where(sel.reshape(shape), take, a)
+
+    return type(cache_a)(*[fa if fa.ndim == 0 else pick(fa, fb)
+                           for fa, fb in zip(cache_a, cache_b)])
+
+
+@partial(jax.jit, static_argnames=("dcfg",), donate_argnames=("d_cache",))
+def _track_chunk_jit(draft_params, d_cache, toks, start_pos, kv_valid,
+                     dcfg: GPTConfig):
+    B, S = toks.shape
+    positions = start_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    _, cache = forward(draft_params, toks, cache=d_cache,
+                       positions=positions, cfg=dcfg, kv_valid=kv_valid)
+    return cache._replace(length=d_cache.length + S)
+
+
+def track_chunk(draft_params, d_cache, toks, start_pos, kv_valid,
+                dcfg: GPTConfig):
+    """Drafter lockstep through a PLAIN interlude: teacher-force the tokens
+    a plain decode chunk just wrote into the TARGET cache (decode_chunk's
+    returned `toks` — exactly its written content, done-row zeros included)
+    into the drafter's cache at the same slots/positions, one dispatch.
+    Keeps the two planes slot-symmetric so speculation can re-enter after a
+    margin fallback or a splice without a drafter re-prefill."""
+    return _track_chunk_jit(draft_params, d_cache, toks, start_pos, kv_valid,
+                            dcfg=dcfg)
+
+
 @partial(jax.jit,
          static_argnames=("cfg", "max_new_tokens", "top_k_bucket", "eos_id"))
 def _generate_jit(params, prompt_ids, prompt_mask, key, temperature, top_k,

@@ -130,7 +130,11 @@ class EngineTimeline:
                          pages_live: Optional[int] = None,
                          pages_total: Optional[int] = None,
                          dispatches: Optional[int] = None,
-                         host_gap_ms: Optional[float] = None) -> None:
+                         host_gap_ms: Optional[float] = None,
+                         spec_draft_ms: Optional[float] = None,
+                         spec_verify_ms: Optional[float] = None,
+                         spec_proposed: Optional[int] = None,
+                         spec_accepted: Optional[int] = None) -> None:
         """One decode chunk at its existing chunk-boundary host sync.
         ``pages_*`` are the paged-KV pool occupancy snapshot (host free-
         list counters, no device sync) — None on dense-layout engines.
@@ -138,7 +142,10 @@ class EngineTimeline:
         are the chunk's jitted-dispatch count and the host-think wall
         between the previous chunk's device window and this one — both
         measured from host clocks already in hand, no new device syncs;
-        None from recorders that predate the compute-plane profiler."""
+        None from recorders that predate the compute-plane profiler.
+        ``spec_*`` (speculative rounds only): draft/verify wall split and
+        the round's proposed/accepted draft-token counts — absent on plain
+        chunks, so spec-off recorders are byte-identical."""
         if not self._enabled:
             return
         # dense engines never pass pages_*: keep their path the exact
@@ -164,6 +171,16 @@ class EngineTimeline:
         if host_gap_ms is not None:
             ev["dispatches"] = int(dispatches or 0)
             ev["host_gap_ms"] = float(host_gap_ms)
+        if spec_proposed is not None:
+            # speculative round: ``steps`` is the MEAN emitted tokens per
+            # live row this boundary (fractional under per-row variable
+            # advance) — restore the fraction the literal dicts' int()
+            # dropped so dispatches-per-EMITTED-token stays honest
+            ev["steps"] = float(steps)
+            ev["spec_draft_ms"] = float(spec_draft_ms or 0.0)
+            ev["spec_verify_ms"] = float(spec_verify_ms or 0.0)
+            ev["spec_proposed"] = int(spec_proposed)
+            ev["spec_accepted"] = int(spec_accepted or 0)
         self._append(ev)
 
     def note_admit(self, rows: int, prefill_ms: float,
@@ -397,6 +414,18 @@ class EngineTimeline:
             out["decode_dispatches_per_token"] = (
                 round(disp / gen_tokens, 4) if gen_tokens else 0.0)
             out["decode_host_gap_pct"] = pct(gap_ms, gap_ms + busy_ms)
+        # speculative-decode view: only rounds recorded by a spec-enabled
+        # engine carry spec_* fields — spec-off summaries are unchanged
+        spec_steps = [e for e in steps if "spec_proposed" in e]
+        if spec_steps:
+            proposed = sum(e["spec_proposed"] for e in spec_steps)
+            accepted = sum(e["spec_accepted"] for e in spec_steps)
+            out["decode_spec_rounds"] = len(spec_steps)
+            out["decode_spec_accept_pct"] = pct(accepted, proposed)
+            out["decode_spec_draft_ms_total"] = round(
+                sum(e["spec_draft_ms"] for e in spec_steps), 2)
+            out["decode_spec_verify_ms_total"] = round(
+                sum(e["spec_verify_ms"] for e in spec_steps), 2)
         out["dominant_stall"] = self._dominant_stall(out)
         return out
 

@@ -1,0 +1,366 @@
+"""Batched speculative decoding (docs/SPECULATIVE.md): drafter/target
+compat validation, token-identity across layouts/quant, per-row variable
+advance under splice/cancel, journal resume, and the fallback ladder.
+
+Fast tier: validate_spec_draft (jax-free) + config knobs. Slow tier (jax):
+the identity/compat matrix the ISSUE's hard gate names — spec-on greedy ==
+spec-off greedy across {dense,paged} × {kv_quant none,int8}, sampled
+resume-after-kill, heterogeneous accepts with mid-flight admission, and
+the drafter-divergence / PoolExhausted degradations."""
+
+import json
+
+import pytest
+
+from symbiont_tpu.config import LmConfig, load_config, validate_spec_draft
+
+# ------------------------------------------------- compat validation (fast)
+
+
+def _model_dir(tmp_path, name, vocab=256, tok_bytes=None):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({"vocab_size": vocab}))
+    if tok_bytes is not None:
+        (d / "tokenizer.json").write_bytes(tok_bytes)
+    return str(d)
+
+
+def test_validate_spec_draft_accepts_matching_pair(tmp_path):
+    t = _model_dir(tmp_path, "target", vocab=512, tok_bytes=b"{tok}")
+    d = _model_dir(tmp_path, "draft", vocab=512, tok_bytes=b"{tok}")
+    validate_spec_draft(t, d)  # no raise
+
+
+def test_validate_spec_draft_rejects_vocab_mismatch(tmp_path):
+    t = _model_dir(tmp_path, "target", vocab=512)
+    d = _model_dir(tmp_path, "draft", vocab=300)
+    with pytest.raises(ValueError, match="vocab mismatch"):
+        validate_spec_draft(t, d)
+
+
+def test_validate_spec_draft_rejects_tokenizer_mismatch(tmp_path):
+    t = _model_dir(tmp_path, "target", tok_bytes=b"{tok-a}")
+    d = _model_dir(tmp_path, "draft", tok_bytes=b"{tok-b}")
+    with pytest.raises(ValueError, match="tokenizer mismatch"):
+        validate_spec_draft(t, d)
+
+
+def test_validate_spec_draft_missing_config_is_clear(tmp_path):
+    t = _model_dir(tmp_path, "target")
+    with pytest.raises(ValueError, match="cannot read"):
+        validate_spec_draft(t, str(tmp_path / "nope"))
+
+
+def test_spec_knobs_env_overrides():
+    cfg = load_config(env={"SYMBIONT_LM_SPEC_DRAFT_MODEL": "/models/draft",
+                           "SYMBIONT_LM_SPEC_K": "12"})
+    assert cfg.lm.spec_draft_model == "/models/draft"
+    assert cfg.lm.spec_k == 12
+    with pytest.raises(ValueError, match="spec_k"):
+        load_config(env={"SYMBIONT_LM_SPEC_K": "0"})
+
+
+# ------------------------------------------------------- jax fixtures (slow)
+
+TINY = dict(enabled=True, arch="llama", hidden_size=32, num_layers=2,
+            num_heads=4, intermediate_size=64, max_positions=256,
+            dtype="float32", prompt_buckets=[16], new_token_buckets=[32],
+            temperature=0.0, spec_k=4, stream_chunk=4, kv_page_tokens=16,
+            gen_max_batch=8, session_min_rows=4)
+
+
+def _engine(**kw):
+    from symbiont_tpu.engine.lm import LmEngine
+
+    return LmEngine(LmConfig(**dict(TINY, **kw)))
+
+
+def _spec_engine(**kw):
+    """Engine + an injected drafter that IS the target (same random init:
+    same cfg ⇒ same seed ⇒ same params) — acceptance is 100% and greedy
+    identity isolates the spec plumbing from drafter quality."""
+    from symbiont_tpu.engine.lm import LmEngine
+
+    donor = _engine(**kw)
+    return LmEngine(LmConfig(**dict(TINY, **kw)), draft_params=donor.params,
+                    draft_model_cfg=donor.model_cfg)
+
+
+def _stream(eng, prompt, n, **kw):
+    return "".join(eng.generate_stream(prompt, n, **kw))
+
+
+def _session(eng, prompts, want, **kw):
+    s = eng.start_session(prompts, want, **kw)
+    done = []
+    while not s.done():
+        done += s.step()
+    return sorted(done)
+
+
+def _corrupting(real_draft, wrong_from=2):
+    """Wrap draft_chunk to corrupt proposals from slot `wrong_from` on —
+    forces PARTIAL acceptance so rejected slots become kv_valid holes that
+    every later window must mask correctly."""
+
+    def fn(draft_params, d_cache, pending, cur_pos, done, kv_valid, dcfg,
+           spec_k):
+        import jax.numpy as jnp
+
+        cache, drafts = real_draft(draft_params, d_cache, pending, cur_pos,
+                                   done, kv_valid, dcfg, spec_k)
+        bad = (drafts + 1) % dcfg.vocab_size
+        mix = jnp.where(jnp.arange(spec_k)[None, :] >= wrong_from,
+                        bad, drafts)
+        return cache, mix
+
+    return fn
+
+
+# ------------------------------------------------------ engine boot (slow)
+
+
+@pytest.mark.slow
+def test_missing_draft_dir_degrades_to_spec_off(tmp_path, caplog):
+    eng = _engine(spec_draft_model=str(tmp_path / "not-there"))
+    assert eng._draft is None  # one warning, engine decodes plain
+    assert isinstance(eng.generate("hello", 8), str)
+
+
+@pytest.mark.slow
+def test_injected_drafter_vocab_mismatch_fails_fast():
+    import dataclasses
+
+    from symbiont_tpu.engine.lm import LmEngine
+
+    donor = _engine()
+    bad_cfg = dataclasses.replace(
+        donor.model_cfg, vocab_size=donor.model_cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        LmEngine(LmConfig(**TINY), draft_params=donor.params,
+                 draft_model_cfg=bad_cfg)
+
+
+# ------------------------------------------- the identity hard gate (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout,kv_quant", [("dense", "none"),
+                                             ("dense", "int8"),
+                                             ("paged", "none"),
+                                             ("paged", "int8")])
+def test_spec_greedy_token_identical(layout, kv_quant):
+    """ISSUE 19 hard gate: greedy spec-on == greedy spec-off, stream and
+    batch session, across every KV layout × quantization pair."""
+    kw = dict(kv_layout=layout, kv_quant=kv_quant)
+    off, on = _engine(**kw), _spec_engine(**kw)
+    prompt = "the quick brown fox jumps"
+    assert _stream(off, prompt, 24) == _stream(on, prompt, 24)
+    prompts = ["hello", "a much longer prompt with many words", ""]
+    assert (_session(off, prompts, [20, 20, 20], temperature=0.0)
+            == _session(on, prompts, [20, 20, 20], temperature=0.0))
+    assert on._spec_proposed > 0
+    assert on._spec_accepted == on._spec_proposed  # drafter IS the target
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("layout,kv_quant", [("dense", "none"),
+                                             ("paged", "int8")])
+def test_spec_partial_accept_token_identical(monkeypatch, layout, kv_quant):
+    """Divergent drafter ⇒ heterogeneous per-row accepts and permanent
+    kv_valid holes — output must STILL match spec-off exactly."""
+    import symbiont_tpu.models.gpt as gpt_mod
+
+    kw = dict(kv_layout=layout, kv_quant=kv_quant)
+    off, on = _engine(**kw), _spec_engine(**kw)
+    prompt = "the quick brown fox jumps"
+    prompts = ["hello", "a much longer prompt with many words", ""]
+    ref_s = _stream(off, prompt, 24)
+    ref_b = _session(off, prompts, [20, 20, 20], temperature=0.0)
+    monkeypatch.setattr(gpt_mod, "draft_chunk",
+                        _corrupting(gpt_mod.draft_chunk))
+    assert _stream(on, prompt, 24) == ref_s
+    assert _session(on, prompts, [20, 20, 20], temperature=0.0) == ref_b
+    assert 0 < on._spec_accepted < on._spec_proposed
+
+
+@pytest.mark.slow
+def test_spec_admit_and_cancel_mid_flight():
+    """Newcomers splice into a speculating session (drafter rows ride the
+    same row_map); a cancelled row frees immediately. Output for surviving
+    rows matches the spec-off engine's."""
+
+    def drive(eng):
+        s = eng.start_session(["alpha prompt", "beta words"], [20, 20],
+                              temperature=0.0)
+        out = list(s.step())
+        tags = s.admit(["gamma joins late"], [12], temperature=0.0)
+        out += s.step()
+        assert s.cancel_tag(tags[0])  # newcomer leaves before finishing
+        while not s.done():
+            out += s.step()
+        return sorted(out)
+
+    assert drive(_engine()) == drive(_spec_engine())
+
+
+@pytest.mark.slow
+def test_spec_sampled_resume_after_kill_token_identical(tmp_path):
+    """Sampled spec-on stream killed at a chunk boundary resumes token-
+    identically through the genlog journal: the tail's base key + split
+    count re-derive the PRNG chain, and the `spec` flag re-ingests the
+    pending token (journal records accepted tokens only)."""
+    from symbiont_tpu.resilience.genlog import GenJournal
+
+    prompt = "sampling is stochastic"
+    kw = dict(temperature=0.8, seed=7)
+    ref = _stream(_spec_engine(**kw), prompt, 24, temperature=0.8, top_k=8)
+
+    eng = _spec_engine(**kw)
+    eng.journal = journal = GenJournal(tmp_path / "s.genlog")
+    got = []
+    gen = eng.generate_stream(prompt, 24, temperature=0.8, top_k=8,
+                              task_id="kill-me")
+    for delta in gen:
+        got.append(delta)
+        if len(got) >= 2:
+            gen.close()  # the SIGKILL stand-in at a chunk boundary
+            break
+    rec = journal.live_tails()["kill-me"]
+    assert rec["key"] is not None and rec["key_splits"] >= 1
+
+    adopter = _spec_engine(**dict(kw, seed=99))  # different-seed process
+    deltas = list(adopter.generate_stream(
+        "", rec["max_new"], temperature=rec["temperature"],
+        top_k=rec["top_k"], task_id="kill-me", stream=True, resume=rec))
+    assert rec["text"] + "".join(deltas) == ref
+
+
+@pytest.mark.slow
+def test_spec_resume_record_adopted_by_spec_off_engine(tmp_path):
+    """The journal records ACCEPTED tokens only, so a spec-on worker's
+    orphan adopts cleanly on a spec-off replica (and stays greedy-
+    identical to the unkilled run)."""
+    from symbiont_tpu.resilience.genlog import GenJournal
+
+    prompt = "the quick brown fox jumps"
+    ref = _stream(_engine(), prompt, 24)
+
+    eng = _spec_engine()
+    eng.journal = journal = GenJournal(tmp_path / "g.genlog")
+    got = []
+    gen = eng.generate_stream(prompt, 24, task_id="kill-me")
+    for delta in gen:
+        got.append(delta)
+        if len(got) >= 2:
+            gen.close()
+            break
+    rec = journal.live_tails()["kill-me"]
+    adopter = _engine()  # no drafter at all
+    deltas = list(adopter.generate_stream(
+        "", rec["max_new"], temperature=rec["temperature"],
+        top_k=rec["top_k"], task_id="kill-me", stream=True, resume=rec))
+    assert rec["text"] + "".join(deltas) == ref
+
+
+# ----------------------------------------------------- fallback rows (slow)
+
+
+@pytest.mark.slow
+def test_spec_divergence_ema_disables_session(monkeypatch):
+    """An always-wrong drafter burns spec_k+1 slots per emitted token; the
+    acceptance EMA turns speculation off for the session after a few
+    rounds, and output still matches spec-off."""
+    import symbiont_tpu.models.gpt as gpt_mod
+
+    real = gpt_mod.draft_chunk
+
+    def wrong(draft_params, d_cache, pending, cur_pos, done, kv_valid,
+              dcfg, spec_k):
+        cache, drafts = real(draft_params, d_cache, pending, cur_pos, done,
+                             kv_valid, dcfg, spec_k)
+        return cache, (drafts + 1) % dcfg.vocab_size
+
+    kw = dict(new_token_buckets=[64])
+    ref = _session(_engine(**kw), ["alpha prompt", "beta words"], [12, 12],
+                   temperature=0.0)
+    on = _spec_engine(**kw)
+    monkeypatch.setattr(gpt_mod, "draft_chunk", wrong)
+    s = on.start_session(["alpha prompt", "beta words"], [12, 12],
+                         temperature=0.0)
+    done = []
+    while not s.done():
+        done += s.step()
+    assert sorted(done) == ref
+    assert s._spec_on is False and s._spec_rounds >= 3
+
+
+@pytest.mark.slow
+def test_spec_pool_exhausted_degrades_to_plain(monkeypatch):
+    """PoolExhausted while reserving the spec window's pages degrades the
+    session to plain decode — never an error, output unchanged."""
+    from symbiont_tpu.kv.pool import PoolExhausted
+
+    kw = dict(kv_layout="paged")
+    ref = _session(_engine(**kw), ["alpha prompt", "beta words"], [20, 20],
+                   temperature=0.0)
+    on = _spec_engine(**kw)
+    s = on.start_session(["alpha prompt", "beta words"], [20, 20],
+                         temperature=0.0)
+    calls = {"n": 0}
+    real_ensure = s._ensure_decode_blocks
+
+    def flaky(chunk):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise PoolExhausted("pressure")
+        return real_ensure(chunk)
+
+    monkeypatch.setattr(s, "_ensure_decode_blocks", flaky)
+    done = []
+    while not s.done():
+        done += s.step()
+    assert sorted(done) == ref
+    assert s._spec_on is False  # degraded, permanently for this session
+
+
+@pytest.mark.slow
+def test_spec_margin_guard_never_truncates_output():
+    """want == bucket leaves no spec headroom mid-stream; the margin guard
+    must hand back to plain decode early enough that every row still
+    fills its full budget."""
+    off, on = _engine(), _spec_engine()
+    prompt = "margin case"
+    a = _stream(off, prompt, 32)  # want == top new-token bucket
+    b = _stream(on, prompt, 32)
+    assert a == b and len(b) > 0
+
+
+# --------------------------------------------------- instruments (slow)
+
+
+@pytest.mark.slow
+def test_spec_ledger_and_timeline_rows():
+    from symbiont_tpu.obs.engine_timeline import engine_timeline
+    from symbiont_tpu.obs.xprof import dispatch_ledger
+    from symbiont_tpu.utils.telemetry import metrics
+
+    engine_timeline.clear()
+    on = _spec_engine()
+    _session(on, ["hello", "world"], [16, 16], temperature=0.0)
+    keys = {e["executable"].split("[")[0]
+            for e in dispatch_ledger.snapshot()}
+    assert {"lm.draft_prefill", "lm.draft_chunk",
+            "lm.verify_chunk"} <= keys
+    s = engine_timeline.summary()
+    assert s["decode_spec_rounds"] >= 1
+    assert s["decode_spec_accept_pct"] == 100.0  # drafter IS the target
+    assert s["decode_spec_draft_ms_total"] >= 0.0
+    # gauge exported for spec-enabled engines only
+    labels = {"service": "lm", "kv_dtype": "float32"}
+    assert metrics.gauge_get("lm.spec_accept_rate", labels=labels) == 1.0
+
+    engine_timeline.clear()
+    _session(_engine(), ["hello"], [8], temperature=0.0)
+    assert "decode_spec_rounds" not in engine_timeline.summary()

@@ -333,6 +333,40 @@ def test_decode_session_feeds_ledger_and_host_gap(tiny_lm):
     assert "decode_host_gap_pct" in s
 
 
+def test_spec_session_feeds_ledger_without_new_sync_sites(tiny_lm):
+    """The speculative-decode executables (draft plane + verify) land in
+    the dispatch ledger like any other jitted dispatch, and the spec path
+    introduces NO new host-sync site: the round's one materialization
+    rides the pre-existing chunk-boundary scope, so the lint allowlist
+    and the runtime counter inventory both stay unchanged."""
+    from symbiont_tpu.config import LmConfig
+    from symbiont_tpu.engine.lm import LmEngine
+    from symbiont_tpu.lint.allowlist import JAX_HOST_SYNC_ALLOWED
+    from symbiont_tpu.obs.xprof import dispatch_ledger, known_sync_sites
+
+    donor = LmEngine(LmConfig(
+        enabled=True, arch="gpt2", hidden_size=32, num_layers=1,
+        num_heads=2, intermediate_size=64, max_positions=128,
+        dtype="float32", prompt_buckets=[16], new_token_buckets=[16],
+        stream_chunk=4, gen_max_batch=8, gen_flush_deadline_ms=5.0,
+        session_min_rows=4, temperature=0.0, spec_k=4))
+    spec = LmEngine(donor.config, draft_params=donor.params,
+                    draft_model_cfg=donor.model_cfg)
+    dispatch_ledger.clear()
+    dispatch_ledger.configure(enabled=True)
+    sess = spec.start_session(["ledger probe one", "ledger probe two"],
+                              [8, 8])
+    while not sess.done():
+        sess.step()
+    sigs = {r["executable"] for r in dispatch_ledger.snapshot()}
+    for fam in ("lm.draft_prefill[", "lm.draft_chunk[", "lm.verify_chunk["):
+        assert any(s.startswith(fam) for s in sigs), (fam, sorted(sigs))
+    # two-direction parity with the lint allowlist is untouched by the
+    # spec plane: every runtime counter site is allowlisted and vice versa
+    allow = {scope for (_f, scope) in JAX_HOST_SYNC_ALLOWED}
+    assert set(known_sync_sites()) == allow
+
+
 # --------------------------------------------------------- HTTP surfaces
 
 class _StubEngine:
