@@ -20,6 +20,13 @@
 #       the ingest mode prints hop self-times — decode batch occupancy,
 #       stranded KV rows, prefix share, TTFT/TPOT, embed packing
 #       opportunity, and the dominant-stall verdict.
+#
+#   scripts/profile_ingest.sh --memory [host:port]   # against a RUNNING
+#       stack (default localhost:8080): print the HBM attribution plane
+#       (GET /api/memory + /api/memory/census, obs/hbm.py) — per-subsystem
+#       byte ledger, per-device bytes-in-use/limit/headroom, the
+#       unattributed residual, the last OOM verdict, and the top
+#       live-array census groups.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -104,6 +111,66 @@ print("dominant stall:", s["dominant_stall"])
 print(f"(Perfetto view: curl http://{api}"
       "'/api/engine/timeline?fmt=chrome' > tl.json, open in "
       "ui.perfetto.dev)")
+EOF
+  exit 0
+fi
+
+if [ "${1:-}" = "--memory" ]; then
+  python3 - "${2:-localhost:8080}" <<'EOF'
+import json
+import sys
+import urllib.request
+
+api = sys.argv[1]
+with urllib.request.urlopen(f"http://{api}/api/memory", timeout=10) as r:
+    mem = json.load(r)
+local = mem.get("local") or {}
+rows = local.get("subsystems") or []
+
+
+def gib(n):
+    return f"{n / (1 << 30):8.3f} GiB" if n is not None else "       -    "
+
+
+print(f"hbm attribution (basis: {local.get('basis')})")
+if not rows:
+    print("  no subsystem claims yet — is an engine plane up on this role?")
+for row in rows:
+    mark = "  (overlay: inside another claim)" if row["overlay"] else ""
+    print("  " + row["subsystem"].ljust(24) + gib(row["bytes"]) + mark)
+print("  " + "-" * 44)
+print("  " + "attributed".ljust(24) + gib(local.get("attributed_bytes")))
+print("  " + "unattributed".ljust(24) + gib(local.get("unattributed_bytes"))
+      + f"  ({local.get('unattributed_pct')}% of "
+        f"{gib(local.get('bytes_in_use')).strip()} in use)")
+for d in local.get("devices") or []:
+    limit, use = d.get("bytes_limit"), d["bytes_in_use"]
+    head = (limit - use) if limit else None
+    print(f"  device {d['device']} ({d['platform']}): "
+          f"{gib(use).strip()} in use / {gib(limit).strip()} limit"
+          + (f", {gib(head).strip()} headroom" if head is not None else ""))
+oom = mem.get("last_oom")
+if oom:
+    print(f"LAST OOM: site={oom['site']} postmortem={oom.get('postmortem')}")
+    print(f"  {oom.get('error', '')[:120]}")
+for role, entry in (mem.get("roles") or {}).items():
+    subs = entry.get("subsystems") or {}
+    if subs:
+        total = sum(v for v in subs.values())
+        print(f"  role {role}: {len(subs)} subsystem claims, "
+              f"{gib(total).strip()} attributed")
+with urllib.request.urlopen(f"http://{api}/api/memory/census?top=8",
+                            timeout=10) as r:
+    cen = json.load(r)["census"]
+if cen.get("available"):
+    print(f"live-array census: {cen['arrays']} arrays, "
+          f"{gib(cen['bytes_total']).strip()} total")
+    for g in cen["groups"][:8]:
+        shape = "x".join(str(d) for d in g["shape"]) or "scalar"
+        print(f"  {g['dtype']:<10} {shape:<22} x{g['count']:<5} "
+              + gib(g["bytes"]).strip())
+else:
+    print("live-array census unavailable:", cen.get("detail"))
 EOF
   exit 0
 fi

@@ -349,6 +349,32 @@ def tier_decode_timeline(results: dict, ctx) -> None:
         f"gap {results['decode_host_gap_pct']}% of chunk wall; dominant "
         f"stall: {s['dominant_stall']}")
 
+    # ---- HBM attribution reconcile (obs/hbm.py) -----------------------
+    # With both decode engines still live, the subsystem ledger must
+    # explain nearly everything the process holds on device: gc first so
+    # per-run temporaries (logits, prompt ids, retired sessions) don't
+    # masquerade as unattributed, then gate the residual in-tier — an
+    # unclaimed allocation site landing in the decode plane shows up here
+    # as the pct creeping toward the 15% wall, not as a silent OOM later.
+    import gc
+
+    from symbiont_tpu.obs.hbm import hbm_ledger
+
+    gc.collect()
+    rec = hbm_ledger.reconcile()
+    assert rec["basis"] != "none", "hbm reconcile found no byte basis"
+    results["decode_hbm_unattributed_pct"] = rec["unattributed_pct"]
+    results["decode_hbm_attributed_mb"] = round(
+        rec["attributed_bytes"] / (1 << 20), 2)
+    assert rec["unattributed_pct"] < 15.0, (
+        f"unattributed device bytes {rec['unattributed_pct']}% >= 15% "
+        f"(basis {rec['basis']}, attributed {rec['attributed_bytes']}, "
+        f"subsystems {[(r['subsystem'], r['bytes']) for r in rec['subsystems']]})")
+    log(f"hbm attribution (dense+paged engines live, basis {rec['basis']}): "
+        f"{results['decode_hbm_attributed_mb']} MiB attributed across "
+        f"{len(rec['subsystems'])} subsystems, "
+        f"{rec['unattributed_pct']}% unattributed (< 15% gate)")
+
     # ---- speculative-decode pass (ROADMAP item 1: draft + verify) ------
     # Scaled stand-in for the GPT-2-124M -> TinyLlama-1.1B pair the
     # roadmap names: the TARGET is a TinyLlama-shaped llama geometry

@@ -42,6 +42,13 @@ seeded synthetic load:
   window for the accepted prefix, stop at the correction/EOS, tally
   accept counters and the divergence EMA. Pays per spec round on the
   decode critical path, so it gates like the timeline record.
+- `obs_hbm_census_ms` (primary, lower is better): one live-array census
+  pass (obs/hbm.py) over a ~512-array process — `jax.live_arrays()`
+  walk, group-by (shape, dtype, sharding), tail fold — the whole cost
+  of a `GET /api/memory/census` call. It is on-demand (never on the
+  decode path), but it runs against a live serving process, so a
+  regression here is a debugging tool that stalls the very process it
+  inspects.
 
 All are median-of-5 with in-run min/max (host-CPU timings on the one
 shared core are noisy; the gate's allowed delta widens with the archived
@@ -156,13 +163,17 @@ JOURNAL_EVENTS = 2000    # journal appends per throughput sample
 SPEC_ROUNDS = 2000       # spec accept/rollback rounds per throughput sample
 
 
+CENSUS_ARRAYS = 512      # live buffers anchored for the census sample
+
+
 @register("obs", primary_metrics=("obs_span_record_per_s",
                                   "obs_critical_path_512_ms",
                                   "obs_fleet_merge_per_s",
                                   "obs_timeline_record_per_s",
                                   "obs_dispatch_record_per_s",
                                   "obs_journal_record_per_s",
-                                  "obs_spec_bookkeeping_per_s"),
+                                  "obs_spec_bookkeeping_per_s",
+                                  "obs_hbm_census_ms"),
           quick=True)
 def tier_obs(results: dict, ctx) -> None:
     from symbiont_tpu.obs import critical_path
@@ -341,6 +352,32 @@ def tier_obs(results: dict, ctx) -> None:
     stats.record(results, "obs_spec_bookkeeping_per_s",
                  [one_spec_sample() for _ in range(REPEATS)], digits=0)
 
+    # ---- live-array census cost (obs/hbm.py): one GET /api/memory/census
+    # pass over a population of CENSUS_ARRAYS live buffers spread across a
+    # realistic shape/dtype mix. The anchor list keeps them live for the
+    # whole sample; deleted after so the suite's own footprint is unmoved.
+    import jax.numpy as jnp
+
+    from symbiont_tpu.obs import hbm
+
+    anchors = []
+    shapes = ((64, 64), (128,), (8, 16, 32), (256, 8), (1,))
+    dtypes = (jnp.float32, jnp.int32)
+    for i in range(CENSUS_ARRAYS):
+        anchors.append(jnp.zeros(shapes[i % len(shapes)],
+                                 dtype=dtypes[i % len(dtypes)]))
+
+    def one_census_ms() -> float:
+        t0 = time.perf_counter()
+        out = hbm.census(top=64)
+        assert out["available"] and out["arrays"] >= CENSUS_ARRAYS, out
+        return (time.perf_counter() - t0) * 1000.0
+
+    one_census_ms()  # warm the live_arrays / grouping path
+    stats.record(results, "obs_hbm_census_ms",
+                 [one_census_ms() for _ in range(REPEATS)], digits=2)
+    del anchors
+
     results["obs_span_overhead_us"] = round(
         1e6 / results["obs_span_record_per_s"], 1)
     log(f"obs: span exit {results['obs_span_record_per_s']:.0f}/s "
@@ -364,4 +401,7 @@ def tier_obs(results: dict, ctx) -> None:
         f"{results['obs_journal_record_per_s_max']:.0f}]; spec bookkeeping "
         f"{results['obs_spec_bookkeeping_per_s']:.0f}/s "
         f"[{results['obs_spec_bookkeeping_per_s_min']:.0f}–"
-        f"{results['obs_spec_bookkeeping_per_s_max']:.0f}]")
+        f"{results['obs_spec_bookkeeping_per_s_max']:.0f}]; hbm census "
+        f"{results['obs_hbm_census_ms']:.2f} ms "
+        f"[{results['obs_hbm_census_ms_min']:.2f}–"
+        f"{results['obs_hbm_census_ms_max']:.2f}]")

@@ -529,6 +529,16 @@ class ObsConfig:
     xprof_executables: int = 256
     xprof_trace_max_s: float = 30.0
     xprof_trace_dir: str = "/tmp/symbiont_xprof"
+    # HBM attribution plane (obs/hbm.py): the subsystem byte ledger /
+    # live-array census behind GET /api/memory (+ /census) and the OOM
+    # forensics postmortems (hbm_enabled=False disables ledger rows and
+    # postmortem writes; engine.oom_total still counts). census_groups
+    # bounds (shape, dtype, sharding) rows carried per census response;
+    # postmortems land in postmortem_dir, newest postmortem_max kept.
+    hbm_enabled: bool = True
+    hbm_census_groups: int = 64
+    hbm_postmortem_dir: str = "/tmp/symbiont_hbm"
+    hbm_postmortem_max: int = 4
 
     def __post_init__(self) -> None:
         if self.trace_capacity < 1:
@@ -569,6 +579,12 @@ class ObsConfig:
             raise ValueError("obs.xprof_trace_max_s must be positive")
         if not self.xprof_trace_dir:
             raise ValueError("obs.xprof_trace_dir must be non-empty")
+        if self.hbm_census_groups < 1:
+            raise ValueError("obs.hbm_census_groups must be >= 1")
+        if self.hbm_postmortem_max < 1:
+            raise ValueError("obs.hbm_postmortem_max must be >= 1")
+        if not self.hbm_postmortem_dir:
+            raise ValueError("obs.hbm_postmortem_dir must be non-empty")
         # malformed SLO entries fail at boot, not silently never fire
         from symbiont_tpu.obs.watchdog import parse_thresholds
 

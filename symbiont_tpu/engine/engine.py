@@ -51,7 +51,8 @@ from symbiont_tpu.engine.bucketing import (
 from symbiont_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from symbiont_tpu.models import bert as bert_mod
 from symbiont_tpu.models.bert import BertConfig
-from symbiont_tpu.obs.xprof import cost_analysis_for, dispatch_ledger
+from symbiont_tpu.obs.hbm import guard_oom, hbm_ledger
+from symbiont_tpu.obs.xprof import compile_analysis_for, dispatch_ledger
 from symbiont_tpu.utils.telemetry import maybe_profile, metrics
 
 log = logging.getLogger(__name__)
@@ -235,6 +236,16 @@ class TpuEngine:
                    else "f32")
         metrics.gauge_set("engine.param_bytes", param_bytes(self.params),
                           labels={"service": "engine", "dtype": storage})
+        # hbm attribution plane (obs/hbm.py): the embed/cross params claim
+        # their device bytes in the subsystem ledger — weakref-bound, so a
+        # dead engine retires the claim like its gauges
+        def _engine_param_bytes(eng):
+            b = param_bytes(eng.params)
+            if eng.cross_params is not None:
+                b += param_bytes(eng.cross_params)
+            return b
+
+        hbm_ledger.claim("engine.params", self, _engine_param_bytes)
 
     def _register_gauges(self) -> None:
         """Engine-plane gauges (docs/OBSERVABILITY.md): compile count and
@@ -377,27 +388,53 @@ class TpuEngine:
         EVERY call (not just the first) reports its host wall to the
         per-executable dispatch ledger (obs/xprof.py) — kernel-launch
         counts + host dispatch overhead per executable, the compute-plane
-        profiler's primary feed. The first call additionally captures the
-        XLA cost model (FLOPs / bytes) from the LOWERED computation, so
-        the one real compile still happens inside the first dispatch."""
+        profiler's primary feed. The first call lowers + compiles via AOT
+        (obs/xprof.compile_analysis_for) so the XLA cost model AND the
+        static memory footprint (temp/argument/output bytes) come off the
+        ONE real compile, and later calls dispatch through the Compiled
+        object — every call per cache key shares exact shapes, so the AOT
+        path is always type-valid; if the backend rejects it we fall back
+        to the jitted fn (jit's own cache; at worst one duplicate compile
+        on that rare path). Every dispatch runs under the OOM guard: a
+        RESOURCE_EXHAUSTED escaping XLA is recorded to the hbm forensics
+        plane (postmortem + engine.oom_total{site}) and re-raised."""
         first = [True]
         sig = (f"{key[0]}[L={key[1]},B={key[2]}]" if key is not None
                else "unknown")
+        dispatch_fn = [jitted]  # swapped to the AOT Compiled after compile
 
         def wrapper(*args):
             if not first[0]:
                 t0 = time.perf_counter()
-                out = jitted(*args)
+                try:
+                    with guard_oom(f"engine.{sig}"):
+                        out = dispatch_fn[0](*args)
+                except TypeError:
+                    # AOT call-convention mismatch (backend-specific):
+                    # permanently fall back to the jitted fn
+                    dispatch_fn[0] = jitted
+                    with guard_oom(f"engine.{sig}"):
+                        out = jitted(*args)
                 dispatch_ledger.note_dispatch(sig, time.perf_counter() - t0)
                 return out
             first[0] = False
-            cost = cost_analysis_for(jitted, args)
+            # the one real XLA compile happens INSIDE compile_analysis_for
+            # (lowered.compile()), so compile_s timing starts before it
             t0 = time.perf_counter()
             start_s = time.time()
-            out = jitted(*args)
+            cost, mem, compiled = compile_analysis_for(jitted, args)
+            with guard_oom(f"engine.{sig}"):
+                if compiled is not None:
+                    try:
+                        out = compiled(*args)
+                        dispatch_fn[0] = compiled
+                    except TypeError:
+                        out = jitted(*args)
+                else:
+                    out = jitted(*args)
             dt = time.perf_counter() - t0
             self._bump(compile_s=dt)
-            dispatch_ledger.note_compile(sig, cost)
+            dispatch_ledger.note_compile(sig, cost, memory=mem)
             dispatch_ledger.note_dispatch(sig, dt)
             from symbiont_tpu.obs.device import record_compile_event
 

@@ -34,6 +34,7 @@ from symbiont_tpu.kv.radix import RadixCache
 from symbiont_tpu.models import gpt as gpt_mod
 from symbiont_tpu.models.gpt import GPTConfig, PagedKVCache
 from symbiont_tpu.obs.engine_timeline import engine_timeline
+from symbiont_tpu.obs.hbm import guard_oom, hbm_ledger
 from symbiont_tpu.obs.usage import usage
 from symbiont_tpu.obs.xprof import dispatch_ledger
 from symbiont_tpu.resilience.admission import DEFAULT_TENANT
@@ -505,6 +506,50 @@ class LmEngine:
             metrics.register_weakref_gauge("lm.spec_accept_rate", self,
                                            spec_accept, labels=labels)
 
+        # hbm attribution plane (obs/hbm.py): the LM plane's device-memory
+        # owners claim their bytes in the subsystem ledger. The pool claims
+        # itself (kv/pool.py), so the engine claims dense KV only — a paged
+        # engine claiming pool bytes here would double count.
+        from symbiont_tpu.models.quant import param_bytes
+
+        hbm_ledger.claim("lm.params", self,
+                         lambda lm: param_bytes(lm.params))
+        if self._draft is not None:
+            hbm_ledger.claim(
+                "lm.drafter", self,
+                lambda lm: (param_bytes(lm._draft[0])
+                            if lm._draft is not None else 0))
+        if self.pool is None:
+            def dense_kv_bytes(lm):
+                with lm._sessions_lock:
+                    sessions = list(lm._sessions)
+                return sum(gpt_mod.cache_bytes(s._cache) for s in sessions
+                           if not s.done())
+
+            hbm_ledger.claim("lm.kv_cache", self, dense_kv_bytes)
+        metrics.register_weakref_gauge(
+            "lm.hbm_headroom_bytes", self,
+            # returning None PERMANENTLY retires the gauge — exactly right
+            # on CPU (no memory accounting, ever); on TPU/GPU the reader
+            # always has stats and None never fires
+            lambda lm: lm.hbm_headroom_bytes(), labels=labels)
+
+    def hbm_headroom_bytes(self) -> Optional[int]:
+        """Free device bytes on the tightest local device — bytes_limit
+        minus bytes_in_use off the (memoized) runtime stats. None where
+        the backend reports no memory accounting (CPU): callers must skip
+        the bytes forecast there, not treat it as zero headroom."""
+        from symbiont_tpu.obs.device import local_device_stats
+
+        headroom = None
+        for _idx, _platform, stats in local_device_stats():
+            limit, in_use = stats.get("bytes_limit"), stats.get("bytes_in_use")
+            if limit is None or in_use is None:
+                continue
+            free = max(0, int(limit) - int(in_use))
+            headroom = free if headroom is None else min(headroom, free)
+        return headroom
+
     def _note_param_bytes(self, params, storage) -> None:
         """Dtype-labeled at-rest parameter bytes (docs/OBSERVABILITY.md) —
         the LM half of the quantization plane's byte budget."""
@@ -697,6 +742,30 @@ class LmEngine:
                         task_id: Optional[str] = None,
                         stream: bool = True,
                         resume: Optional[dict] = None):
+        """Thin OOM-forensics shell over ``_generate_stream_impl`` (which
+        carries the real contract — see its docstring): every advance of
+        the underlying generator runs under the hbm plane's guard, so a
+        RESOURCE_EXHAUSTED out of any prefill/chunk dispatch dumps the
+        postmortem and counts engine.oom_total{site="lm.generate_stream"}
+        before propagating to the stream's consumer unchanged."""
+        gen = self._generate_stream_impl(
+            prompt, max_new_tokens, temperature=temperature, top_k=top_k,
+            tenant=tenant, task_id=task_id, stream=stream, resume=resume)
+        while True:
+            try:
+                with guard_oom("lm.generate_stream"):
+                    item = next(gen)
+            except StopIteration:
+                return
+            yield item
+
+    def _generate_stream_impl(self, prompt: str, max_new_tokens: int,
+                              temperature: Optional[float] = None,
+                              top_k: Optional[int] = None,
+                              tenant: Optional[str] = None,
+                              task_id: Optional[str] = None,
+                              stream: bool = True,
+                              resume: Optional[dict] = None):
         """Streaming decode: yields text deltas as chunks of tokens finish
         (SURVEY.md §7 hard part #5: "streaming tokens back out through
         NATS→SSE"). Prefill + one compiled chunk-scan executable per
@@ -984,7 +1053,8 @@ class LmEngine:
                             "lm.spec_first[B=1]", t_d - t1)
                     # the round's out/counted/emitted materialization above
                     # is the stream's one allowlisted device->host sync
-                    dispatch_ledger.note_host_sync("LmEngine.generate_stream")
+                    dispatch_ledger.note_host_sync(
+                        "LmEngine._generate_stream_impl")
                     slots_used += S
                     self._spec_proposed += self.spec_k
                     self._spec_accepted += max(0, n_emit - 1)
@@ -1023,7 +1093,8 @@ class LmEngine:
                         f"chunk={c_n}]", dt1)
                     # the chunk-boundary toks/counted materialization above
                     # is the stream's one allowlisted device->host sync
-                    dispatch_ledger.note_host_sync("LmEngine.generate_stream")
+                    dispatch_ledger.note_host_sync(
+                        "LmEngine._generate_stream_impl")
                     slots_used += c_n
                     chunk_start = len(all_tokens)
                     for t, c in zip(toks, counted):
@@ -1155,7 +1226,17 @@ class LmEngine:
         quote is fresh pages needed (worst-case by default; exact, radix
         hits deducted, when `prompts`/`max_new_tokens` are passed) against
         free + LRU-evictable pages minus what admitted rows may still
-        lazily claim. The row cap still applies on top when set."""
+        lazily claim. The row cap still applies on top when set.
+
+        On devices that report memory accounting, a BYTES forecast runs
+        beside the page/row quotes (obs/hbm.py): admitting `n_rows` costs
+        their KV bytes, and the dispatch that serves them needs the
+        largest known lm.* executable's temp (activation scratch) bytes —
+        both must fit the tightest device's headroom. The page quote
+        guards the pool; this guards everything the pool doesn't see
+        (activation scratch, dense slabs, other subsystems' growth). On
+        CPU (headroom None) the forecast is skipped entirely, so test and
+        dev behavior is byte-for-byte the old quote."""
         if self.pool is not None:
             need = self._pages_needed(max(1, int(n_rows)), prompts,
                                       max_new_tokens)
@@ -1164,9 +1245,38 @@ class LmEngine:
                          - self.pages_reserved())
             if need > avail:
                 return False
+        headroom = self.hbm_headroom_bytes()
+        if headroom is not None:
+            need_bytes = self._admit_bytes_forecast(max(1, int(n_rows)))
+            if need_bytes > headroom:
+                metrics.inc("lm.admit_hbm_rejects")
+                return False
         if max_kv_rows <= 0:
             return True
         return self.kv_rows_allocated() + max(1, int(n_rows)) <= max_kv_rows
+
+    def _admit_bytes_forecast(self, n_rows: int) -> int:
+        """Fresh HBM `n_rows` admissions may need: worst-case dense KV
+        slab bytes per row (paged rows allocate from the already-resident
+        pool — zero fresh bytes) plus the largest known lm.* executable
+        temp footprint (the activation scratch the serving dispatch will
+        ask the allocator for)."""
+        from symbiont_tpu.obs.hbm import peak_temp_bytes
+
+        kv_fresh = 0
+        if self.pool is None:
+            cfg = self.config
+            new_b = max(cfg.new_token_buckets)
+            cap = self.model_cfg.max_position_embeddings - new_b
+            usable = [b for b in cfg.prompt_buckets if b <= cap]
+            T = (usable[-1] if usable else max(cap, 1)) + new_b
+            # [2, layers, T, kv_heads, head_dim] at cache dtype, per row
+            itemsize = (1 if self.model_cfg.kv_quant == "int8"
+                        else np.dtype(self.model_cfg.dtype).itemsize)
+            kv_fresh = (2 * self.model_cfg.num_layers * T
+                        * self.model_cfg.kv_heads * self.model_cfg.head_dim
+                        * itemsize) * n_rows
+        return kv_fresh + peak_temp_bytes("lm.")
 
     def update_params(self, params) -> None:
         """Swap in new model parameters (online fine-tune sync,
@@ -1992,17 +2102,24 @@ class BatchSession:
         [(tag, text), ...] for every request that finished in it (eos, its
         own budget, or the session cap). The spec/plain choice is re-made
         every chunk boundary, so a session degrades AND re-enters
-        speculation as margins, splices, and drafter quality dictate."""
-        if self.done():
-            return self._drain_all()
-        if (self._spec_on and self._d_cache is not None
-                and self._spec_margin_ok()):
-            return self._step_spec()
-        if self._pending is not None:
-            self._to_plain()
-            if self.done():  # the ingest slot was the session's last one
+        speculation as margins, splices, and drafter quality dictate.
+
+        Runs under the OOM guard (obs/hbm.py): a RESOURCE_EXHAUSTED out of
+        any step dispatch dumps the hbm postmortem (ledger + census + last
+        timeline window), counts engine.oom_total{site="lm.batch_step"},
+        and re-raises — the batcher's existing error path fails the
+        affected requests and the engine keeps serving."""
+        with guard_oom("lm.batch_step"):
+            if self.done():
                 return self._drain_all()
-        return self._step_plain()
+            if (self._spec_on and self._d_cache is not None
+                    and self._spec_margin_ok()):
+                return self._step_spec()
+            if self._pending is not None:
+                self._to_plain()
+                if self.done():  # the ingest slot was the session's last one
+                    return self._drain_all()
+            return self._step_plain()
 
     def _spec_margin_ok(self) -> bool:
         """Slot-margin guard: a spec round may only run while the WORST

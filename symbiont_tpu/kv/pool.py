@@ -110,6 +110,17 @@ class PagePool:
                                    lambda p: 0.0, labels=self.labels)
         for name in COUNTERS:
             reg.inc(name, 0, labels=self.labels)
+        # hbm attribution plane (obs/hbm.py): the pool claims its full
+        # preallocated device bytes; the radix-retained slice is an
+        # OVERLAY (a view INSIDE the pool claim, reported but excluded
+        # from the attribution sum — counting it twice would overstate)
+        from symbiont_tpu.obs.hbm import hbm_ledger
+
+        hbm_ledger.claim("kv.page_pool", self, lambda p: p.device_bytes)
+        hbm_ledger.claim(
+            "kv.radix_retained", self,
+            lambda p: int(p.pages_retained * p.device_bytes / p.n_pages),
+            overlay=True)
 
     @property
     def pages_free(self) -> int:

@@ -61,6 +61,14 @@ class VectorStore:
         self._warmed_capacity = None  # capacity warm_fused last compiled for
         self._wal_file = None
         self.last_load_skipped_lines = 0  # corrupt WAL lines on last load()
+        # hbm attribution plane (obs/hbm.py): the device-resident corpus
+        # claims its padded bytes — .nbytes is host metadata, no sync
+        from symbiont_tpu.obs.hbm import hbm_ledger
+
+        hbm_ledger.claim(
+            "memory.corpus", self,
+            lambda vs: (0 if vs._device_corpus is None
+                        else int(vs._device_corpus.nbytes)))
         if self.config.data_dir:
             Path(self.config.data_dir).mkdir(parents=True, exist_ok=True)
             self.load()

@@ -92,7 +92,9 @@ def export_timeline(trace_id: str, spans: Sequence[SpanRecord],
       will reclaim — ``lm.kv_stranded_rows`` over time);
     - ``engine.queue.<kind>`` — batcher queue depth samples;
     - ``embed.flush_tokens`` — real vs padding token slots per dispatched
-      embed batch (the packing-opportunity series).
+      embed batch (the packing-opportunity series);
+    - ``hbm.subsystem_bytes`` — per-subsystem device-memory claims from
+      the hbm ledger (obs/hbm.py), sampled at decode chunk boundaries.
 
     Admit / finish / cancel land as instant events (``ph: "i"``) on the
     counters' process lane. Determinism: the span half is exactly
@@ -133,6 +135,12 @@ def export_timeline(trace_id: str, spans: Sequence[SpanRecord],
             counter("embed.flush_tokens", t, {
                 "real": ev["real_tokens"],
                 "padding": ev["total_tokens"] - ev["real_tokens"]})
+        elif kind == "mem":
+            # per-subsystem HBM ledger sample (obs/hbm.py): every non-meta
+            # key is a subsystem's byte claim — one stacked-area track
+            series = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+            if series:
+                counter("hbm.subsystem_bytes", t, series)
         elif kind in ("admit", "finish", "cancel"):
             args = {k: v for k, v in ev.items() if k not in ("kind", "t")}
             instant(f"decode.{kind}", t, args)

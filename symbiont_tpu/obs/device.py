@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 from symbiont_tpu.obs.trace_store import SpanRecord, trace_store
 from symbiont_tpu.utils.ids import generate_uuid
@@ -46,6 +47,69 @@ _DEVICE_SERIES = (
     ("device.peak_bytes_in_use", "peak_bytes_in_use"),
     ("device.bytes_limit", "bytes_limit"),
 )
+
+
+class _DeviceStatsCache:
+    """One ``dev.memory_stats()`` runtime call per device per scrape pass.
+
+    The three ``device.*`` gauges per device are independent registry
+    callbacks, so a scrape used to hit the runtime 3× per device; the
+    hbm attribution plane adds more readers on top. This cache collapses
+    them: the first reader inside a ``max_age_s`` window pays the runtime
+    call, the rest share the dict. A RAISE from the runtime propagates
+    (never cached) — that keeps the registry's skip-this-scrape contract;
+    an EMPTY result is cached like any other (the retire signal must be
+    just as cheap to agree on)."""
+
+    def __init__(self, max_age_s: float = 0.25):
+        self.max_age_s = max_age_s
+        self._lock = threading.Lock()
+        self._by_dev: Dict[int, Tuple[float, dict]] = {}
+
+    def stats(self, dev, max_age_s: Optional[float] = None) -> dict:
+        ttl = self.max_age_s if max_age_s is None else max_age_s
+        key = id(dev)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._by_dev.get(key)
+            if hit is not None and now - hit[0] <= ttl:
+                return hit[1]
+        s = dev.memory_stats()  # raises → propagate uncached
+        s = dict(s) if s else {}
+        with self._lock:
+            self._by_dev[key] = (now, s)
+        return s
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._by_dev.clear()
+
+
+_stats_cache = _DeviceStatsCache()
+
+
+def local_device_stats(max_age_s: Optional[float] = None
+                       ) -> List[Tuple[int, str, dict]]:
+    """``[(index, platform, memory_stats_dict), ...]`` for every local
+    device that reports memory accounting — the shared read both the
+    hbm ledger's ``reconcile()`` and ``lm.hbm_headroom_bytes`` sit on.
+    CPU-only / no-jax / backend-down all degrade to ``[]``."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:
+        log.debug("local device stats unavailable: %s", e)
+        return []
+    out = []
+    for i, dev in enumerate(devices):
+        try:
+            stats = _stats_cache.stats(dev, max_age_s=max_age_s)
+        except Exception:
+            continue
+        if stats:
+            out.append((i, str(dev.platform), stats))
+    return out
 
 
 def record_compile_event(name: str, duration_s: float,
@@ -73,7 +137,7 @@ def register_device_gauges(registry: Optional[Metrics] = None) -> int:
     n = 0
     for i, dev in enumerate(devices):
         try:
-            stats = dev.memory_stats()
+            stats = _stats_cache.stats(dev)
         except Exception:
             stats = None
         if not stats:
@@ -87,7 +151,10 @@ def register_device_gauges(registry: Optional[Metrics] = None) -> int:
                 # transient backend hiccup must not return None, which is
                 # the PERMANENT-retirement signal. Only a backend that
                 # stops reporting stats altogether retires the gauge.
-                s = dev.memory_stats()
+                # The cache bounds a scrape pass to ONE memory_stats()
+                # runtime call per device, shared across the 3 series
+                # (and the hbm plane's readers).
+                s = _stats_cache.stats(dev)
                 return None if not s else s.get(key)
             return fn
 

@@ -55,13 +55,21 @@ from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
 #   resume — an orphaned generation session adopted from a dead worker's
 #            journal tail (resilience/genlog.py): prefix tokens
 #            re-prefilled, prefill ms
-STEP, ADMIT, FINISH, CANCEL, QUEUE, FLUSH, RESUME = (
-    "step", "admit", "finish", "cancel", "queue", "flush", "resume")
+#   mem    — a per-subsystem HBM ledger sample (obs/hbm.py), taken at a
+#            decode chunk boundary at most every _MEM_SAMPLE_S seconds:
+#            {subsystem: bytes} — the Perfetto memory counter track
+STEP, ADMIT, FINISH, CANCEL, QUEUE, FLUSH, RESUME, MEM = (
+    "step", "admit", "finish", "cancel", "queue", "flush", "resume", "mem")
 
 # prompt tokens kept per registry entry for the prefix probe: overlap past
 # this depth is counted as full-depth (the radix cache would share at least
 # this much) — bounds the per-admit comparison cost
 _PREFIX_DEPTH = 128
+
+# minimum seconds between hbm-ledger samples on the decode path: chunk
+# boundaries arrive every few ms, byte totals move per admit/finish —
+# sampling each boundary would be all cost, no signal
+_MEM_SAMPLE_S = 0.5
 
 
 class EngineTimeline:
@@ -87,6 +95,7 @@ class EngineTimeline:
         self._flushes: deque = deque(maxlen=128)
         self._flush_real = 0
         self._flush_total = 0
+        self._last_mem_t = 0.0  # last hbm-ledger sample (monotonic)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -181,6 +190,32 @@ class EngineTimeline:
             ev["spec_verify_ms"] = float(spec_verify_ms or 0.0)
             ev["spec_proposed"] = int(spec_proposed)
             ev["spec_accepted"] = int(spec_accepted or 0)
+        self._append(ev)
+        self._maybe_note_memory()
+
+    def _maybe_note_memory(self) -> None:
+        """Sample the hbm ledger into the ring at most every
+        _MEM_SAMPLE_S — the per-subsystem memory counter track in the
+        Perfetto export. Rate-limited AND cached on the ledger side
+        (rows(max_age_s) shares one reader pass), so the decode chunk
+        boundary pays a dict copy, not a ledger walk, almost always."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_mem_t < _MEM_SAMPLE_S:
+                return
+            self._last_mem_t = now
+        try:
+            from symbiont_tpu.obs.hbm import hbm_ledger
+
+            rows = hbm_ledger.rows(max_age_s=_MEM_SAMPLE_S)
+        except Exception:
+            return
+        if not rows:
+            return
+        ev = {"kind": MEM, "t": time.time()}
+        for r in rows:
+            if not r["overlay"]:
+                ev[r["subsystem"]] = r["bytes"]
         self._append(ev)
 
     def note_admit(self, rows: int, prefill_ms: float,

@@ -80,8 +80,12 @@ ROLLUP_GAUGE_PREFIXES = (
     "gauge.lm.kv_rows_allocated",
     "gauge.admission.queued",
     "gauge.api.sse_clients",
+    "gauge.lm.hbm_headroom_bytes",
     "counter.runner.heartbeats",
     "counter.bus.consumed",
+    # OOM verdicts per role (obs/hbm.py forensics): a device allocator
+    # failure anywhere in the fleet shows on the one-page roll-up
+    "counter.engine.oom_total",
 )
 ROLLUP_MAX_SERIES = 32
 

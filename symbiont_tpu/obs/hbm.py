@@ -1,0 +1,566 @@
+"""Device-memory attribution plane (the bytes axis of the obs layer).
+
+The time axis is fully instrumented (flight recorder, engine timeline,
+the PR 17 dispatch ledger) but until now the bytes axis was not:
+``obs/device.py`` reports whole-device ``memory_stats()`` totals while
+params / KV / corpus bytes live in scattered per-subsystem gauges, so
+nobody could say what actually fills HBM — yet the decode-role
+autoscaler wants headroom on REAL device memory, corpus tiering needs a
+bytes-per-subsystem budget to promote against, and a full on-device run
+hits capacity walls blind. Demystifying BERT (arxiv 2104.08335) shows
+memory capacity/bandwidth, not FLOPs, sizes accelerator deployments;
+LightSeq (arxiv 2010.13887) attributes much of its serving win to
+explicit device-memory accounting. Four surfaces, one module:
+
+* **Subsystem byte ledger** (``HbmLedger``) — each device-memory owner
+  (engine params, LM params, drafter, KV page pool, dense KV slabs,
+  device-resident corpus shards) registers a weakref-bound byte claim at
+  its existing byte-gauge site; ``reconcile()`` sums the claims against
+  per-device ``memory_stats()`` (live-array totals where the backend
+  reports none — CPU) and reports the residual as
+  ``hbm.unattributed_bytes{device}``. Served at ``GET /api/memory``
+  (fleet-federated per role — the gauges ride the ordinary telemetry
+  exporter). ``overlay=True`` claims (radix-retained pages — a SUBSET of
+  the pool's bytes) are reported but excluded from the attribution sum,
+  so shared bytes are never double-counted.
+
+* **Live-array census** (``census()`` / ``census_diff()``) — aggregates
+  ``jax.live_arrays()`` by (shape, dtype, sharding); the diff mode turns
+  "HBM grew 2 GiB since the last look" into the owning allocation group.
+  On-demand and host-side only (array METADATA — ``.nbytes``/``.shape``
+  — never a device sync): ``GET /api/memory/census`` and the leak tests
+  are the callers, nothing on the hot path.
+
+* **Per-executable static footprints** — ``obs/xprof.py`` joins
+  ``compiled.memory_analysis()`` (temp / argument / output bytes) into
+  the dispatch ledger at the engine's compile seam; this module's
+  ``peak_temp_bytes()`` helper reads the ledger back as the
+  peak-activation estimate ``can_admit``'s bytes forecast adds to its
+  page quote.
+
+* **OOM forensics** (``OomForensics``) — the engine dispatch seams wrap
+  in ``guard_oom(site)``: a ``RESOURCE_EXHAUSTED`` escaping a dispatch
+  dumps ledger + census + the last engine-timeline window to a bounded
+  postmortem file, counts ``engine.oom_total{site}``, and surfaces the
+  verdict in ``GET /api/fleet`` — then re-raises, because the caller's
+  error path (not the profiler) owns recovery.
+
+Layering: imports only utils.telemetry at module level; device stats /
+timeline / census pulls are lazy so the module sits below the whole
+engine plane. Process-global singletons (``hbm_ledger``,
+``oom_forensics``) are configured by the runner at boot, same pattern as
+``dispatch_ledger`` / ``engine_timeline``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import weakref
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+from symbiont_tpu.utils.telemetry import Metrics, metrics as _global_metrics
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "HbmLedger",
+    "OomForensics",
+    "census",
+    "census_diff",
+    "guard_oom",
+    "hbm_ledger",
+    "is_oom",
+    "oom_forensics",
+]
+
+# census groups carried in API responses / postmortems past which the
+# tail is summed into one "(other)" row — bounded output, counted drop
+DEFAULT_CENSUS_GROUPS = 64
+
+
+# --------------------------------------------------------------------- ledger
+
+
+class HbmLedger:
+    """Process-wide subsystem → device-bytes claim table.
+
+    A claim is ``(subsystem, owner, reader)``: the ledger holds a WEAKREF
+    of the owner and calls ``reader(owner)`` at read time — a dead engine
+    (tests churn through dozens) silently retires its claims, exactly the
+    ``register_weakref_gauge`` contract. Multiple owners may claim the
+    same subsystem (two live engines during a param swap); their bytes
+    sum. Readers must be host-side only: object attributes, ``.nbytes``
+    metadata, free-list counters — never a device sync.
+    """
+
+    def __init__(self, registry: Optional[Metrics] = None):
+        self.registry = registry if registry is not None else _global_metrics
+        self._lock = threading.Lock()
+        # (subsystem, owner-key) -> (weakref-or-None, reader, overlay)
+        self._claims: Dict[Tuple[str, int], tuple] = {}
+        self._enabled = True
+        # the census row bound API responses and postmortems apply
+        # (ObsConfig.hbm_census_groups, set by the runner at boot)
+        self.census_groups = DEFAULT_CENSUS_GROUPS
+        # bounded read-side cache: ledger rows feed the engine-timeline
+        # memory track at chunk boundaries — one reader pass per max_age
+        # window, not one per chunk
+        self._cache: Optional[Tuple[float, List[dict]]] = None
+
+    def configure(self, enabled: bool = True,
+                  census_groups: Optional[int] = None) -> None:
+        with self._lock:
+            self._enabled = bool(enabled)
+            if census_groups is not None:
+                self.census_groups = max(1, int(census_groups))
+            self._cache = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._claims.clear()
+            self._cache = None
+
+    def claim(self, subsystem: str, owner, reader: Callable,
+              overlay: bool = False) -> None:
+        """Register (or replace) ``owner``'s byte claim for ``subsystem``.
+
+        ``reader(owner)`` returns current bytes (int) or None to retire.
+        ``overlay=True`` reports the line without adding it to the
+        attribution sum — for views over bytes another claim already owns
+        (radix-retained pages live INSIDE the page pool's claim)."""
+        ref = weakref.ref(owner)
+        with self._lock:
+            self._claims[(str(subsystem), id(owner))] = (ref, reader,
+                                                         bool(overlay))
+            self._cache = None
+
+    def claim_value(self, subsystem: str, nbytes: int,
+                    overlay: bool = False) -> None:
+        """Ownerless static claim (boot-time constants); 0 removes it."""
+        key = (str(subsystem), 0)
+        with self._lock:
+            if nbytes:
+                self._claims[key] = (None, (lambda n=int(nbytes): n),
+                                     bool(overlay))
+            else:
+                self._claims.pop(key, None)
+            self._cache = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._claims)
+
+    def rows(self, max_age_s: float = 0.0) -> List[dict]:
+        """Per-subsystem byte rows, largest first. Readers run OUTSIDE the
+        ledger lock (they may take engine/pool locks — same deadlock
+        stance as telemetry._eval_gauge_fns); dead owners retire."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._enabled:
+                return []
+            if (max_age_s > 0.0 and self._cache is not None
+                    and now - self._cache[0] <= max_age_s):
+                return [dict(r) for r in self._cache[1]]
+            claims = dict(self._claims)
+        per: Dict[str, List[float]] = {}
+        dead = []
+        for key, (ref, reader, overlay) in claims.items():
+            try:
+                if ref is None:
+                    v = reader()
+                else:
+                    owner = ref()
+                    v = None if owner is None else reader(owner)
+            except Exception:
+                log.debug("hbm claim %s failed this read", key[0],
+                          exc_info=True)
+                continue  # transient failure: skip this read, keep claim
+            if v is None:
+                dead.append(key)
+                continue
+            agg = per.setdefault(key[0], [0.0, overlay])
+            agg[0] += float(v)
+            agg[1] = agg[1] and overlay
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._claims.pop(key, None)
+        rows = [{"subsystem": name, "bytes": int(v), "overlay": bool(ov)}
+                for name, (v, ov) in per.items()]
+        rows.sort(key=lambda r: (-r["bytes"], r["subsystem"]))
+        with self._lock:
+            self._cache = (now, [dict(r) for r in rows])
+        return rows
+
+    def attributed_bytes(self, rows: Optional[List[dict]] = None) -> int:
+        """Sum of non-overlay claims — the bytes the ledger can explain."""
+        if rows is None:
+            rows = self.rows()
+        return sum(r["bytes"] for r in rows if not r["overlay"])
+
+    def reconcile(self, census_rows: int = 0) -> dict:
+        """Claims vs reality, per device. The basis is per-device
+        ``memory_stats()['bytes_in_use']`` where the backend reports it;
+        where it reports nothing (CPU) the basis falls back to the
+        live-array census totals — same residual question, softer
+        denominator (it misses backend-internal scratch). The residual is
+        what nobody claimed: ``hbm.unattributed_bytes``."""
+        rows = self.rows()
+        attributed = self.attributed_bytes(rows)
+        devices = []
+        stats_total = 0
+        try:
+            from symbiont_tpu.obs.device import local_device_stats
+
+            for idx, platform, stats in local_device_stats():
+                in_use = stats.get("bytes_in_use")
+                if in_use is None:
+                    continue
+                devices.append({"device": idx, "platform": platform,
+                                "bytes_in_use": int(in_use),
+                                "bytes_limit": stats.get("bytes_limit"),
+                                "peak_bytes_in_use":
+                                    stats.get("peak_bytes_in_use")})
+                stats_total += int(in_use)
+        except Exception:
+            log.debug("device stats unavailable for reconcile",
+                      exc_info=True)
+        cen = None
+        if not devices:
+            cen = census(top=max(0, int(census_rows)))
+        if devices:
+            basis, basis_total = "memory_stats", stats_total
+        elif cen and cen.get("available"):
+            basis, basis_total = "live_arrays", int(cen["bytes_total"])
+        else:
+            basis, basis_total = "none", 0
+        unattributed = max(0, basis_total - attributed)
+        out = {
+            "basis": basis,
+            "bytes_in_use": basis_total,
+            "attributed_bytes": attributed,
+            "unattributed_bytes": unattributed,
+            "unattributed_pct": (
+                round(100.0 * unattributed / basis_total, 2)
+                if basis_total else 0.0),
+            "subsystems": rows,
+            "devices": devices,
+        }
+        for d in devices:
+            # per-device residual: claims are process-wide (replicated
+            # params claim their LOGICAL bytes once), so apportion the
+            # attributed sum by each device's share of bytes in use —
+            # exact on the common one-device-per-role deployment
+            share = (d["bytes_in_use"] / stats_total) if stats_total else 0.0
+            d["unattributed_bytes"] = max(
+                0, int(d["bytes_in_use"] - attributed * share))
+        if census_rows and cen is None:
+            out["census"] = census(top=int(census_rows))
+        elif census_rows and cen is not None:
+            out["census"] = cen
+        return out
+
+    # ----------------------------------------------------------- metrics tie
+
+    def register_gauges(self, registry: Optional[Metrics] = None) -> None:
+        """Scrapeable ledger: one ``hbm.attributed_bytes{subsystem}``
+        gauge per known subsystem plus ``hbm.unattributed_bytes{device}``
+        per stats-reporting device. Registered at boot by the runner; the
+        per-subsystem family is served through ONE callback that refreshes
+        the bounded row cache — a scrape costs one ledger pass, not one
+        per subsystem."""
+        registry = registry or self.registry
+
+        def sub_reader(name: str):
+            def fn():
+                for r in self.rows(max_age_s=1.0):
+                    if r["subsystem"] == name:
+                        return r["bytes"]
+                return 0
+            return fn
+
+        # families known at registration time; later claims appear on the
+        # next register_gauges pass (runner boots call this once after the
+        # engine plane is up) and are always visible via GET /api/memory
+        for r in self.rows():
+            registry.register_gauge("hbm.attributed_bytes",
+                                    sub_reader(r["subsystem"]),
+                                    labels={"subsystem": r["subsystem"]})
+
+        def unattributed():
+            rec = self.reconcile()
+            return (rec["unattributed_bytes"]
+                    if rec["basis"] == "memory_stats" else None)
+
+        try:
+            from symbiont_tpu.obs.device import local_device_stats
+
+            reporting = list(local_device_stats())
+        except Exception:
+            reporting = []
+        if reporting:
+            # one process-total residual series per device label set; a
+            # backend that stops reporting stats retires it (None)
+            for idx, platform, _stats in reporting:
+                registry.register_gauge(
+                    "hbm.unattributed_bytes", unattributed,
+                    labels={"device": str(idx), "platform": str(platform)})
+
+    def register_zero(self, registry: Optional[Metrics] = None) -> None:
+        """Zero-register the hbm families at boot so the doc-drift sweep
+        (and /metrics) sees them before any subsystem claims bytes."""
+        registry = registry or self.registry
+        registry.gauge_set("hbm.attributed_bytes", 0,
+                           labels={"subsystem": "all"})
+
+
+# --------------------------------------------------------------------- census
+
+
+def _sharding_label(a) -> str:
+    try:
+        s = a.sharding
+    except Exception:
+        return "unknown"
+    name = type(s).__name__
+    try:
+        n = len(s.device_set)
+    except Exception:
+        return name
+    return name if n <= 1 else f"{name}x{n}"
+
+
+def census(top: int = DEFAULT_CENSUS_GROUPS) -> dict:
+    """Aggregate ``jax.live_arrays()`` by (shape, dtype, sharding).
+
+    Host-side metadata only (``.shape``/``.dtype``/``.nbytes`` — no
+    device sync) and on-demand only (API / bench / postmortem callers);
+    returns ``{"available": False}`` where jax or the API is absent.
+    ``top`` > 0 bounds the group rows; the tail folds into "(other)"."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception as e:
+        return {"available": False, "detail": str(e)}
+    groups: Dict[Tuple, List[int]] = {}
+    total = n = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            key = (tuple(int(d) for d in a.shape), str(a.dtype),
+                   _sharding_label(a))
+        except Exception:
+            continue  # a deleted/donated buffer mid-iteration
+        g = groups.setdefault(key, [0, 0])
+        g[0] += 1
+        g[1] += nbytes
+        total += nbytes
+        n += 1
+    rows = [{"shape": list(k[0]), "dtype": k[1], "sharding": k[2],
+             "count": c, "bytes": b} for k, (c, b) in groups.items()]
+    rows.sort(key=lambda r: (-r["bytes"], r["dtype"], r["shape"]))
+    out = {"available": True, "arrays": n, "bytes_total": total,
+           "group_count": len(rows)}
+    if top and len(rows) > int(top):
+        head, tail = rows[:int(top)], rows[int(top):]
+        head.append({"shape": [], "dtype": "(other)", "sharding": "",
+                     "count": sum(r["count"] for r in tail),
+                     "bytes": sum(r["bytes"] for r in tail)})
+        rows = head
+    out["groups"] = rows
+    return out
+
+
+def census_diff(before: dict, after: dict,
+                top: int = DEFAULT_CENSUS_GROUPS) -> dict:
+    """What changed between two censuses — "HBM grew 2 GiB" becomes the
+    owning (shape, dtype, sharding) group. Rows carry byte and count
+    deltas, growth first; unchanged groups are omitted."""
+    def keyed(c: dict) -> Dict[Tuple, Tuple[int, int]]:
+        return {(tuple(r["shape"]), r["dtype"], r["sharding"]):
+                (r["count"], r["bytes"])
+                for r in c.get("groups", []) if r["dtype"] != "(other)"}
+
+    if not (before.get("available") and after.get("available")):
+        return {"available": False}
+    b, a = keyed(before), keyed(after)
+    rows = []
+    for key in set(b) | set(a):
+        cb, bb = b.get(key, (0, 0))
+        ca, ba = a.get(key, (0, 0))
+        if ba == bb and ca == cb:
+            continue
+        rows.append({"shape": list(key[0]), "dtype": key[1],
+                     "sharding": key[2], "count_delta": ca - cb,
+                     "bytes_delta": ba - bb})
+    rows.sort(key=lambda r: -r["bytes_delta"])
+    return {
+        "available": True,
+        "bytes_delta": after["bytes_total"] - before["bytes_total"],
+        "array_delta": after["arrays"] - before["arrays"],
+        "groups": rows[:int(top)] if top else rows,
+    }
+
+
+# ------------------------------------------------------- executable footprint
+
+
+def peak_temp_bytes(prefix: str = "") -> int:
+    """Largest known per-dispatch temp (activation scratch) footprint
+    among the dispatch ledger's executables, optionally filtered by
+    signature prefix (``"lm."`` → the decode plane's). The bytes half of
+    ``can_admit``'s forecast: admitting work whose executable needs more
+    temp HBM than the headroom left is an OOM with extra steps."""
+    from symbiont_tpu.obs.xprof import dispatch_ledger
+
+    best = 0
+    for row in dispatch_ledger.snapshot():
+        if prefix and not row["executable"].startswith(prefix):
+            continue
+        t = row.get("temp_bytes")
+        if t:
+            best = max(best, int(t))
+    return best
+
+
+# ------------------------------------------------------------- OOM forensics
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "resource_exhausted", "out of memory",
+                "Out of memory")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like a device allocator failure? String
+    match on the XLA status — the runtime error type is backend-private
+    (jaxlib XlaRuntimeError), and ``RESOURCE_EXHAUSTED`` is the stable
+    part of the contract. PoolExhausted (our own paged-KV admission
+    signal) is NOT an OOM and never matches."""
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+class OomForensics:
+    """Bounded postmortem writer + verdict holder for device OOMs.
+
+    ``record(site, exc)`` is called from a dispatch seam's except block:
+    it counts ``engine.oom_total{site}``, dumps ledger + census + the
+    last engine-timeline window + device stats to one JSON file under
+    ``postmortem_dir`` (keeping at most ``max_files`` — newest win), and
+    remembers the verdict for ``GET /api/fleet``. It NEVER raises: the
+    original OOM is already propagating and must arrive unreplaced."""
+
+    def __init__(self, registry: Optional[Metrics] = None):
+        self.registry = registry if registry is not None else _global_metrics
+        self._lock = threading.Lock()
+        self._dir = "/tmp/symbiont_hbm"
+        self._max_files = 4
+        self._enabled = True
+        self._seq = 0
+        self._last: Optional[dict] = None
+
+    def configure(self, postmortem_dir: Optional[str] = None,
+                  max_files: Optional[int] = None,
+                  enabled: Optional[bool] = None) -> None:
+        with self._lock:
+            if postmortem_dir:
+                self._dir = str(postmortem_dir)
+            if max_files is not None:
+                self._max_files = max(1, int(max_files))
+            if enabled is not None:
+                self._enabled = bool(enabled)
+
+    @property
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+    def register_zero(self, registry: Optional[Metrics] = None) -> None:
+        (registry or self.registry).inc("engine.oom_total", 0,
+                                        labels={"site": "all"})
+
+    def _prune_locked(self) -> None:
+        try:
+            files = sorted(
+                f for f in os.listdir(self._dir)
+                if f.startswith("oom_") and f.endswith(".json"))
+        except OSError:
+            return
+        for f in files[:-self._max_files]:
+            try:
+                os.unlink(os.path.join(self._dir, f))
+            except OSError:
+                pass
+
+    def record(self, site: str, exc: BaseException) -> Optional[str]:
+        """One device OOM at ``site``. Returns the postmortem path (None
+        when disabled or the write failed — the counter still counts)."""
+        self.registry.inc("engine.oom_total", labels={"site": site})
+        with self._lock:
+            if not self._enabled:
+                return None
+            self._seq += 1
+            seq = self._seq
+        report = {
+            "site": site,
+            "ts": round(time.time(), 3),
+            "error": str(exc)[:2000],
+            "error_type": type(exc).__name__,
+        }
+        # every section best-effort: a postmortem must degrade, not raise
+        try:
+            report["memory"] = hbm_ledger.reconcile()
+        except Exception:
+            log.debug("oom postmortem: reconcile failed", exc_info=True)
+        try:
+            report["census"] = census(top=32)
+        except Exception:
+            log.debug("oom postmortem: census failed", exc_info=True)
+        try:
+            from symbiont_tpu.obs.engine_timeline import engine_timeline
+
+            report["timeline_tail"] = engine_timeline.events()[-128:]
+        except Exception:
+            log.debug("oom postmortem: timeline failed", exc_info=True)
+        path = None
+        try:
+            with self._lock:
+                os.makedirs(self._dir, exist_ok=True)
+                path = os.path.join(self._dir, f"oom_{seq:04d}.json")
+                with open(path, "w") as fh:
+                    json.dump(report, fh, default=str)
+                self._prune_locked()
+        except Exception:
+            log.warning("oom postmortem write failed", exc_info=True)
+            path = None
+        verdict = {"site": site, "ts": report["ts"],
+                   "error": report["error"][:200], "postmortem": path}
+        with self._lock:
+            self._last = verdict
+        log.error("device OOM at %s — postmortem %s", site, path)
+        return path
+
+
+@contextmanager
+def guard_oom(site: str):
+    """Wrap one dispatch seam: a RESOURCE_EXHAUSTED escaping the body is
+    recorded (postmortem + counter) and re-raised unchanged — the engine
+    keeps serving because its caller's error path runs exactly as before.
+    Non-OOM exceptions pass straight through untouched."""
+    try:
+        yield
+    except BaseException as e:
+        if is_oom(e):
+            oom_forensics.record(site, e)
+        raise
+
+
+# process-global instances, configured by the runner at boot
+hbm_ledger = HbmLedger()
+oom_forensics = OomForensics()

@@ -54,10 +54,12 @@ from symbiont_tpu.utils.telemetry import metrics
 __all__ = [
     "DispatchLedger",
     "DeviceTraceCapture",
+    "compile_analysis_for",
     "cost_analysis_for",
     "dispatch_ledger",
     "device_trace",
     "known_sync_sites",
+    "memory_analysis_of",
 ]
 
 
@@ -102,9 +104,83 @@ def cost_analysis_for(jitted, args) -> Optional[dict]:
     return {"flops": _num("flops"), "bytes_accessed": _num("bytes accessed")}
 
 
+_MEMORY_FIELDS = (
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+)
+
+
+def memory_analysis_of(compiled) -> Optional[dict]:
+    """Static HBM footprint of a compiled executable, from XLA's
+    ``compiled.memory_analysis()`` (CompiledMemoryStats): temp (activation
+    scratch), argument, output, and generated-code bytes. Returns None
+    where the backend doesn't implement it — callers treat None as
+    "unknown", never as zero bytes."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for name, attr in _MEMORY_FIELDS:
+        try:
+            v = float(getattr(ma, attr))
+        except (AttributeError, TypeError, ValueError):
+            continue
+        if v == v and v >= 0.0:  # NaN / negative -> absent
+            out[name] = int(v)
+    return out or None
+
+
+def compile_analysis_for(jitted, args) -> tuple:
+    """Lower + compile ONCE, harvesting both analyses on the way.
+
+    Returns ``(cost, memory, compiled)``: the cost model off the Lowered,
+    the memory footprint off the Compiled, and the AOT Compiled object
+    itself so the caller can dispatch through it — the first call then
+    costs exactly one trace and one XLA compile, same as calling the
+    jitted fn directly, but the static analyses come along for free.
+    Any stage may come back None (backend support varies); a None
+    ``compiled`` means the caller must fall back to ``jitted(*args)``
+    (which re-uses jit's own cache — at worst one duplicate compile on
+    this rare path).
+    """
+    cost = mem = compiled = None
+    try:
+        lowered = jitted.lower(*args)
+    except Exception:
+        return None, None, None
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            def _num(key: str) -> float:
+                try:
+                    v = float(ca.get(key, 0.0))
+                except (TypeError, ValueError):
+                    return 0.0
+                return v if v == v and v >= 0.0 else 0.0
+
+            cost = {"flops": _num("flops"),
+                    "bytes_accessed": _num("bytes accessed")}
+    except Exception:
+        cost = None
+    try:
+        compiled = lowered.compile()
+    except Exception:
+        return cost, None, None
+    mem = memory_analysis_of(compiled)
+    return cost, mem, compiled
+
+
 class _ExeStats:
     __slots__ = ("dispatches", "wall_s", "compiles", "flops",
-                 "bytes_accessed")
+                 "bytes_accessed", "temp_bytes", "argument_bytes",
+                 "output_bytes", "generated_code_bytes")
 
     def __init__(self) -> None:
         self.dispatches = 0
@@ -112,6 +188,10 @@ class _ExeStats:
         self.compiles = 0
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
+        self.temp_bytes: Optional[int] = None
+        self.argument_bytes: Optional[int] = None
+        self.output_bytes: Optional[int] = None
+        self.generated_code_bytes: Optional[int] = None
 
 
 class DispatchLedger:
@@ -167,8 +247,10 @@ class DispatchLedger:
         self.registry.inc("xla.dispatches_total",
                           labels={"executable": signature})
 
-    def note_compile(self, signature: str, cost: Optional[dict]) -> None:
-        """First-call compile of an executable (+ its cost-model numbers)."""
+    def note_compile(self, signature: str, cost: Optional[dict],
+                     memory: Optional[dict] = None) -> None:
+        """First-call compile of an executable (+ its cost-model numbers
+        and, when the backend reports one, its static memory footprint)."""
         if not self._enabled:
             return
         with self._lock:
@@ -177,6 +259,10 @@ class DispatchLedger:
             if cost is not None:
                 st.flops = cost.get("flops")
                 st.bytes_accessed = cost.get("bytes_accessed")
+            if memory is not None:
+                for name, _attr in _MEMORY_FIELDS:
+                    if name in memory:
+                        setattr(st, name, int(memory[name]))
 
     def note_host_sync(self, site: str, n: int = 1) -> None:
         """n device->host syncs at an allowlisted site (live lint audit)."""
@@ -205,9 +291,12 @@ class DispatchLedger:
         None (unknown) when the backend exposed no cost model."""
         with self._lock:
             rows = [(sig, st.dispatches, st.wall_s, st.compiles, st.flops,
-                     st.bytes_accessed) for sig, st in self._exes.items()]
+                     st.bytes_accessed, st.temp_bytes, st.argument_bytes,
+                     st.output_bytes, st.generated_code_bytes)
+                    for sig, st in self._exes.items()]
         out = []
-        for sig, n, wall, compiles, flops, nbytes in rows:
+        for (sig, n, wall, compiles, flops, nbytes, temp, arg, outp,
+             code) in rows:
             mean_us = (wall / n * 1e6) if n else 0.0
             out.append({
                 "executable": sig,
@@ -217,6 +306,10 @@ class DispatchLedger:
                 "mean_dispatch_us": round(mean_us, 1),
                 "flops": flops,
                 "bytes_accessed": nbytes,
+                "temp_bytes": temp,
+                "argument_bytes": arg,
+                "output_bytes": outp,
+                "generated_code_bytes": code,
             })
         out.sort(key=lambda r: -r["dispatches"])
         return out

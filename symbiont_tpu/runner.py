@@ -128,6 +128,19 @@ class SymbiontStack:
         dispatch_ledger.register_zero()
         metrics.register_gauge("obs.xprof_executables",
                                dispatch_ledger.__len__)
+        # hbm attribution plane (obs/hbm.py): configure the subsystem
+        # byte ledger + OOM forensics, zero-register their families for
+        # the doc-drift sweep. Per-claim gauges register LATER (after
+        # services boot, when the engines have claimed) — see _start's
+        # device-gauge block.
+        from symbiont_tpu.obs.hbm import hbm_ledger, oom_forensics
+        hbm_ledger.configure(enabled=cfg.obs.hbm_enabled,
+                             census_groups=cfg.obs.hbm_census_groups)
+        oom_forensics.configure(postmortem_dir=cfg.obs.hbm_postmortem_dir,
+                                max_files=cfg.obs.hbm_postmortem_max,
+                                enabled=cfg.obs.hbm_enabled)
+        hbm_ledger.register_zero()
+        oom_forensics.register_zero()
         # kv.* page-pool/radix families at zero BEFORE the engine exists
         # (zero-returning callbacks a real PagePool later replaces) — the
         # doc-drift sweep sees them even on a stub stack with no LM
@@ -331,8 +344,13 @@ class SymbiontStack:
             # local device) — only once jax is demonstrably in play; a
             # CPU-only or api-only process registers nothing
             from symbiont_tpu.obs.device import register_device_gauges
+            from symbiont_tpu.obs.hbm import hbm_ledger
 
             register_device_gauges()
+            # the engines/pools/corpus have claimed by now: expose the
+            # ledger as hbm.attributed_bytes{subsystem} gauges (+ the
+            # per-device residual where the backend reports stats)
+            hbm_ledger.register_gauges()
 
         if on("perception"):
             self.services.append(

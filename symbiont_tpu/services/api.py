@@ -578,6 +578,15 @@ class ApiService:
                 # dispatch counts + host wall, placed on the roofline from
                 # the XLA cost model captured at compile time
                 return self._engine_executables()
+            if path == "/api/memory" and method == "GET":
+                # hbm attribution plane (obs/hbm.py): subsystem byte
+                # ledger reconciled against per-device memory_stats(),
+                # fleet-federated per role when the aggregator is attached
+                return self._memory()
+            if path == "/api/memory/census" and method == "GET":
+                # on-demand live-array census (?top=N, ?diff=1 for the
+                # delta vs the previous diff baseline)
+                return self._memory_census(query)
             if path == "/api/profile/device" and method == "POST":
                 metrics.inc("api.POST./api/profile/device")
                 return await self._profile_device(body)
@@ -591,14 +600,20 @@ class ApiService:
                 # freshness, supervisor liveness verdicts (up / restarts /
                 # hangs / heartbeat age — broker probe included), and key
                 # engine gauges, one entry per role
+                from symbiont_tpu.obs.hbm import oom_forensics
+
                 if self.fleet is None:
                     return 200, json.dumps(
                         {"available": False, "roles": {},
+                         "last_oom": oom_forensics.last,
                          "message": ("no fleet aggregator on this process "
                                      "— single-process stack, or "
                                      "obs.fleet_export off")})
+                # the local OOM verdict rides the roll-up (remote roles'
+                # counts federate as counter.engine.oom_total series)
                 return 200, json.dumps(
-                    {"available": True, **self.fleet.rollup()})
+                    {"available": True, "last_oom": oom_forensics.last,
+                     **self.fleet.rollup()})
             if path == "/api/dlq" and method == "GET":
                 return self._dlq_list()
             if path == "/api/dlq/replay" and method == "POST":
@@ -751,6 +766,83 @@ class ApiService:
             "total_dispatches": sum(r["dispatches"] for r in rows),
             "device_trace_artifact": device_trace.last_artifact,
         })
+
+    def _memory(self) -> Tuple[int, str]:
+        """``GET /api/memory``: the hbm ledger reconciled against device
+        reality — per-subsystem claims, per-device bytes in use / limit,
+        the unattributed residual, and the last OOM verdict. With the
+        fleet aggregator attached, every remote role's ``hbm.*`` /
+        ``device.bytes*`` gauges fold in per role, so the autoscaler reads
+        REAL fleet-wide headroom from one endpoint."""
+        import time as _time
+
+        from symbiont_tpu.obs.hbm import hbm_ledger, oom_forensics
+        from symbiont_tpu.obs.prometheus import parse_flat_key
+
+        roles: Dict[str, dict] = {}
+        if self.fleet is not None:
+            for role, flat in self.fleet.role_snapshots().items():
+                for key, v in flat.items():
+                    parsed = parse_flat_key(key)
+                    if parsed is None:
+                        continue
+                    kind, name, labels, stat = parsed
+                    if (kind != "gauge" or stat is not None
+                            or not (name.startswith("hbm.")
+                                    or name.startswith("device.bytes")
+                                    or name == "lm.hbm_headroom_bytes")):
+                        continue
+                    entry = roles.setdefault(role, {})
+                    if name == "hbm.attributed_bytes":
+                        sub = labels.get("subsystem") or "all"
+                        entry.setdefault("subsystems", {})[sub] = v
+                    else:
+                        lbl = ",".join(f"{k}={labels[k]}"
+                                       for k in sorted(labels))
+                        entry.setdefault("series", {})[
+                            f"{name}{{{lbl}}}" if lbl else name] = v
+        return 200, json.dumps({
+            "generated_at": round(_time.time(), 3),
+            "local": hbm_ledger.reconcile(),
+            "last_oom": oom_forensics.last,
+            "roles": roles,
+        })
+
+    def _memory_census(self, query: str) -> Tuple[int, str]:
+        """``GET /api/memory/census``: aggregate ``jax.live_arrays()`` by
+        (shape, dtype, sharding) — host metadata only, on demand only.
+        ``?top=N`` bounds group rows (default obs.hbm_census_groups);
+        ``?diff=1`` returns the delta against the previous diff call's
+        snapshot (and re-arms the baseline), turning "HBM grew" into the
+        owning allocation group."""
+        from urllib.parse import parse_qs
+
+        from symbiont_tpu.obs import hbm
+
+        q = parse_qs(query)
+        try:
+            top = int((q.get("top") or [hbm.hbm_ledger.census_groups])[0])
+        except ValueError:
+            raise ValueError("top must be an integer")
+        if (q.get("diff") or ["0"])[0] not in ("0", "", "false"):
+            # diff snapshots are UNBOUNDED (top=0): a leaked group must
+            # not hide inside the bounded census's "(other)" fold. Only
+            # the returned delta rows are bounded.
+            now = hbm.census(top=0)
+            before, self._census_baseline = (
+                getattr(self, "_census_baseline", None), now)
+            summary = {k: now.get(k) for k in
+                       ("available", "arrays", "bytes_total")}
+            if before is None:
+                return 200, json.dumps({
+                    "baseline_armed": True, "census": summary,
+                    "message": ("no prior baseline — this census is now "
+                                "the baseline; call ?diff=1 again to see "
+                                "the delta")})
+            return 200, json.dumps(
+                {"diff": hbm.census_diff(before, now, top=max(1, top)),
+                 "census": summary})
+        return 200, json.dumps({"census": hbm.census(top=max(1, top))})
 
     async def _profile_device(self, body: bytes) -> Tuple[int, str]:
         """``POST /api/profile/device``: capture a bounded on-demand
